@@ -1,0 +1,58 @@
+"""licensee-tpu: a TPU-native license-detection framework.
+
+Reproduces the detection semantics of the reference implementation
+(`lib/licensee.rb` facade) with a JAX/XLA batch scoring path for
+classifying millions of candidate files against the template corpus.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Over which percent a match is considered a match by default
+# (reference: lib/licensee.rb:21)
+CONFIDENCE_THRESHOLD = 98
+
+DOMAIN = "http://choosealicense.com"
+
+_confidence_threshold: float | None = None
+
+
+def confidence_threshold() -> float:
+    return CONFIDENCE_THRESHOLD if _confidence_threshold is None else _confidence_threshold
+
+
+def set_confidence_threshold(value: float) -> None:
+    global _confidence_threshold
+    _confidence_threshold = value
+
+
+def inverse_confidence_threshold() -> float:
+    # reference: lib/licensee.rb:58-61
+    return round(1 - (confidence_threshold() / 100.0), 2)
+
+
+def licenses(**options):
+    from licensee_tpu.corpus.license import License
+
+    return License.all(**options)
+
+
+def project(path: str, **args):
+    """Build the right project backend for a path/URL
+    (reference: lib/licensee.rb:37-45)."""
+    import re as _re
+
+    from licensee_tpu.projects import FSProject, GitHubProject, GitProject
+    from licensee_tpu.projects.git_project import InvalidRepository
+
+    if _re.match(r"\Ahttps://github.com", path):
+        return GitHubProject(path, **args)
+    try:
+        return GitProject(path, **args)
+    except InvalidRepository:
+        return FSProject(path, **args)
+
+
+def license(path: str):
+    return project(path).license

@@ -1,0 +1,6 @@
+from licensee_tpu.corpus.fields import LicenseField
+from licensee_tpu.corpus.meta import LicenseMeta
+from licensee_tpu.corpus.rules import LicenseRules, Rule
+from licensee_tpu.corpus.license import License
+
+__all__ = ["License", "LicenseField", "LicenseMeta", "LicenseRules", "Rule"]

@@ -1,0 +1,89 @@
+"""License metadata parsed from template YAML front matter.
+
+Parity target: `lib/licensee/license_meta.rb`.  Defaults match
+choosealicense.com's collection defaults (featured: false, hidden: true).
+"""
+
+from __future__ import annotations
+
+import yaml
+
+MEMBERS = (
+    "title",
+    "spdx_id",
+    "source",
+    "description",
+    "how",
+    "conditions",
+    "permissions",
+    "limitations",
+    "using",
+    "featured",
+    "hidden",
+    "nickname",
+    "note",
+)
+
+DEFAULTS = {"featured": False, "hidden": True}
+
+PREDICATE_FIELDS = ("featured", "hidden")
+
+
+class LicenseMeta:
+    members = MEMBERS
+
+    def __init__(self, values: dict):
+        for member in MEMBERS:
+            setattr(self, member, values.get(member))
+
+    @classmethod
+    def from_yaml(cls, raw_yaml: str | None) -> "LicenseMeta":
+        if raw_yaml is None or str(raw_yaml) == "":
+            return cls.from_hash({})
+        # Front matter arrives with its `---` document markers; take the
+        # first YAML document like Ruby's YAML.safe_load does.
+        for doc in yaml.safe_load_all(raw_yaml):
+            if doc is not None:
+                return cls.from_hash(doc)
+        return cls.from_hash({})
+
+    @classmethod
+    def from_hash(cls, data: dict) -> "LicenseMeta":
+        merged = dict(DEFAULTS)
+        merged.update(data or {})
+        merged["spdx_id"] = merged.pop("spdx-id", None)
+        return cls(merged)
+
+    @property
+    def source(self):
+        """The canonical source URL is derived from the SPDX id (reference:
+        license_meta.rb:61-63 overrides the YAML `source` field)."""
+        if self.spdx_id:
+            return f"https://spdx.org/licenses/{self.spdx_id}.html"
+        return None
+
+    @source.setter
+    def source(self, value):
+        self._raw_source = value
+
+    @property
+    def featured_q(self) -> bool:
+        return bool(self.featured)
+
+    @property
+    def hidden_q(self) -> bool:
+        return bool(self.hidden)
+
+    def __getitem__(self, key):
+        if key == "spdx-id":
+            key = "spdx_id"
+        return getattr(self, key, None)
+
+    def get(self, key, default=None):
+        value = self[key]
+        return default if value is None else value
+
+    def to_h(self) -> dict:
+        # reference: license_meta.rb HASH_METHODS = members - excluded
+        excluded = {"conditions", "permissions", "limitations", "spdx_id"}
+        return {m: getattr(self, m) for m in MEMBERS if m not in excluded}

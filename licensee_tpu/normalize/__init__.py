@@ -1,0 +1,3 @@
+from licensee_tpu.normalize.pipeline import NormalizedContent, wrap
+
+__all__ = ["NormalizedContent", "wrap"]
