@@ -1,0 +1,40 @@
+"""Detection-strategy plugin registry.
+
+Parity target: `lib/licensee/matchers.rb` — each matcher wraps a candidate
+file and reports (license, confidence).  The batch TPU path plugs into this
+registry as ``DiceXLA`` (drop-in for ``Dice`` over packed blob batches).
+"""
+
+from licensee_tpu.matchers.base import Matcher
+from licensee_tpu.matchers.copyright_matcher import Copyright
+from licensee_tpu.matchers.exact import Exact
+from licensee_tpu.matchers.dice import Dice
+from licensee_tpu.matchers.reference_matcher import Reference
+from licensee_tpu.matchers.package import (
+    Cabal,
+    Cargo,
+    Cran,
+    DistZilla,
+    Gemspec,
+    NpmBower,
+    NuGet,
+    Package,
+    Spdx,
+)
+
+__all__ = [
+    "Matcher",
+    "Copyright",
+    "Exact",
+    "Dice",
+    "Reference",
+    "Package",
+    "Gemspec",
+    "NpmBower",
+    "Cabal",
+    "Cargo",
+    "Cran",
+    "DistZilla",
+    "NuGet",
+    "Spdx",
+]

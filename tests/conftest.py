@@ -1,0 +1,85 @@
+"""Test harness configuration.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh: multi-chip sharding
+is validated without TPU hardware (the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+import re
+import subprocess
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+FIXTURES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def fixture_path(name: str) -> str:
+    return os.path.join(FIXTURES_DIR, name)
+
+
+def fixture_contents(name: str) -> str:
+    with open(fixture_path(name), encoding="utf-8") as f:
+        return f.read()
+
+
+# Field values used by the reference's vendored-license round-trip spec
+# (spec/spec_helper.rb:65-79)
+FIELD_VALUES = {
+    "fullname": "Ben Balter",
+    "year": "2018",
+    "email": "ben@github.invalid",
+    "projecturl": "http://github.invalid/benbalter/licensee",
+    "login": "benbalter",
+    "project": "Licensee",
+    "description": "Detects licenses",
+}
+
+
+def sub_copyright_info(license) -> str:
+    """Render a license template with concrete field values (the mustache
+    rendering in spec_helper.rb:77-79)."""
+    return re.sub(
+        r"\{\{\{(\w+)\}\}\}",
+        lambda m: FIELD_VALUES[m.group(1)],
+        license.content_for_mustache,
+    )
+
+
+@pytest.fixture()
+def git_fixture(tmp_path):
+    """Copy a fixture dir into a temp git repo (spec_helper.rb:96-103)."""
+
+    def _build(fixture: str) -> str:
+        import shutil
+
+        dest = tmp_path / fixture
+        shutil.copytree(fixture_path(fixture), dest)
+        subprocess.run(["git", "init", "-q"], cwd=dest, check=True)
+        subprocess.run(
+            ["git", "config", "--local", "commit.gpgsign", "false"],
+            cwd=dest,
+            check=True,
+        )
+        subprocess.run(
+            ["git", "config", "--local", "user.email", "test@example.invalid"],
+            cwd=dest,
+            check=True,
+        )
+        subprocess.run(
+            ["git", "config", "--local", "user.name", "Test"], cwd=dest, check=True
+        )
+        subprocess.run(["git", "add", "."], cwd=dest, check=True)
+        subprocess.run(
+            ["git", "commit", "-q", "-m", "initial commit"], cwd=dest, check=True
+        )
+        return str(dest)
+
+    return _build

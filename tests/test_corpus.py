@@ -1,0 +1,71 @@
+"""Corpus facts pinned by the reference's license_spec
+(spec/licensee/license_spec.rb:4-6 and friends)."""
+
+from licensee_tpu.corpus.license import License, global_title_regex
+
+
+def test_key_counts():
+    all_licenses = License.all(hidden=True)
+    assert len(all_licenses) == 49
+    assert sum(1 for lic in all_licenses if lic.hidden_q) == 36
+    assert sum(1 for lic in all_licenses if lic.featured_q) == 3
+    assert sum(1 for lic in all_licenses if lic.pseudo_license) == 2
+
+
+def test_default_options_exclude_hidden():
+    default = License.all()
+    assert all(not lic.hidden_q for lic in default)
+
+
+def test_find():
+    assert License.find("mit").key == "mit"
+    assert License.find("MIT").key == "mit"
+    assert License.find("does-not-exist") is None
+
+
+def test_find_by_title():
+    assert License.find_by_title("MIT License").key == "mit"
+    assert (
+        License.find_by_title("GNU Affero General Public License v3.0").key
+        == "agpl-3.0"
+    )
+
+
+def test_pseudo_spdx_ids():
+    assert License.find("other").spdx_id == "NOASSERTION"
+    assert License.find("no-license").spdx_id == "NONE"
+
+
+def test_meta_and_rules():
+    mit = License.find("mit")
+    assert mit.meta.spdx_id == "MIT"
+    assert mit.featured_q
+    assert not mit.hidden_q
+    assert mit.rules["permissions"]
+    assert {f.name for f in mit.fields} == {"year", "fullname"}
+
+
+def test_name_without_version():
+    assert License.find("gpl-3.0").name_without_version == "GNU General Public License"
+    assert License.find("mit").name_without_version == "MIT License"
+
+
+def test_title_regex_matches_own_title():
+    for lic in License.all(hidden=True, pseudo=False):
+        # '*' in a title is folded to 'u' (license.rb:147), so match against
+        # the folded title like the reference does
+        title = lic.title.replace("*", "u")
+        assert lic.title_regex.search(title), lic.key
+
+
+def test_global_title_regex_strips_titles():
+    regex = global_title_regex()
+    assert regex.search("MIT License\n\nPermission is hereby granted")
+    assert regex.search("The MIT License (MIT)\nbody")
+    assert not regex.search("Permission is hereby granted")
+
+
+def test_spdx_alt_segments():
+    # sanity: values are non-negative ints for every non-pseudo license
+    for lic in License.all(hidden=True, pseudo=False):
+        assert lic.spdx_alt_segments >= 0

@@ -1,0 +1,58 @@
+"""Dice matcher exactness oracle: the similarity floats pinned by
+spec/licensee/matchers/dice_matcher_spec.rb:24-28 must match bit-for-bit —
+they are the agreement contract for the batch XLA kernel too."""
+
+from licensee_tpu.corpus.license import License
+from licensee_tpu.matchers import Dice
+from licensee_tpu.project_files.license_file import LicenseFile
+from tests.conftest import fixture_contents, sub_copyright_info
+
+
+def make_file(content, filename="LICENSE.txt"):
+    return LicenseFile(content, filename)
+
+
+def test_similarity_floats():
+    gpl = License.find("gpl-3.0")
+    file = make_file(sub_copyright_info(gpl))
+    matcher = Dice(file)
+    ranked = matcher.matches_by_similarity
+    assert ranked[0][0] == gpl and ranked[0][1] == 100.0
+    assert ranked[1][0] == License.find("agpl-3.0")
+    assert ranked[1][1] == 94.56967213114754
+    assert ranked[2][0] == License.find("lgpl-2.1")
+    assert ranked[2][1] == 26.821370750134918
+
+
+def test_match_and_confidence():
+    gpl = License.find("gpl-3.0")
+    matcher = Dice(make_file(sub_copyright_info(gpl)))
+    assert matcher.match == gpl
+    assert matcher.confidence == 100.0
+
+
+def test_no_match():
+    matcher = Dice(make_file("Not really a license"))
+    assert matcher.match is None
+    assert matcher.matches == []
+    assert matcher.confidence == 0
+
+
+def test_stacked_licenses_do_not_match():
+    mit = License.find("mit")
+    gpl = License.find("gpl-3.0")
+    content = sub_copyright_info(mit) + "\n\n" + sub_copyright_info(gpl)
+    matcher = Dice(make_file(content))
+    assert matcher.match is None
+
+
+def test_cc_false_positive_guard():
+    cc_by = License.find("cc-by-4.0")
+    # CC-BY's own content matches
+    assert Dice(make_file(cc_by.content)).match == cc_by
+    # a CC-ND file must not match CC-BY / CC-BY-SA
+    content = fixture_contents("cc-by-nd/LICENSE")
+    matcher = Dice(make_file(content))
+    assert matcher.match is None
+    assert matcher.matches == []
+    assert matcher.confidence == 0

@@ -1,0 +1,62 @@
+"""Golden-file corpus test over every fixture project directory, asserting
+detected key, matcher name, and content hash against fixtures.yml
+(parity with spec/fixture_spec.rb)."""
+
+import os
+
+import pytest
+import yaml
+
+import licensee_tpu
+from licensee_tpu.corpus.license import License
+from licensee_tpu.projects import FSProject
+from tests.conftest import FIXTURES_DIR, fixture_path
+
+with open(fixture_path("fixtures.yml"), encoding="utf-8") as f:
+    FIXTURE_LICENSES = yaml.safe_load(f)
+
+FIXTURES = sorted(
+    name
+    for name in os.listdir(FIXTURES_DIR)
+    if os.path.isdir(os.path.join(FIXTURES_DIR, name))
+)
+
+
+def project_for(fixture):
+    return FSProject(
+        fixture_path(fixture), detect_packages=True, detect_readme=True
+    )
+
+
+def test_every_fixture_has_an_expectation():
+    for fixture in FIXTURES:
+        assert fixture in FIXTURE_LICENSES, fixture
+
+
+@pytest.mark.parametrize("fixture", FIXTURES)
+def test_fixture_license(fixture):
+    expectations = FIXTURE_LICENSES.get(fixture) or {}
+    project = project_for(fixture)
+    expected = (
+        License.find(expectations["key"]) if expectations.get("key") else None
+    )
+    assert project.license == expected
+
+
+@pytest.mark.parametrize("fixture", FIXTURES)
+def test_fixture_hash(fixture):
+    expectations = FIXTURE_LICENSES.get(fixture) or {}
+    project = project_for(fixture)
+    license_file = project.license_file
+    hash_ = license_file.content_hash if license_file else None
+    assert hash_ == expectations.get("hash")
+
+
+@pytest.mark.parametrize("fixture", FIXTURES)
+def test_fixture_matcher(fixture):
+    expectations = FIXTURE_LICENSES.get(fixture) or {}
+    project = project_for(fixture)
+    license_file = project.license_file
+    matcher = license_file.matcher if license_file else None
+    name = matcher.name if matcher else None
+    assert name == expectations.get("matcher")

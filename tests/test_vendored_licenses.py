@@ -1,0 +1,88 @@
+"""Round-trip detection-quality contract over every vendored license
+(parity with spec/vendored_license_spec.rb): each rendered template must be
+detected as itself — also without its title, with a doubled title, and
+re-wrapped at 60 columns — and must NOT match once 75 random words are
+injected."""
+
+import random
+
+import pytest
+
+import licensee_tpu
+from licensee_tpu.corpus.license import License
+from licensee_tpu.normalize.pipeline import wrap
+from licensee_tpu.project_files.license_file import LicenseFile
+from tests.conftest import fixture_contents, sub_copyright_info
+
+LICENSES = [
+    lic for lic in License.all(hidden=True) if not lic.pseudo_license
+]
+KEYS = [lic.key for lic in LICENSES]
+
+IPSUM_WORDS = fixture_contents("ipsum.txt").split()
+
+
+def detected_as(content, license) -> bool:
+    """The be_detected_as matcher (spec_helper.rb:119-149)."""
+    file = LicenseFile(content, "LICENSE")
+    return file.license is not None and file.license == license
+
+
+def add_random_words(string: str, count: int = 5, seed: int = 0) -> str:
+    rng = random.Random(seed)
+    words = string.split()
+    for _ in range(count):
+        word = IPSUM_WORDS[rng.randrange(len(IPSUM_WORDS))]
+        index = rng.randrange(len(words))
+        words.insert(index, word)
+    return " ".join(words)
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_detects_itself(key):
+    lic = License.find(key)
+    assert detected_as(sub_copyright_info(lic), lic)
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_confidence_equals_similarity(key):
+    lic = License.find(key)
+    file = LicenseFile(sub_copyright_info(lic), "LICENSE.txt")
+    assert file.confidence == lic.similarity(file)
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_detects_without_title(key):
+    lic = License.find(key)
+    file = LicenseFile(sub_copyright_info(lic), "LICENSE.txt")
+    stripped = file._strip_title(file.content_without_title_and_version)
+    assert detected_as(stripped, lic)
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_detects_with_double_title(key):
+    lic = License.find(key)
+    content = lic.name.replace("*", "u", 1) + "\n\n" + sub_copyright_info(lic)
+    assert detected_as(content, lic)
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_detects_rewrapped(key):
+    lic = License.find(key)
+    assert detected_as(wrap(sub_copyright_info(lic), 60), lic)
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_does_not_match_with_random_words(key):
+    lic = License.find(key)
+    content = add_random_words(sub_copyright_info(lic), 75, seed=hash(key) % 2**32)
+    assert not detected_as(content, lic)
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_does_not_match_rewrapped_with_random_words(key):
+    lic = License.find(key)
+    content = wrap(
+        add_random_words(sub_copyright_info(lic), 75, seed=hash(key) % 2**31), 60
+    )
+    assert not detected_as(content, lic)
