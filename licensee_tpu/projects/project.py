@@ -87,12 +87,15 @@ class Project:
             return None
         cached = self.__dict__.get("_readme", _UNSET)
         if cached is _UNSET:
+            from licensee_tpu.project_files.project_file import sanitize_content
             from licensee_tpu.project_files.readme_file import ReadmeFile
 
             cached = None
             result = self._find_file(ReadmeFile.name_score)
             if result is not None:
                 content, file = result
+                if content is not None:
+                    content = sanitize_content(content)
                 content = ReadmeFile.license_content(content)
                 if content and file:
                     cached = ReadmeFile(content, file)

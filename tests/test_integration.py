@@ -1,0 +1,147 @@
+"""Integration scenarios run against both FSProject and GitProject
+(parity with spec/integration_spec.rb) — same scenario table, git repos
+created on the fly."""
+
+import os
+
+import pytest
+
+from licensee_tpu.corpus.license import License
+from licensee_tpu.projects import FSProject, GitProject
+from tests.conftest import fixture_path
+
+# fixture -> (expected key or None, project kwargs)
+SCENARIOS = [
+    ("license-folder", None, {}),
+    ("lgpl", "lgpl-3.0", {}),
+    ("multiple-license-files", "other", {}),
+    ("multiple-arrs", "bsd-3-clause", {}),
+    ("cc-by-nc-sa", "other", {}),
+    ("cc-by-nd", "other", {}),
+    ("wrk-modified-apache", "other", {}),
+    ("pixar-modified-apache", "other", {}),
+    ("fcpl-modified-mpl", "other", {}),
+    ("mpl-without-hrs", "mpl-2.0", {}),
+    ("gpl3-without-instructions", "gpl-3.0", {}),
+    ("description-license", "other", {"detect_packages": True}),
+    ("crlf-license", "gpl-3.0", {}),
+    ("crlf-bsd", "bsd-3-clause", {}),
+    ("bsd-plus-patents", "other", {}),
+    ("bsl", "bsl-1.0", {}),
+    ("cc0-cc", "cc0-1.0", {}),
+    ("cc0-cal2013", "cc0-1.0", {}),
+    ("eupl-cal2017", "eupl-1.2", {}),
+    ("unlicense-noinfo", "unlicense", {}),
+    ("mit-optional", "mit", {}),
+    ("license-with-readme-reference", "mit", {"detect_readme": True}),
+    ("apache-with-readme-notice", "apache-2.0", {"detect_readme": True}),
+    ("gpl-2.0_markdown_headings", "gpl-2.0", {}),
+    ("artistic-2.0_markdown", "artistic-2.0", {}),
+    ("bsd-3-lists", "bsd-3-clause", {}),
+    ("bsd-3-noendorseslash", "bsd-3-clause", {}),
+    ("bsd-3-authorowner", "bsd-3-clause", {}),
+    ("bsd-2-author", "bsd-2-clause", {}),
+    ("html", "epl-1.0", {}),
+    ("vim", "vim", {}),
+    ("cc-by-sa-nocclicensor", "cc-by-sa-4.0", {}),
+    ("cc-by-sa-mdlinks", "cc-by-sa-4.0", {}),
+    ("bom", "mit", {}),
+]
+
+
+def build_project(project_type, fixture, kwargs, git_fixture):
+    if project_type is GitProject:
+        return GitProject(git_fixture(fixture), **kwargs)
+    return FSProject(fixture_path(fixture), **kwargs)
+
+
+@pytest.mark.parametrize("project_type", [FSProject, GitProject])
+@pytest.mark.parametrize("fixture,key,kwargs", SCENARIOS)
+def test_scenario(project_type, fixture, key, kwargs, git_fixture):
+    project = build_project(project_type, fixture, kwargs, git_fixture)
+    expected = License.find(key) if key else None
+    assert project.license == expected
+
+
+@pytest.mark.parametrize("project_type", [FSProject, GitProject])
+def test_lgpl_license_file_path(project_type, git_fixture):
+    project = build_project(project_type, "lgpl", {}, git_fixture)
+    assert project.license_file.path == "COPYING.lesser"
+
+
+@pytest.mark.parametrize("project_type", [FSProject, GitProject])
+def test_no_license_files(project_type, tmp_path, git_fixture):
+    import subprocess
+
+    path = tmp_path / "empty-project"
+    path.mkdir()
+    (path / "foo.md").write_text("bar")
+    if project_type is GitProject:
+        for cmd in (
+            ["git", "init", "-q"],
+            ["git", "config", "--local", "commit.gpgsign", "false"],
+            ["git", "config", "--local", "user.email", "t@e.invalid"],
+            ["git", "config", "--local", "user.name", "T"],
+            ["git", "add", "."],
+            ["git", "commit", "-q", "-m", "init"],
+        ):
+            subprocess.run(cmd, cwd=path, check=True)
+        project = GitProject(str(path))
+    else:
+        project = FSProject(str(path))
+    assert project.license is None
+    assert project.license_files == []
+    assert project.matched_file is None
+    assert project.matched_files == []
+
+
+STUBBED_FILENAMES = [
+    "LICENSE.md",
+    "LICENSE.txt",
+    "LiCeNSe.Txt",
+    "LICENSE-MIT",
+    "MIT-LICENSE",
+    "licence",
+    "unlicense",
+]
+
+
+@pytest.mark.parametrize("filename", STUBBED_FILENAMES)
+def test_stubbed_license_filenames(filename, tmp_path):
+    mit = License.find("mit")
+    (tmp_path / filename).write_text(mit.content)
+    project = FSProject(str(tmp_path))
+    assert project.license == mit
+    assert project.license_file.path == filename
+
+
+def test_stubbed_package_json(tmp_path):
+    (tmp_path / "package.json").write_text('{"license": "mit"}')
+    project = FSProject(str(tmp_path), detect_packages=True)
+    assert project.license == License.find("mit")
+    assert project.package_file.path == "package.json"
+
+
+def test_stubbed_readme(tmp_path):
+    mit = License.find("mit")
+    (tmp_path / "README").write_text("## License\n" + mit.content)
+    project = FSProject(str(tmp_path), detect_readme=True)
+    assert project.license == mit
+    assert project.readme_file.path == "README"
+
+
+def test_stubbed_description_file(tmp_path):
+    (tmp_path / "DESCRIPTION").write_text("Package: test\nLicense: MIT")
+    project = FSProject(str(tmp_path), detect_packages=True)
+    assert project.license == License.find("mit")
+    assert project.package_file.path == "DESCRIPTION"
+
+
+def test_search_root(tmp_path):
+    mit = License.find("mit")
+    (tmp_path / "LICENSE.txt").write_text(mit.content)
+    nested = tmp_path / "a" / "b"
+    nested.mkdir(parents=True)
+    (nested / "code.py").write_text("pass")
+    project = FSProject(str(nested), search_root=str(tmp_path))
+    assert project.license == mit
