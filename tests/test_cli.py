@@ -1,0 +1,103 @@
+"""CLI behavior (parity: spec/licensee/commands/detect_spec.rb + bin_spec.rb),
+run in-process against fixture projects."""
+
+import json
+
+import pytest
+import yaml
+
+from licensee_tpu.cli.main import main
+from tests.conftest import fixture_path
+
+
+def run_cli(argv, capsys):
+    rc = main(argv)
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_detect_mit(capsys):
+    rc, out = run_cli(["detect", fixture_path("mit")], capsys)
+    assert rc == 0
+    parsed = yaml.safe_load(out)
+    assert parsed["License"] == "MIT"
+    assert "LICENSE.txt" in parsed["Matched files"]
+    assert parsed["LICENSE.txt"]["Confidence"] == "100.00%"
+    assert parsed["LICENSE.txt"]["License"] == "MIT"
+
+
+def test_detect_json(capsys):
+    rc, out = run_cli(["detect", "--json", fixture_path("mit")], capsys)
+    assert rc == 0
+    parsed = json.loads(out)
+    assert parsed["licenses"][0]["key"] == "mit"
+    assert parsed["licenses"][0]["spdx_id"] == "MIT"
+    assert parsed["matched_files"][0]["matched_license"] == "MIT"
+    assert parsed["matched_files"][0]["matcher"] == {
+        "name": "exact",
+        "confidence": 100,
+    }
+
+
+def test_detect_no_license_exit_code(capsys, tmp_path):
+    (tmp_path / "foo.md").write_text("bar")
+    rc, _ = run_cli(["detect", str(tmp_path)], capsys)
+    assert rc == 1
+
+
+def test_detect_closest_licenses(capsys):
+    rc, out = run_cli(["detect", fixture_path("bsd-2-author")], capsys)
+    assert rc == 0
+    assert "Closest non-matching licenses:" in out
+    assert "BSD-2-Clause similarity:" in out
+
+
+def test_default_command_is_detect(capsys):
+    rc, out = run_cli([fixture_path("mit")], capsys)
+    assert rc == 0
+    assert yaml.safe_load(out)["License"] == "MIT"
+
+
+def test_license_path(capsys):
+    rc, out = run_cli(["license-path", fixture_path("mit")], capsys)
+    assert rc == 0
+    assert out.strip().endswith("LICENSE.txt")
+
+
+def test_license_path_missing(capsys, tmp_path):
+    (tmp_path / "foo.md").write_text("bar")
+    rc, _ = run_cli(["license-path", str(tmp_path)], capsys)
+    assert rc == 1
+
+
+def test_version(capsys):
+    import licensee_tpu
+
+    rc, out = run_cli(["version"], capsys)
+    assert rc == 0
+    assert out.strip() == licensee_tpu.__version__
+
+
+def test_diff_exact_match(capsys):
+    rc, out = run_cli(
+        ["diff", fixture_path("mit"), "--license", "mit"], capsys
+    )
+    assert rc == 0
+    assert "Similarity:" in out
+
+
+def test_diff_invalid_license(capsys):
+    rc, _ = run_cli(
+        ["diff", fixture_path("mit"), "--license", "not-a-license"], capsys
+    )
+    assert rc == 1
+
+
+def test_confidence_flag(capsys):
+    import licensee_tpu
+
+    rc, out = run_cli(
+        ["detect", "--confidence", "90", fixture_path("bsd-2-author")], capsys
+    )
+    assert rc == 0
+    licensee_tpu.set_confidence_threshold(licensee_tpu.CONFIDENCE_THRESHOLD)
