@@ -1,0 +1,8 @@
+from licensee_tpu.kernels.dice_xla import (
+    CorpusArrays,
+    score_pairs,
+    best_match,
+    make_best_match_fn,
+)
+
+__all__ = ["CorpusArrays", "score_pairs", "best_match", "make_best_match_fn"]

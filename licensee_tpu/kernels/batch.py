@@ -1,0 +1,161 @@
+"""Batch classification: host pre-filters + the DiceXLA device kernel.
+
+Mirrors the first-match-wins matcher chain of the reference
+(`project_files/license_file.rb:67-69`: Copyright -> Exact -> Dice) at batch
+scale: the cheap host pre-filters short-circuit blobs before they reach HBM
+(the EP-style routing of SURVEY.md §2.7), and everything else is scored in
+one vmapped bit-matrix pass on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import licensee_tpu
+from licensee_tpu.corpus.compiler import CompiledCorpus, default_corpus
+from licensee_tpu.normalize.pipeline import COPYRIGHT_FULL_REGEX, NormalizedContent
+from licensee_tpu.project_files.license_file import CC_FALSE_POSITIVE_REGEX
+from licensee_tpu.project_files.project_file import sanitize_content
+from licensee_tpu.rubytext import ruby_strip
+
+
+class NormalizedBlob(NormalizedContent):
+    """A bare content blob run through the normalization engine."""
+
+    def __init__(self, content: str | bytes | None, filename: str | None = None):
+        self.content = (
+            sanitize_content(content) if content is not None else None
+        )
+        self.filename = filename
+
+
+@dataclass
+class BlobResult:
+    key: str | None
+    matcher: str | None
+    confidence: float
+    score_num: int = 0
+    score_den: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "matcher": self.matcher,
+            "confidence": self.confidence,
+        }
+
+
+class BatchClassifier:
+    """Classify many blobs against a compiled corpus.
+
+    Host side: sanitation, Copyright / Exact pre-filters, normalization +
+    tokenization into packed bitsets.  Device side: DiceXLA best-match.
+    Scores returned to the host as exact (overlap, denominator) pairs and
+    finished in float64 — bit-identical to the scalar Ruby-semantics path.
+    """
+
+    def __init__(
+        self,
+        corpus: CompiledCorpus | None = None,
+        method: str = "popcount",
+        pad_batch_to: int = 1024,
+    ):
+        from licensee_tpu.kernels.dice_xla import CorpusArrays, make_best_match_fn
+
+        self.corpus = corpus or default_corpus()
+        self.method = method
+        self.pad_batch_to = pad_batch_to
+        self.arrays = CorpusArrays.from_compiled(self.corpus)
+        self._fn = make_best_match_fn(self.arrays, method=method)
+        # Exact matcher pre-filter: full wordset (fields included) equality
+        # (matchers/exact.rb:6-13)
+        self._exact_map = {}
+        from licensee_tpu.corpus.license import License
+
+        for key in self.corpus.keys:
+            lic = License.find(key)
+            self._exact_map[frozenset(lic.wordset)] = key
+
+    # -- host featureization --
+
+    def _prefilter(self, blob: NormalizedBlob) -> BlobResult | None:
+        content = blob.content or ""
+        if COPYRIGHT_FULL_REGEX.search(ruby_strip(content)):
+            return BlobResult("no-license", "copyright", 100.0)
+        if blob.wordset is not None and frozenset(blob.wordset) in self._exact_map:
+            return BlobResult(self._exact_map[frozenset(blob.wordset)], "exact", 100.0)
+        return None
+
+    def features(self, blobs: list[NormalizedBlob]):
+        B = len(blobs)
+        W = self.corpus.n_lanes
+        bits = np.zeros((B, W), dtype=np.uint32)
+        n_words = np.zeros(B, dtype=np.int32)
+        lengths = np.zeros(B, dtype=np.int32)
+        cc_fp = np.zeros(B, dtype=bool)
+        for i, blob in enumerate(blobs):
+            bits[i], n_words[i], lengths[i] = self.corpus.file_features(blob)
+            cc_fp[i] = bool(
+                CC_FALSE_POSITIVE_REGEX.search(ruby_strip(blob.content or ""))
+            )
+        return bits, n_words, lengths, cc_fp
+
+    # -- classification --
+
+    def classify_blobs(
+        self, contents: list[str | bytes], threshold: float | None = None
+    ) -> list[BlobResult]:
+        threshold = (
+            licensee_tpu.confidence_threshold() if threshold is None else threshold
+        )
+        blobs = [NormalizedBlob(c) for c in contents]
+        results: list[BlobResult | None] = [self._prefilter(b) for b in blobs]
+
+        todo = [i for i, r in enumerate(results) if r is None]
+        if todo:
+            for start in range(0, len(todo), self.pad_batch_to):
+                chunk = todo[start : start + self.pad_batch_to]
+                self._classify_chunk(blobs, results, chunk, threshold)
+        return results  # type: ignore[return-value]
+
+    def _classify_chunk(self, blobs, results, chunk, threshold) -> None:
+        B = self.pad_batch_to
+        bits, n_words, lengths, cc_fp = self.features([blobs[i] for i in chunk])
+        pad = B - len(chunk)
+        if pad:
+            bits = np.pad(bits, ((0, pad), (0, 0)))
+            n_words = np.pad(n_words, (0, pad))
+            lengths = np.pad(lengths, (0, pad))
+            cc_fp = np.pad(cc_fp, (0, pad))
+        best_idx, best_num, best_den = self._fn(bits, n_words, lengths, cc_fp)
+        best_idx = np.asarray(best_idx)[: len(chunk)]
+        best_num = np.asarray(best_num)[: len(chunk)]
+        best_den = np.asarray(best_den)[: len(chunk)]
+
+        # float64 finish: identical to Ruby's Float score (dice.rb:57-59)
+        scores = np.where(
+            best_den > 0, (best_num * 200.0) / best_den, 0.0
+        )
+        for j, i in enumerate(chunk):
+            if best_num[j] >= 0 and scores[j] >= threshold:
+                results[i] = BlobResult(
+                    self.corpus.keys[int(best_idx[j])],
+                    "dice",
+                    float(scores[j]),
+                    int(best_num[j]),
+                    int(best_den[j]),
+                )
+            else:
+                results[i] = BlobResult(None, None, 0.0)
+
+
+def batch_detect_paths(paths: list[str], **kwargs) -> list[dict]:
+    """Classify files by path (the CLI `batch-detect` command)."""
+    classifier = BatchClassifier(**kwargs)
+    contents = []
+    for path in paths:
+        with open(path, "rb") as f:
+            contents.append(f.read())
+    return [r.as_dict() for r in classifier.classify_blobs(contents)]

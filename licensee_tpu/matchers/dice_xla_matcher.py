@@ -1,0 +1,51 @@
+"""DiceXLA as a registry matcher: drop-in for the scalar Dice matcher,
+scoring through the batched XLA kernel (north-star integration point —
+the `Matchers::DiceXLA` of BASELINE.json)."""
+
+from __future__ import annotations
+
+import licensee_tpu
+from licensee_tpu.matchers.base import Matcher
+
+_UNSET = object()
+
+
+def _shared_classifier():
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    global _classifier
+    try:
+        return _classifier
+    except NameError:
+        _classifier = BatchClassifier(pad_batch_to=8)
+        return _classifier
+
+
+class DiceXLA(Matcher):
+    @property
+    def match(self):
+        cached = self.__dict__.get("_match", _UNSET)
+        if cached is _UNSET:
+            from licensee_tpu.corpus.license import License
+
+            result = self._result()
+            cached = License.find(result.key) if result.key else None
+            self.__dict__["_match"] = cached
+        return cached
+
+    @property
+    def confidence(self) -> float:
+        result = self._result()
+        return result.confidence if result.key else 0
+
+    def _result(self):
+        cached = self.__dict__.get("_xla_result")
+        if cached is None:
+            classifier = _shared_classifier()
+            content = self.file.content
+            cached = classifier.classify_blobs(
+                [content if content is not None else ""],
+                threshold=licensee_tpu.confidence_threshold(),
+            )[0]
+            self.__dict__["_xla_result"] = cached
+        return cached

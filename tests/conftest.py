@@ -18,6 +18,23 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+
+def _force_cpu_jax():
+    """Pin JAX to the virtual CPU mesh for tests.
+
+    The environment's TPU plugin may override jax_platforms via config at
+    interpreter startup (sitecustomize), which beats the JAX_PLATFORMS env
+    var — so set the config explicitly before any backend initializes."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass
+
+
+_force_cpu_jax()
+
 FIXTURES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
 
 
