@@ -146,14 +146,17 @@ def _argmax_exact(num: jnp.ndarray, den: jnp.ndarray):
         cand_num = lax.dynamic_index_in_dim(num64, t, axis=1, keepdims=False)
         cand_den = lax.dynamic_index_in_dim(den64, t, axis=1, keepdims=False)
         better = cand_num * best_den > best_num * cand_den
+        t32 = lax.convert_element_type(t, jnp.int32)
         return (
-            jnp.where(better, t, best_idx),
+            jnp.where(better, t32, best_idx),
             jnp.where(better, cand_num, best_num),
             jnp.where(better, cand_den, best_den),
         )
 
+    # derive the index init from a varying operand so the carry has the
+    # same manual-axes type under shard_map as the body output
     init = (
-        jnp.zeros(B, dtype=jnp.int32),
+        jnp.zeros_like(num[:, 0], dtype=jnp.int32),
         num64[:, 0],
         den64[:, 0],
     )

@@ -1,0 +1,7 @@
+from licensee_tpu.parallel.mesh import (
+    build_mesh,
+    make_sharded_scorer,
+    shard_batch,
+)
+
+__all__ = ["build_mesh", "make_sharded_scorer", "shard_batch"]

@@ -1,0 +1,179 @@
+"""Device-mesh sharding for the batch scoring path.
+
+The reference is single-process Ruby (SURVEY.md §2.7 — no parallelism of
+any kind); this module is the TPU-native scale-out design:
+
+* **data axis** (primary): the candidate-blob batch is sharded across
+  chips — each chip scores its slice against the full template matrix.
+  This is the 10M-files lever; no cross-chip communication is needed in
+  the steady state, so throughput scales linearly over ICI-connected
+  chips.
+* **model axis**: the template bit-matrix is sharded along the vocab
+  (lane) dimension for corpora whose T×V matrix outgrows per-chip HBM
+  (full SPDX + large vocab).  Each chip computes partial popcounts over
+  its lane slice, and the partial overlaps are summed with a `psum` over
+  the model axis inside `shard_map` — the collective rides ICI.
+
+Multi-host (DCN) runs use the same meshes built over
+`jax.distributed`-initialized global devices: `jax.make_mesh` lays out
+axes so that the model axis stays within a slice (ICI) and the data axis
+spans slices (DCN), which is the right placement because the data axis
+never communicates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from licensee_tpu.kernels.dice_xla import (
+    CorpusArrays,
+    _argmax_exact,
+    score_pairs,
+)
+
+
+def build_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ('data', 'model') mesh over the available devices."""
+    devices = devices if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_model
+    grid = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(grid, ("data", "model"))
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Place per-blob arrays with their batch dim sharded over 'data'."""
+    out = []
+    for a in arrays:
+        spec = P("data", *([None] * (a.ndim - 1)))
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)
+
+
+def make_sharded_scorer(
+    corpus: CorpusArrays, mesh: Mesh, method: str = "popcount"
+):
+    """A scorer jitted over the mesh.
+
+    Blob features come in sharded over 'data'.  The template matrix is
+    sharded over 'model' along the packed-lane axis; partial overlaps are
+    psum-reduced.  With n_model == 1 the psum is the identity and XLA
+    compiles a pure data-parallel program."""
+
+    n_model = mesh.shape["model"]
+
+    def _score(corpus_arrays, file_bits, n_words, lengths, cc_fp):
+        num, den = score_pairs(
+            corpus_arrays, file_bits, n_words, lengths, cc_fp, method=method
+        )
+        return _argmax_exact(num, den)
+
+    if n_model == 1:
+        # Pure DP: replicate the corpus, shard the batch; XLA partitions
+        # everything else automatically.
+        corpus_sharding = jax.tree.map(
+            lambda _a: NamedSharding(mesh, P()), corpus
+        )
+        data_shardings = (
+            NamedSharding(mesh, P("data", None)),
+            NamedSharding(mesh, P("data")),
+            NamedSharding(mesh, P("data")),
+            NamedSharding(mesh, P("data")),
+        )
+        fn = jax.jit(
+            _score,
+            in_shardings=(corpus_sharding, *data_shardings),
+            out_shardings=NamedSharding(mesh, P("data")),
+        )
+        corpus_on_mesh = jax.device_put(
+            corpus, jax.tree.map(lambda _a: NamedSharding(mesh, P()), corpus)
+        )
+        return partial(fn, corpus_on_mesh)
+
+    # DP × TP: lanes of the bit-matrix (and of blob bitsets) are sharded
+    # over 'model'; each chip popcounts its lane slice and the partial
+    # overlaps are summed over the model axis.
+    from jax import shard_map
+
+    def _tp_score(corpus_arrays, file_bits, n_words, lengths, cc_fp):
+        # Inside shard_map: arrays hold this chip's (data, model) block.
+        from licensee_tpu.kernels.dice_xla import (
+            _overlap_matmul,
+            _overlap_popcount,
+        )
+
+        overlap_fn = _overlap_matmul if method == "matmul" else _overlap_popcount
+        partial_overlap = overlap_fn(file_bits, corpus_arrays.bits)
+        overlap = lax.psum(partial_overlap, "model")
+
+        total = (
+            corpus_arrays.n_wf[None, :]
+            + n_words[:, None]
+            - corpus_arrays.n_fieldset[None, :]
+        )
+        delta = jnp.abs(corpus_arrays.length[None, :] - lengths[:, None])
+        adj = jnp.maximum(
+            delta
+            - 5
+            * jnp.maximum(corpus_arrays.field_count, corpus_arrays.alt_count)[
+                None, :
+            ],
+            0,
+        )
+        denom = total + adj // 4
+        excluded = (corpus_arrays.cc_flag[None, :] & cc_fp[:, None]) | ~(
+            corpus_arrays.valid[None, :]
+        )
+        num = jnp.where(excluded, -1, overlap)
+        den = jnp.where(excluded | (denom <= 0), 1, denom)
+        return _argmax_exact(num, den)
+
+    # lanes of the bit-matrix sharded over the model axis; scalars replicated
+    spec_fields = {
+        "bits": P(None, "model"),
+        "n_wf": P(),
+        "n_fieldset": P(),
+        "field_count": P(),
+        "alt_count": P(),
+        "length": P(),
+        "cc_flag": P(),
+        "valid": P(),
+    }
+    corpus_specs = CorpusArrays(**spec_fields)
+    fn = shard_map(
+        _tp_score,
+        mesh=mesh,
+        in_specs=(
+            corpus_specs,
+            P("data", "model"),
+            P("data"),
+            P("data"),
+            P("data"),
+        ),
+        out_specs=(P("data"), P("data"), P("data")),
+    )
+    jitted = jax.jit(fn)
+
+    corpus_on_mesh = CorpusArrays(
+        **{
+            name: jax.device_put(
+                getattr(corpus, name), NamedSharding(mesh, spec)
+            )
+            for name, spec in spec_fields.items()
+        }
+    )
+
+    def run(file_bits, n_words, lengths, cc_fp):
+        return jitted(corpus_on_mesh, file_bits, n_words, lengths, cc_fp)
+
+    return run

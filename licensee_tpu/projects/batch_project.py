@@ -1,0 +1,116 @@
+"""BatchProject: classify a manifest of millions of blobs.
+
+The scale-out ingestion path of SURVEY.md §7 step 5: manifest -> featurize
+workers -> fixed-width packed batches -> (double-buffered) device feed ->
+JSONL results, with a resumable shard manifest (the checkpoint/resume
+subsystem; the reference's closest analog is its pervasive memoization +
+golden caches, SURVEY.md §5).
+
+Host pre-filters (Copyright regex, Exact wordset hash) short-circuit blobs
+before they are packed for HBM, mirroring the first-match-wins chain
+(project_files/project_file.rb:69-71).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+import licensee_tpu
+
+
+@dataclass
+class BatchStats:
+    total: int = 0
+    prefiltered_copyright: int = 0
+    prefiltered_exact: int = 0
+    dice_matched: int = 0
+    unmatched: int = 0
+    read_errors: int = 0
+
+    def as_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+class BatchProject:
+    """Classify every path in a manifest against the compiled corpus.
+
+    Results stream to ``<output>`` as JSON lines; a run interrupted at any
+    point resumes from the last completed batch (line count == completed
+    prefix of the manifest)."""
+
+    def __init__(
+        self,
+        manifest_paths: list[str],
+        corpus=None,
+        method: str = "popcount",
+        batch_size: int = 4096,
+        threshold: float | None = None,
+    ):
+        from licensee_tpu.kernels.batch import BatchClassifier
+
+        self.paths = list(manifest_paths)
+        self.classifier = BatchClassifier(
+            corpus=corpus, method=method, pad_batch_to=batch_size
+        )
+        self.batch_size = batch_size
+        self.threshold = (
+            licensee_tpu.confidence_threshold() if threshold is None else threshold
+        )
+        self.stats = BatchStats()
+
+    @classmethod
+    def from_manifest_file(cls, manifest_file: str, **kwargs) -> "BatchProject":
+        with open(manifest_file, encoding="utf-8") as f:
+            paths = [line.strip() for line in f if line.strip()]
+        return cls(paths, **kwargs)
+
+    def _read(self, path: str) -> bytes | None:
+        try:
+            with open(path, "rb") as f:
+                return f.read(64 * 1024)  # MAX_LICENSE_SIZE cap (git_project.rb:53)
+        except OSError:
+            self.stats.read_errors += 1
+            return None
+
+    def run(self, output: str, resume: bool = True) -> BatchStats:
+        done = 0
+        if resume and os.path.exists(output):
+            with open(output, encoding="utf-8") as f:
+                done = sum(1 for _ in f)
+        mode = "a" if done else "w"
+
+        with open(output, mode, encoding="utf-8") as out:
+            for start in range(done, len(self.paths), self.batch_size):
+                chunk = self.paths[start : start + self.batch_size]
+                contents = [self._read(p) for p in chunk]
+                results = self.classifier.classify_blobs(
+                    [c if c is not None else b"" for c in contents],
+                    threshold=self.threshold,
+                )
+                for path, result in zip(chunk, results):
+                    self._count(result)
+                    out.write(json.dumps({"path": path, **result.as_dict()}) + "\n")
+                out.flush()
+        self.stats.total = len(self.paths)
+        return self.stats
+
+    def classify_contents(self, contents: list[bytes | str]) -> list:
+        results = self.classifier.classify_blobs(contents, threshold=self.threshold)
+        for result in results:
+            self._count(result)
+        self.stats.total += len(contents)
+        return results
+
+    def _count(self, result) -> None:
+        if result.matcher == "copyright":
+            self.stats.prefiltered_copyright += 1
+        elif result.matcher == "exact":
+            self.stats.prefiltered_exact += 1
+        elif result.matcher == "dice":
+            self.stats.dice_matched += 1
+        else:
+            self.stats.unmatched += 1

@@ -1,0 +1,58 @@
+"""BatchProject: manifest-driven classification with resume."""
+
+import json
+import os
+
+from licensee_tpu.projects.batch_project import BatchProject
+from tests.conftest import FIXTURES_DIR, fixture_path
+
+
+def manifest_paths():
+    paths = []
+    for fixture in ("mit", "bsd-2-author", "cc-by-nd", "mit-with-copyright"):
+        dir_path = fixture_path(fixture)
+        for name in sorted(os.listdir(dir_path)):
+            full = os.path.join(dir_path, name)
+            if os.path.isfile(full) and name.lower().startswith(("license", "copying")):
+                paths.append(full)
+    return paths
+
+
+def test_batch_run_and_resume(tmp_path):
+    paths = manifest_paths()
+    out = tmp_path / "results.jsonl"
+
+    project = BatchProject(paths, batch_size=4)
+    stats = project.run(str(out))
+    lines = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(lines) == len(paths)
+    assert stats.total == len(paths)
+
+    by_path = {line["path"]: line for line in lines}
+    assert by_path[fixture_path("mit/LICENSE.txt")]["key"] == "mit"
+    assert by_path[fixture_path("bsd-2-author/LICENSE")]["key"] == "bsd-2-clause"
+    assert by_path[fixture_path("cc-by-nd/LICENSE")]["key"] is None
+
+    # resume: a second run appends nothing
+    project2 = BatchProject(paths, batch_size=4)
+    project2.run(str(out), resume=True)
+    assert len(out.read_text().splitlines()) == len(paths)
+
+
+def test_batch_stats(tmp_path):
+    paths = manifest_paths()
+    project = BatchProject(paths, batch_size=8)
+    project.run(str(tmp_path / "r.jsonl"))
+    stats = project.stats
+    assert stats.prefiltered_exact >= 1  # mit/LICENSE.txt
+    assert stats.dice_matched >= 1       # bsd-2-author
+    assert stats.unmatched >= 1          # cc-by-nd
+
+
+def test_classify_contents():
+    project = BatchProject([])
+    results = project.classify_contents(
+        [open(fixture_path("mit/LICENSE.txt"), "rb").read(), b"nope"]
+    )
+    assert results[0].key == "mit"
+    assert results[1].key is None

@@ -1,0 +1,79 @@
+"""GitHubProject behavior with a stubbed HTTP layer — the reference's
+WebMock pattern (spec/licensee/projects/git_hub_project_spec.rb): fake the
+remote, never hit the network."""
+
+import os
+
+import pytest
+
+from licensee_tpu.corpus.license import License
+from licensee_tpu.projects import GitHubProject, RepoNotFound
+from tests.conftest import FIXTURES_DIR, fixture_path
+
+
+class StubbedGitHubProject(GitHubProject):
+    """Serves the contents API from a local fixture directory."""
+
+    def __init__(self, url, fixture="mit", **kwargs):
+        self.fixture = fixture
+        super().__init__(url, **kwargs)
+
+    def _request(self, path, raw=False):
+        root = fixture_path(self.fixture)
+        if not path:
+            return [
+                {"name": name, "type": "file", "path": name}
+                for name in sorted(os.listdir(root))
+            ]
+        full = os.path.join(root, path)
+        if not os.path.exists(full):
+            return None
+        with open(full, "rb") as f:
+            return f.read()
+
+
+class EmptyGitHubProject(GitHubProject):
+    def _request(self, path, raw=False):
+        return None if raw else []
+
+
+def test_repo_url_parsing():
+    project = StubbedGitHubProject("https://github.com/benbalter/licensee")
+    assert project.repo == "benbalter/licensee"
+
+
+def test_repo_url_with_dot_git():
+    project = StubbedGitHubProject("https://github.com/benbalter/licensee.git")
+    assert project.repo == "benbalter/licensee"
+
+
+def test_invalid_url_raises():
+    with pytest.raises(ValueError):
+        GitHubProject("https://gitlab.com/benbalter/licensee")
+
+
+def test_detects_license_remotely():
+    project = StubbedGitHubProject("https://github.com/benbalter/licensee")
+    assert project.license == License.find("mit")
+
+
+def test_missing_repo_raises_not_found():
+    project = EmptyGitHubProject("https://github.com/benbalter/does-not-exist")
+    with pytest.raises(RepoNotFound):
+        _ = project.license
+
+
+def test_facade_routes_github_urls(monkeypatch):
+    import licensee_tpu
+
+    captured = {}
+
+    class FakeProject:
+        def __init__(self, url, **kwargs):
+            captured["url"] = url
+
+    monkeypatch.setattr(
+        "licensee_tpu.projects.GitHubProject", FakeProject
+    )
+    licensee_tpu.project("https://github.com/a/b")
+    assert captured["url"] == "https://github.com/a/b"

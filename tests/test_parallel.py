@@ -1,0 +1,69 @@
+"""Multi-chip sharding validation on the virtual 8-device CPU mesh:
+data-parallel and data×model (vocab-sharded, psum-reduced) scoring must
+produce exactly the single-device results."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from licensee_tpu.corpus.compiler import default_corpus
+from licensee_tpu.kernels.batch import BatchClassifier, NormalizedBlob
+from licensee_tpu.kernels.dice_xla import CorpusArrays, make_best_match_fn
+from licensee_tpu.parallel.mesh import build_mesh, make_sharded_scorer, shard_batch
+from tests.conftest import fixture_contents, sub_copyright_info
+
+
+@pytest.fixture(scope="module")
+def features():
+    from licensee_tpu.corpus.license import License
+
+    corpus = default_corpus()
+    classifier = BatchClassifier()
+    licenses = License.all(hidden=True, pseudo=False)
+    blobs = [
+        NormalizedBlob(sub_copyright_info(lic)) for lic in licenses[:14]
+    ] + [NormalizedBlob(fixture_contents("cc-by-nd/LICENSE"))] + [
+        NormalizedBlob("not a license at all")
+    ]
+    bits, n_words, lengths, cc_fp = classifier.features(blobs)
+    return corpus, bits, n_words, lengths, cc_fp
+
+
+@pytest.fixture(scope="module")
+def reference_result(features):
+    corpus, bits, n_words, lengths, cc_fp = features
+    arrays = CorpusArrays.from_compiled(corpus)
+    fn = make_best_match_fn(arrays)
+    idx, num, den = fn(bits, n_words, lengths, cc_fp)
+    return np.asarray(idx), np.asarray(num), np.asarray(den)
+
+
+def _assert_matches_reference(result, reference):
+    for got, want in zip(result, reference):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("n_data,n_model", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_scorer_agrees(features, reference_result, n_data, n_model):
+    corpus, bits, n_words, lengths, cc_fp = features
+    arrays = CorpusArrays.from_compiled(corpus)
+    mesh = build_mesh(n_data=n_data, n_model=n_model)
+    scorer = make_sharded_scorer(arrays, mesh, method="popcount")
+    sharded = shard_batch(mesh, bits, n_words, lengths, cc_fp)
+    result = scorer(*sharded)
+    _assert_matches_reference(result, reference_result)
+
+
+def test_sharded_matmul_agrees(features, reference_result):
+    corpus, bits, n_words, lengths, cc_fp = features
+    arrays = CorpusArrays.from_compiled(corpus)
+    mesh = build_mesh(n_data=4, n_model=2)
+    scorer = make_sharded_scorer(arrays, mesh, method="matmul")
+    sharded = shard_batch(mesh, bits, n_words, lengths, cc_fp)
+    result = scorer(*sharded)
+    _assert_matches_reference(result, reference_result)
