@@ -60,8 +60,14 @@ def bench_device(arrays, features, method: str, iters: int = 20):
 
     from licensee_tpu.kernels.dice_xla import make_best_match_fn
 
-    fn = make_best_match_fn(arrays, method=method)
-    args = [jax.device_put(a) for a in features]
+    if method == "pallas":
+        from licensee_tpu.kernels.dice_pallas import make_padded_best_match_fn
+
+        prepare, fn = make_padded_best_match_fn(arrays, tile_b=512)
+        args = [jax.device_put(a) for a in prepare(*features)]
+    else:
+        fn = make_best_match_fn(arrays, method=method)
+        args = [jax.device_put(a) for a in features]
     out = fn(*args)
     jax.block_until_ready(out)  # compile + warm up
     start = time.perf_counter()
@@ -98,7 +104,9 @@ def bench_scalar_baseline(n_samples: int = 30) -> float:
 
 
 def main() -> None:
-    n_blobs = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    # big batches amortize the per-dispatch latency floor of the TPU
+    # tunnel (~4 ms); 256k blobs puts the bench in the throughput regime
+    n_blobs = int(sys.argv[1]) if len(sys.argv) > 1 else 262144
     from licensee_tpu.corpus.compiler import default_corpus
     from licensee_tpu.kernels.dice_xla import CorpusArrays
 
@@ -107,7 +115,7 @@ def main() -> None:
     features = build_blob_features(corpus, n_blobs)
 
     rates = {}
-    for method in ("popcount", "matmul"):
+    for method in ("popcount", "matmul", "pallas"):
         try:
             rates[method] = bench_device(arrays, features, method)
         except Exception as exc:  # keep the bench robust per-method

@@ -68,7 +68,14 @@ class BatchClassifier:
         self.method = method
         self.pad_batch_to = pad_batch_to
         self.arrays = CorpusArrays.from_compiled(self.corpus)
-        self._fn = make_best_match_fn(self.arrays, method=method)
+        if method == "pallas":
+            from licensee_tpu.kernels.dice_pallas import (
+                make_best_match_fn_pallas,
+            )
+
+            self._fn = make_best_match_fn_pallas(self.arrays)
+        else:
+            self._fn = make_best_match_fn(self.arrays, method=method)
         # Exact matcher pre-filter: full wordset (fields included) equality
         # (matchers/exact.rb:6-13)
         self._exact_map = {}

@@ -114,11 +114,12 @@ def score_pairs(
     adj_delta = max(0, |len_t - len_b| - 5*max(field_count, alt_count))
     (content_helper.rb:128-133, 337-347).  Excluded pairs (CC guard /
     padding) get (-1, 1) so they never win the ranking."""
-    overlap = (
-        _overlap_matmul(file_bits, corpus.bits)
-        if method == "matmul"
-        else _overlap_popcount(file_bits, corpus.bits)
-    )
+    if method == "matmul":
+        overlap = _overlap_matmul(file_bits, corpus.bits)
+    elif method == "popcount":
+        overlap = _overlap_popcount(file_bits, corpus.bits)
+    else:
+        raise ValueError(f"unknown scoring method: {method!r}")
 
     total = corpus.n_wf[None, :] + n_words[:, None] - corpus.n_fieldset[None, :]
     delta = jnp.abs(corpus.length[None, :] - lengths[:, None])
