@@ -348,15 +348,18 @@ def make_best_match_fn_pallas(corpus: CorpusArrays,
                               interpret: bool | None = None):
     """Drop-in for `dice_xla.make_best_match_fn` backed by the pallas kernel.
 
-    The padding/packing happens per call on host (cheap numpy); the
-    pallas_call itself is jit-cached on the padded shapes.
-    """
+    The padding/packing happens per call on host (cheap numpy); scoring
+    and the exact ranking run as ONE jitted computation (per padded
+    shape), so a call costs a single device dispatch — not one per
+    post-kernel slice/astype op."""
+    prepare, scorer = make_padded_best_match_fn(
+        corpus, tile_b=tile_b, interpret=interpret
+    )
 
     def fn(file_bits, n_words, lengths, cc_fp):
-        return best_match_pallas(
-            corpus, file_bits, n_words, lengths, cc_fp,
-            tile_b=tile_b, interpret=interpret,
-        )
+        B = np.asarray(file_bits).shape[0]
+        idx, num, den = scorer(*prepare(file_bits, n_words, lengths, cc_fp))
+        return idx[:B], num[:B], den[:B]
 
     return fn
 

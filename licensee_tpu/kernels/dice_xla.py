@@ -100,27 +100,32 @@ def _overlap_matmul(file_bits: jnp.ndarray, tpl_bits: jnp.ndarray) -> jnp.ndarra
     )
 
 
-def score_pairs(
+def overlap_pairs(
+    corpus: CorpusArrays, file_bits: jnp.ndarray, method: str = "popcount"
+) -> jnp.ndarray:
+    """int32[B, T] intersection sizes; raises on unknown method."""
+    if method == "matmul":
+        return _overlap_matmul(file_bits, corpus.bits)
+    if method == "popcount":
+        return _overlap_popcount(file_bits, corpus.bits)
+    raise ValueError(f"unknown scoring method: {method!r}")
+
+
+def finish_scores(
     corpus: CorpusArrays,
-    file_bits: jnp.ndarray,   # uint32[B, W]
+    overlap: jnp.ndarray,     # int32[B, T]
     n_words: jnp.ndarray,     # int32[B]
     lengths: jnp.ndarray,     # int32[B]
     cc_fp: jnp.ndarray,       # bool[B]
-    method: str = "popcount",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Exact (numerator, denominator) for every (blob, template) pair.
+    """The exact integer score algebra, shared by every scoring path
+    (single-device, TP-sharded partial-popcount, and as the model for the
+    fused pallas kernel).
 
     score = 200*overlap / (n_wf + n_words - n_fieldset + adj_delta//4) with
     adj_delta = max(0, |len_t - len_b| - 5*max(field_count, alt_count))
     (content_helper.rb:128-133, 337-347).  Excluded pairs (CC guard /
     padding) get (-1, 1) so they never win the ranking."""
-    if method == "matmul":
-        overlap = _overlap_matmul(file_bits, corpus.bits)
-    elif method == "popcount":
-        overlap = _overlap_popcount(file_bits, corpus.bits)
-    else:
-        raise ValueError(f"unknown scoring method: {method!r}")
-
     total = corpus.n_wf[None, :] + n_words[:, None] - corpus.n_fieldset[None, :]
     delta = jnp.abs(corpus.length[None, :] - lengths[:, None])
     adj = jnp.maximum(
@@ -133,6 +138,19 @@ def score_pairs(
     num = jnp.where(excluded, -1, overlap)
     den = jnp.where(excluded | (denom <= 0), 1, denom)
     return num, den
+
+
+def score_pairs(
+    corpus: CorpusArrays,
+    file_bits: jnp.ndarray,   # uint32[B, W]
+    n_words: jnp.ndarray,     # int32[B]
+    lengths: jnp.ndarray,     # int32[B]
+    cc_fp: jnp.ndarray,       # bool[B]
+    method: str = "popcount",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact (numerator, denominator) for every (blob, template) pair."""
+    overlap = overlap_pairs(corpus, file_bits, method)
+    return finish_scores(corpus, overlap, n_words, lengths, cc_fp)
 
 
 def _argmax_exact(num: jnp.ndarray, den: jnp.ndarray):

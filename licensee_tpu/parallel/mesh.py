@@ -34,6 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from licensee_tpu.kernels.dice_xla import (
     CorpusArrays,
     _argmax_exact,
+    finish_scores,
+    overlap_pairs,
     score_pairs,
 )
 
@@ -71,6 +73,8 @@ def make_sharded_scorer(
     compiles a pure data-parallel program."""
 
     n_model = mesh.shape["model"]
+    if method not in ("popcount", "matmul"):
+        raise ValueError(f"unknown scoring method: {method!r}")
 
     def _score(corpus_arrays, file_bits, n_words, lengths, cc_fp):
         num, den = score_pairs(
@@ -107,35 +111,13 @@ def make_sharded_scorer(
 
     def _tp_score(corpus_arrays, file_bits, n_words, lengths, cc_fp):
         # Inside shard_map: arrays hold this chip's (data, model) block.
-        from licensee_tpu.kernels.dice_xla import (
-            _overlap_matmul,
-            _overlap_popcount,
-        )
-
-        overlap_fn = _overlap_matmul if method == "matmul" else _overlap_popcount
-        partial_overlap = overlap_fn(file_bits, corpus_arrays.bits)
+        # Each chip popcounts its lane slice; psum over 'model' rebuilds
+        # the full overlap, then the shared exact algebra finishes it.
+        partial_overlap = overlap_pairs(corpus_arrays, file_bits, method)
         overlap = lax.psum(partial_overlap, "model")
-
-        total = (
-            corpus_arrays.n_wf[None, :]
-            + n_words[:, None]
-            - corpus_arrays.n_fieldset[None, :]
+        num, den = finish_scores(
+            corpus_arrays, overlap, n_words, lengths, cc_fp
         )
-        delta = jnp.abs(corpus_arrays.length[None, :] - lengths[:, None])
-        adj = jnp.maximum(
-            delta
-            - 5
-            * jnp.maximum(corpus_arrays.field_count, corpus_arrays.alt_count)[
-                None, :
-            ],
-            0,
-        )
-        denom = total + adj // 4
-        excluded = (corpus_arrays.cc_flag[None, :] & cc_fp[:, None]) | ~(
-            corpus_arrays.valid[None, :]
-        )
-        num = jnp.where(excluded, -1, overlap)
-        den = jnp.where(excluded | (denom <= 0), 1, denom)
         return _argmax_exact(num, den)
 
     # lanes of the bit-matrix sharded over the model axis; scalars replicated

@@ -76,11 +76,31 @@ class BatchProject:
             self.stats.read_errors += 1
             return None
 
+    @staticmethod
+    def _resume_point(output: str) -> int:
+        """Count completed records, discarding a torn tail.
+
+        A crash mid-write can leave a final line without its newline (or
+        truncated); only newline-terminated lines count as done, and the
+        file is truncated back to the last complete record so the resumed
+        run rewrites the torn row instead of leaving it corrupt."""
+        done = 0
+        good_end = 0
+        with open(output, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break
+                done += 1
+                good_end += len(line)
+        if good_end < os.path.getsize(output):
+            with open(output, "r+b") as f:
+                f.truncate(good_end)
+        return done
+
     def run(self, output: str, resume: bool = True) -> BatchStats:
         done = 0
         if resume and os.path.exists(output):
-            with open(output, encoding="utf-8") as f:
-                done = sum(1 for _ in f)
+            done = self._resume_point(output)
         mode = "a" if done else "w"
 
         with open(output, mode, encoding="utf-8") as out:
@@ -91,11 +111,16 @@ class BatchProject:
                     [c if c is not None else b"" for c in contents],
                     threshold=self.threshold,
                 )
-                for path, result in zip(chunk, results):
-                    self._count(result)
-                    out.write(json.dumps({"path": path, **result.as_dict()}) + "\n")
+                for path, content, result in zip(chunk, contents, results):
+                    row = {"path": path, **result.as_dict()}
+                    if content is None:
+                        # distinguish "could not read" from "no license"
+                        row["error"] = "read_error"
+                    else:
+                        self._count(result)
+                    self.stats.total += 1
+                    out.write(json.dumps(row) + "\n")
                 out.flush()
-        self.stats.total = len(self.paths)
         return self.stats
 
     def classify_contents(self, contents: list[bytes | str]) -> list:

@@ -56,3 +56,56 @@ def test_classify_contents():
     )
     assert results[0].key == "mit"
     assert results[1].key is None
+
+
+def test_resume_discards_torn_tail(tmp_path):
+    """A crash mid-write leaves a torn final line; resume must rewrite it
+    instead of counting it as done."""
+    paths = manifest_paths()
+    out = tmp_path / "results.jsonl"
+    BatchProject(paths, batch_size=4).run(str(out))
+    full = out.read_text()
+    n = len(full.splitlines())
+
+    # simulate a crash: chop the last record in half (no trailing newline)
+    torn = full[: full.rindex('{"path"') + 20]
+    out.write_text(torn)
+
+    BatchProject(paths, batch_size=4).run(str(out), resume=True)
+    lines = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(lines) == n  # every row parses, torn row rewritten
+
+
+def test_unreadable_path_marked_as_read_error(tmp_path):
+    paths = [fixture_path("mit/LICENSE.txt"), str(tmp_path / "does-not-exist")]
+    out = tmp_path / "results.jsonl"
+    project = BatchProject(paths, batch_size=4)
+    stats = project.run(str(out))
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert rows[0]["key"] == "mit"
+    assert "error" not in rows[0]
+    assert rows[1]["key"] is None
+    assert rows[1]["error"] == "read_error"
+    assert stats.read_errors == 1
+    # stats are internally consistent: categories + read errors == total
+    counted = (
+        stats.prefiltered_copyright
+        + stats.prefiltered_exact
+        + stats.dice_matched
+        + stats.unmatched
+    )
+    assert counted + stats.read_errors == stats.total
+
+
+def test_resume_stats_count_only_new_rows(tmp_path):
+    paths = manifest_paths()
+    out = tmp_path / "results.jsonl"
+    BatchProject(paths, batch_size=4).run(str(out))
+
+    # remove the last two completed rows, then resume with a new project
+    lines = out.read_text().splitlines()
+    out.write_text("\n".join(lines[:-2]) + "\n")
+    project = BatchProject(paths, batch_size=4)
+    stats = project.run(str(out), resume=True)
+    assert stats.total == 2
+    assert len(out.read_text().splitlines()) == len(paths)

@@ -67,3 +67,16 @@ def test_sharded_matmul_agrees(features, reference_result):
     sharded = shard_batch(mesh, bits, n_words, lengths, cc_fp)
     result = scorer(*sharded)
     _assert_matches_reference(result, reference_result)
+
+
+def test_sharded_scorer_rejects_unknown_method(features):
+    import pytest
+
+    from licensee_tpu.corpus.compiler import default_corpus
+    from licensee_tpu.kernels.dice_xla import CorpusArrays
+    from licensee_tpu.parallel.mesh import build_mesh, make_sharded_scorer
+
+    arrays = CorpusArrays.from_compiled(default_corpus())
+    mesh = build_mesh(n_data=2, n_model=2)
+    with pytest.raises(ValueError, match="unknown scoring method"):
+        make_sharded_scorer(arrays, mesh, method="bogus")
