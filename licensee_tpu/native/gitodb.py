@@ -1,0 +1,157 @@
+"""ctypes bindings for the native git ODB reader (native/gitodb.cpp).
+
+The shared library is built on demand with the system toolchain (g++ +
+zlib, both baked into the image) and cached next to this module; a stale
+cache (older than the source) is rebuilt.  If the toolchain or build is
+unavailable the caller falls back to git plumbing subprocesses
+(projects/git_project.py), so importing this module must never hard-fail.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "gitodb.cpp",
+)
+_LIB = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_gitodb.so")
+
+_build_lock = threading.Lock()
+_lib = None
+_lib_error: str | None = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", _LIB + ".tmp", _SRC, "-lz",
+    ]
+    result = subprocess.run(cmd, capture_output=True, text=True)
+    if result.returncode != 0:
+        raise NativeUnavailable(f"gitodb build failed: {result.stderr[:500]}")
+    os.replace(_LIB + ".tmp", _LIB)
+
+
+def _load():
+    global _lib, _lib_error
+    if _lib is not None:
+        return _lib
+    if _lib_error is not None:
+        raise NativeUnavailable(_lib_error)
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if os.environ.get("LICENSEE_TPU_NO_NATIVE"):
+                raise NativeUnavailable("disabled by LICENSEE_TPU_NO_NATIVE")
+            if not os.path.exists(_SRC):
+                raise NativeUnavailable(f"missing source {_SRC}")
+            if (
+                not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+        except NativeUnavailable as exc:
+            _lib_error = str(exc)
+            raise
+        except OSError as exc:
+            _lib_error = f"gitodb load failed: {exc}"
+            raise NativeUnavailable(_lib_error) from exc
+
+        lib.godb_last_error.restype = ctypes.c_char_p
+        lib.godb_open.restype = ctypes.c_void_p
+        lib.godb_open.argtypes = [ctypes.c_char_p]
+        lib.godb_close.argtypes = [ctypes.c_void_p]
+        lib.godb_resolve.restype = ctypes.c_int
+        lib.godb_resolve.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.godb_root_entries.restype = ctypes.c_void_p
+        lib.godb_root_entries.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.godb_read_blob.restype = ctypes.c_void_p
+        lib.godb_read_blob.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.godb_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class GitODBError(ValueError):
+    pass
+
+
+class GitODB:
+    """A repository handle over the native object-database reader."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        self._lib = lib
+        self._handle = lib.godb_open(os.fsencode(path))
+        if not self._handle:
+            raise GitODBError(lib.godb_last_error().decode("utf-8", "replace"))
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.godb_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _error(self) -> str:
+        return self._lib.godb_last_error().decode("utf-8", "replace")
+
+    def resolve(self, revision: str | None = None) -> str:
+        out = ctypes.create_string_buffer(41)
+        rc = self._lib.godb_resolve(
+            self._handle, (revision or "HEAD").encode("utf-8"), out
+        )
+        if rc != 0:
+            raise GitODBError(self._error())
+        return out.value.decode("ascii")
+
+    def root_entries(self, commit_sha: str) -> list[dict]:
+        """Root-tree entries: [{'mode', 'oid', 'type', 'name'}, ...]."""
+        ptr = self._lib.godb_root_entries(
+            self._handle, commit_sha.encode("ascii")
+        )
+        if not ptr:
+            raise GitODBError(self._error())
+        try:
+            text = ctypes.string_at(ptr).decode("utf-8", "replace")
+        finally:
+            self._lib.godb_free(ptr)
+        entries = []
+        for line in text.splitlines():
+            mode, oid, otype, name = line.split(" ", 3)
+            entries.append(
+                {"mode": mode, "oid": oid, "type": otype, "name": name}
+            )
+        return entries
+
+    def read_blob(self, sha: str, max_len: int = 64 * 1024) -> bytes:
+        n = ctypes.c_size_t()
+        ptr = self._lib.godb_read_blob(
+            self._handle, sha.encode("ascii"), max_len, ctypes.byref(n)
+        )
+        if not ptr:
+            raise GitODBError(self._error())
+        try:
+            return ctypes.string_at(ptr, n.value)
+        finally:
+            self._lib.godb_free(ptr)

@@ -2,10 +2,11 @@
 
 Parity target: `lib/licensee/projects/git_project.rb` (rugged/libgit2).
 This backend reads blobs straight from the git object database via the
-native ODB reader in `native/` when built (a C++ equivalent of the
-reference's libgit2 dependency), falling back to `git cat-file --batch`
-plumbing subprocesses otherwise.  Blob loads are capped at
-``MAX_LICENSE_SIZE`` bytes like the reference (git_project.rb:53).
+native C++ ODB reader (native/gitodb.cpp — loose objects, packfiles v2
+with deltas, ref resolution; the equivalent of the reference's libgit2
+dependency), falling back to `git` plumbing subprocesses when the native
+library can't be built.  Blob loads are capped at ``MAX_LICENSE_SIZE``
+bytes like the reference (git_project.rb:53).
 """
 
 from __future__ import annotations
@@ -33,20 +34,60 @@ def _run_git(repo: str, *args: str) -> bytes:
     return result.stdout
 
 
-class GitProject(Project):
-    def __init__(self, repo: str, revision: str | None = None, **args):
-        self.repo_path = repo
-        self.revision = revision
+class _NativeBackend:
+    """git_project.rb's rugged usage, over the native ODB reader."""
 
-        if not os.path.isdir(repo):
-            raise InvalidRepository(repo)
+    def __init__(self, repo: str, revision: str | None):
+        from licensee_tpu.native.gitodb import GitODB, GitODBError
+
+        try:
+            self._odb = GitODB(repo)
+            self._commit = self._odb.resolve(revision or "HEAD")
+        except GitODBError as exc:
+            raise InvalidRepository(str(exc)) from exc
+
+    def close(self) -> None:
+        self._odb.close()
+
+    def files(self) -> list[dict]:
+        from licensee_tpu.native.gitodb import GitODBError
+
+        try:
+            entries = self._odb.root_entries(self._commit)
+        except GitODBError as exc:
+            raise InvalidRepository(str(exc)) from exc
+        # symlinks (mode 120000) are blob-backed and count as blobs, matching
+        # rugged's entry typing and `git ls-tree` (both report them as blob)
+        return [
+            {"name": e["name"], "oid": e["oid"], "dir": "."}
+            for e in entries
+            if e["type"] in ("blob", "link")
+        ]
+
+    def load_file(self, file: dict) -> bytes:
+        from licensee_tpu.native.gitodb import GitODBError
+
+        try:
+            return self._odb.read_blob(file["oid"], MAX_LICENSE_SIZE)
+        except GitODBError as exc:
+            raise InvalidRepository(str(exc)) from exc
+
+
+class _SubprocessBackend:
+    """`git cat-file`/`ls-tree` plumbing fallback."""
+
+    def __init__(self, repo: str, revision: str | None):
+        self.repo = repo
+        self.revision = revision
         try:
             # resolves only inside an actual repository; unborn HEAD raises
             git_dir = _run_git(repo, "rev-parse", "--git-dir").strip()
             if not git_dir:
                 raise InvalidRepository(repo)
             # Reject repos found by upward discovery from a plain directory:
-            # the reference opens the path itself as a repository.
+            # the reference opens the path itself as a repository.  A .git
+            # *file* (gitlink: linked worktrees, submodules) is a repository
+            # at this path — libgit2 follows it, so we do too.
             absolute_git_dir = os.path.abspath(
                 os.path.join(repo, git_dir.decode("utf-8", errors="ignore"))
             )
@@ -54,35 +95,72 @@ class GitProject(Project):
             if not (
                 absolute_git_dir == repo_abs
                 or os.path.dirname(absolute_git_dir) == repo_abs
+                or os.path.isfile(os.path.join(repo, ".git"))
             ):
                 raise InvalidRepository(repo)
-            _run_git(repo, "rev-parse", "--verify", self.revision or "HEAD")
+            _run_git(repo, "rev-parse", "--verify", revision or "HEAD")
         except FileNotFoundError as exc:
             raise InvalidRepository(str(exc)) from exc
 
-        super().__init__(**args)
-
     def close(self) -> None:
         pass
+
+    def files(self) -> list[dict]:
+        rev = self.revision or "HEAD"
+        out = _run_git(self.repo, "ls-tree", rev)
+        files = []
+        for line in out.decode("utf-8", errors="ignore").splitlines():
+            if not line:
+                continue
+            meta, name = line.split("\t", 1)
+            _mode, otype, oid = meta.split()
+            if otype == "blob":
+                files.append({"name": name, "oid": oid, "dir": "."})
+        return files
+
+    def load_file(self, file: dict) -> bytes:
+        data = _run_git(self.repo, "cat-file", "blob", file["oid"])
+        return data[:MAX_LICENSE_SIZE]
+
+
+class GitProject(Project):
+    def __init__(self, repo: str, revision: str | None = None, **args):
+        self.repo_path = repo
+        self.revision = revision
+
+        if not os.path.isdir(repo):
+            raise InvalidRepository(repo)
+
+        self._backend = self._open_backend(repo, revision)
+        super().__init__(**args)
+
+    @staticmethod
+    def _open_backend(repo: str, revision: str | None):
+        from licensee_tpu.native.gitodb import NativeUnavailable
+
+        try:
+            backend = _NativeBackend(repo, revision)
+            # probe the root tree: a repo shape the native reader cannot
+            # fully serve (e.g. exotic layouts) falls back to plumbing
+            # instead of masquerading as an invalid repository
+            backend.files()
+            return backend
+        except NativeUnavailable:
+            return _SubprocessBackend(repo, revision)
+        except InvalidRepository:
+            return _SubprocessBackend(repo, revision)
+
+    def close(self) -> None:
+        self._backend.close()
 
     def files(self) -> list[dict]:
         """Root-tree blob entries of the target commit
         (git_project.rb:64-76: only type == :blob, root level)."""
         cached = self.__dict__.get("_files")
         if cached is None:
-            rev = self.revision or "HEAD"
-            out = _run_git(self.repo_path, "ls-tree", rev)
-            cached = []
-            for line in out.decode("utf-8", errors="ignore").splitlines():
-                if not line:
-                    continue
-                meta, name = line.split("\t", 1)
-                _mode, otype, oid = meta.split()
-                if otype == "blob":
-                    cached.append({"name": name, "oid": oid, "dir": "."})
+            cached = self._backend.files()
             self.__dict__["_files"] = cached
         return cached
 
     def load_file(self, file: dict) -> bytes:
-        data = _run_git(self.repo_path, "cat-file", "blob", file["oid"])
-        return data[:MAX_LICENSE_SIZE]
+        return self._backend.load_file(file)

@@ -1,0 +1,704 @@
+// gitodb: a minimal native git object-database reader.
+//
+// The reference (lib/licensee/projects/git_project.rb) reads blobs from a
+// repository without a checkout via rugged/libgit2 (C).  This is the
+// equivalent native capability for licensee_tpu, implemented directly
+// against the on-disk formats with only zlib as a dependency:
+//
+//   * loose objects   (.git/objects/xx/<38-hex>, zlib "type size\0data")
+//   * packfiles v2    (.git/objects/pack/*.{idx,pack}, incl. OFS_DELTA /
+//                      REF_DELTA chains and the large-offset table)
+//   * ref resolution  (HEAD symref chains, refs/heads, refs/tags,
+//                      packed-refs, full and unambiguous short SHAs,
+//                      annotated-tag peeling)
+//
+// Exposed as a small C ABI consumed from Python via ctypes
+// (licensee_tpu/native/gitodb.py).  Single-threaded by design.
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fstream>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace {
+
+constexpr int OBJ_COMMIT = 1;
+constexpr int OBJ_TREE = 2;
+constexpr int OBJ_BLOB = 3;
+constexpr int OBJ_TAG = 4;
+constexpr int OBJ_OFS_DELTA = 6;
+constexpr int OBJ_REF_DELTA = 7;
+
+std::string g_error;
+
+bool is_dir(const std::string &p) {
+  struct stat st;
+  return ::stat(p.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+bool is_file(const std::string &p) {
+  struct stat st;
+  return ::stat(p.c_str(), &st) == 0 && S_ISREG(st.st_mode);
+}
+
+bool read_file(const std::string &p, std::string *out) {
+  std::ifstream f(p, std::ios::binary);
+  if (!f) return false;
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+std::string trim(const std::string &s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+bool is_hex(const std::string &s) {
+  for (char c : s)
+    if (!std::isxdigit(static_cast<unsigned char>(c))) return false;
+  return !s.empty();
+}
+
+std::string hex_to_bin(const std::string &hex) {
+  std::string out;
+  out.reserve(hex.size() / 2);
+  for (size_t i = 0; i + 1 < hex.size(); i += 2) {
+    auto nib = [](char c) -> int {
+      if (c >= '0' && c <= '9') return c - '0';
+      if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+      if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+      return 0;
+    };
+    out.push_back(static_cast<char>((nib(hex[i]) << 4) | nib(hex[i + 1])));
+  }
+  return out;
+}
+
+std::string bin_to_hex(const unsigned char *bin, size_t n = 20) {
+  static const char *digits = "0123456789abcdef";
+  std::string out;
+  out.reserve(n * 2);
+  for (size_t i = 0; i < n; ++i) {
+    out.push_back(digits[bin[i] >> 4]);
+    out.push_back(digits[bin[i] & 15]);
+  }
+  return out;
+}
+
+// Inflate a whole zlib stream of unknown size (loose objects).
+bool inflate_all(const unsigned char *src, size_t src_len, std::string *out) {
+  z_stream zs{};
+  if (inflateInit(&zs) != Z_OK) return false;
+  zs.next_in = const_cast<unsigned char *>(src);
+  zs.avail_in = static_cast<uInt>(src_len);
+  std::vector<unsigned char> buf(64 * 1024);
+  int ret = Z_OK;
+  while (ret != Z_STREAM_END) {
+    zs.next_out = buf.data();
+    zs.avail_out = static_cast<uInt>(buf.size());
+    ret = inflate(&zs, Z_NO_FLUSH);
+    if (ret != Z_OK && ret != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return false;
+    }
+    out->append(reinterpret_cast<char *>(buf.data()),
+                buf.size() - zs.avail_out);
+  }
+  inflateEnd(&zs);
+  return true;
+}
+
+// Inflate exactly n_out bytes from a FILE* starting at file offset `at`.
+bool inflate_from(FILE *f, long at, size_t n_out, std::string *out) {
+  if (std::fseek(f, at, SEEK_SET) != 0) return false;
+  z_stream zs{};
+  if (inflateInit(&zs) != Z_OK) return false;
+  std::vector<unsigned char> in(64 * 1024);
+  out->resize(n_out);
+  zs.next_out = reinterpret_cast<unsigned char *>(&(*out)[0]);
+  zs.avail_out = static_cast<uInt>(n_out);
+  int ret = Z_OK;
+  while (zs.avail_out > 0 && ret != Z_STREAM_END) {
+    if (zs.avail_in == 0) {
+      size_t got = std::fread(in.data(), 1, in.size(), f);
+      if (got == 0) break;
+      zs.next_in = in.data();
+      zs.avail_in = static_cast<uInt>(got);
+    }
+    ret = inflate(&zs, Z_NO_FLUSH);
+    if (ret != Z_OK && ret != Z_STREAM_END) break;
+  }
+  bool ok = zs.avail_out == 0;
+  inflateEnd(&zs);
+  return ok;
+}
+
+uint32_t be32(const unsigned char *p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+uint64_t be64(const unsigned char *p) {
+  return (uint64_t(be32(p)) << 32) | be32(p + 4);
+}
+
+struct Pack {
+  std::string pack_path;
+  std::string idx;      // whole .idx file
+  size_t n = 0;
+  const unsigned char *fanout = nullptr;   // 256 * 4
+  const unsigned char *names = nullptr;    // n * 20
+  const unsigned char *offs = nullptr;     // n * 4
+  const unsigned char *large = nullptr;    // 8-byte entries
+  FILE *fp = nullptr;
+
+  ~Pack() {
+    if (fp) std::fclose(fp);
+  }
+
+  bool load(const std::string &idx_path, const std::string &pack) {
+    pack_path = pack;
+    if (!read_file(idx_path, &idx)) return false;
+    const auto *p = reinterpret_cast<const unsigned char *>(idx.data());
+    if (idx.size() < 8 + 256 * 4) return false;
+    if (!(p[0] == 0xff && p[1] == 0x74 && p[2] == 0x4f && p[3] == 0x63))
+      return false;                       // v1 idx unsupported (git >=1.6 writes v2)
+    if (be32(p + 4) != 2) return false;
+    fanout = p + 8;
+    n = be32(fanout + 255 * 4);
+    size_t need = 8 + 256 * 4 + n * 20 + n * 4 + n * 4;
+    if (idx.size() < need + 40) return false;
+    names = fanout + 256 * 4;
+    offs = names + n * 20 + n * 4;        // skip crc table
+    large = offs + n * 4;
+    return true;
+  }
+
+  // binary search; returns object index or -1
+  long find(const std::string &sha_bin) const {
+    const unsigned char *key =
+        reinterpret_cast<const unsigned char *>(sha_bin.data());
+    size_t first = key[0] ? be32(fanout + (key[0] - 1) * 4) : 0;
+    size_t last = be32(fanout + key[0] * 4);
+    while (first < last) {
+      size_t mid = (first + last) / 2;
+      int cmp = std::memcmp(names + mid * 20, key, 20);
+      if (cmp == 0) return static_cast<long>(mid);
+      if (cmp < 0)
+        first = mid + 1;
+      else
+        last = mid;
+    }
+    return -1;
+  }
+
+  uint64_t offset_of(size_t i) const {
+    uint32_t o = be32(offs + i * 4);
+    if (o & 0x80000000u) return be64(large + (o & 0x7fffffffu) * 8);
+    return o;
+  }
+
+  // prefix search for short SHAs: count matches, record one
+  int find_prefix(const std::string &prefix_bin, int odd_nibble,
+                  std::string *found) const {
+    const unsigned char *key =
+        reinterpret_cast<const unsigned char *>(prefix_bin.data());
+    size_t klen = prefix_bin.size();
+    int count = 0;
+    unsigned char b0 = klen ? key[0] : 0;
+    size_t first = b0 ? be32(fanout + (b0 - 1) * 4) : 0;
+    size_t last = be32(fanout + b0 * 4);
+    for (size_t i = first; i < last && count < 2; ++i) {
+      const unsigned char *cand = names + i * 20;
+      if (std::memcmp(cand, key, klen) != 0) continue;
+      if (odd_nibble >= 0 && (cand[klen] >> 4) != odd_nibble) continue;
+      ++count;
+      *found = bin_to_hex(cand);
+    }
+    return count;
+  }
+};
+
+bool apply_delta(const std::string &base, const std::string &delta,
+                 std::string *out);
+
+struct Repo {
+  std::string git_dir;      // per-worktree dir: HEAD lives here
+  std::string common_dir;   // shared dir: refs, packed-refs, objects
+  std::vector<std::string> object_dirs;  // objects + alternates, in order
+  std::vector<std::unique_ptr<Pack>> packs;
+  bool packs_loaded = false;
+
+  // objects/info/alternates: additional object stores (git clone --shared /
+  // --reference).  Recursion bounded like git's own limit.
+  void add_object_dir(const std::string &dir, int depth = 0) {
+    if (depth > 5 || !is_dir(dir)) return;
+    for (const auto &seen : object_dirs)
+      if (seen == dir) return;
+    object_dirs.push_back(dir);
+    std::string alt;
+    if (read_file(dir + "/info/alternates", &alt)) {
+      std::istringstream ss(alt);
+      std::string line;
+      while (std::getline(ss, line)) {
+        line = trim(line);
+        if (line.empty() || line[0] == '#') continue;
+        if (line[0] != '/') line = dir + "/" + line;  // relative to objects
+        add_object_dir(line, depth + 1);
+      }
+    }
+  }
+
+  void load_packs() {
+    if (packs_loaded) return;
+    packs_loaded = true;
+    for (const auto &objects : object_dirs) {
+      std::string pack_dir = objects + "/pack";
+      DIR *d = ::opendir(pack_dir.c_str());
+      if (!d) continue;
+      while (auto *ent = ::readdir(d)) {
+        std::string name = ent->d_name;
+        if (name.size() > 4 && name.substr(name.size() - 4) == ".idx") {
+          auto pk = std::make_unique<Pack>();
+          std::string base = name.substr(0, name.size() - 4);
+          if (pk->load(pack_dir + "/" + name, pack_dir + "/" + base + ".pack"))
+            packs.push_back(std::move(pk));
+        }
+      }
+      ::closedir(d);
+    }
+  }
+
+  bool read_pack_at(Pack &pk, uint64_t offset, int *type, std::string *data,
+                    int depth = 0);
+  bool read_object(const std::string &sha_hex, int *type, std::string *data);
+  bool resolve_name(const std::string &rev, std::string *sha);
+  bool ref_sha(const std::string &ref, std::string *sha);
+};
+
+bool Repo::read_pack_at(Pack &pk, uint64_t offset, int *type,
+                        std::string *data, int depth) {
+  if (depth > 64) {
+    g_error = "delta chain too deep";
+    return false;
+  }
+  if (!pk.fp) {
+    pk.fp = std::fopen(pk.pack_path.c_str(), "rb");
+    if (!pk.fp) {
+      g_error = "cannot open pack " + pk.pack_path;
+      return false;
+    }
+  }
+  if (std::fseek(pk.fp, static_cast<long>(offset), SEEK_SET) != 0) return false;
+  // entry header: 4-bit type, size in 4+7k bits
+  int c = std::fgetc(pk.fp);
+  if (c == EOF) return false;
+  int t = (c >> 4) & 7;
+  uint64_t size = c & 15;
+  int shift = 4;
+  while (c & 0x80) {
+    c = std::fgetc(pk.fp);
+    if (c == EOF) return false;
+    size |= uint64_t(c & 0x7f) << shift;
+    shift += 7;
+  }
+
+  if (t == OBJ_OFS_DELTA) {
+    c = std::fgetc(pk.fp);
+    if (c == EOF) return false;
+    uint64_t off = c & 0x7f;
+    while (c & 0x80) {
+      c = std::fgetc(pk.fp);
+      if (c == EOF) return false;
+      off = ((off + 1) << 7) | uint64_t(c & 0x7f);
+    }
+    long data_at = std::ftell(pk.fp);
+    int base_type;
+    std::string base;
+    if (!read_pack_at(pk, offset - off, &base_type, &base, depth + 1))
+      return false;
+    std::string delta;
+    if (!inflate_from(pk.fp, data_at, size, &delta)) return false;
+    *type = base_type;
+    return apply_delta(base, delta, data);
+  }
+  if (t == OBJ_REF_DELTA) {
+    unsigned char sha[20];
+    if (std::fread(sha, 1, 20, pk.fp) != 20) return false;
+    long data_at = std::ftell(pk.fp);
+    int base_type;
+    std::string base;
+    if (!read_object(bin_to_hex(sha), &base_type, &base)) return false;
+    std::string delta;
+    if (!inflate_from(pk.fp, data_at, size, &delta)) return false;
+    *type = base_type;
+    return apply_delta(base, delta, data);
+  }
+  if (t != OBJ_COMMIT && t != OBJ_TREE && t != OBJ_BLOB && t != OBJ_TAG) {
+    g_error = "unknown pack object type";
+    return false;
+  }
+  *type = t;
+  return inflate_from(pk.fp, std::ftell(pk.fp), size, data);
+}
+
+bool apply_delta(const std::string &base, const std::string &delta,
+                 std::string *out) {
+  const auto *d = reinterpret_cast<const unsigned char *>(delta.data());
+  size_t i = 0, n = delta.size();
+  auto varint = [&](uint64_t *v) -> bool {
+    *v = 0;
+    int shift = 0;
+    while (i < n) {
+      unsigned char c = d[i++];
+      *v |= uint64_t(c & 0x7f) << shift;
+      shift += 7;
+      if (!(c & 0x80)) return true;
+    }
+    return false;
+  };
+  uint64_t src_size, dst_size;
+  if (!varint(&src_size) || !varint(&dst_size)) return false;
+  if (src_size != base.size()) {
+    g_error = "delta base size mismatch";
+    return false;
+  }
+  out->clear();
+  out->reserve(dst_size);
+  while (i < n) {
+    unsigned char c = d[i++];
+    if (c & 0x80) {  // copy from base
+      // a truncated delta must not read past the buffer
+      int arg_bytes = __builtin_popcount(c & 0x7f);
+      if (i + static_cast<size_t>(arg_bytes) > n) {
+        g_error = "truncated delta copy opcode";
+        return false;
+      }
+      uint64_t off = 0, sz = 0;
+      if (c & 0x01) off |= uint64_t(d[i++]);
+      if (c & 0x02) off |= uint64_t(d[i++]) << 8;
+      if (c & 0x04) off |= uint64_t(d[i++]) << 16;
+      if (c & 0x08) off |= uint64_t(d[i++]) << 24;
+      if (c & 0x10) sz |= uint64_t(d[i++]);
+      if (c & 0x20) sz |= uint64_t(d[i++]) << 8;
+      if (c & 0x40) sz |= uint64_t(d[i++]) << 16;
+      if (sz == 0) sz = 0x10000;
+      if (off + sz > base.size()) {
+        g_error = "delta copy out of range";
+        return false;
+      }
+      out->append(base, off, sz);
+    } else if (c) {  // insert literal
+      if (i + c > n) return false;
+      out->append(delta, i, c);
+      i += c;
+    } else {
+      g_error = "reserved delta opcode";
+      return false;
+    }
+  }
+  return out->size() == dst_size;
+}
+
+bool Repo::read_object(const std::string &sha_hex, int *type,
+                       std::string *data) {
+  // loose first, across the object store and its alternates
+  std::string raw;
+  bool have_loose = false;
+  for (const auto &objects : object_dirs) {
+    std::string loose =
+        objects + "/" + sha_hex.substr(0, 2) + "/" + sha_hex.substr(2);
+    if (read_file(loose, &raw)) {
+      have_loose = true;
+      break;
+    }
+  }
+  if (have_loose) {
+    std::string all;
+    if (!inflate_all(reinterpret_cast<const unsigned char *>(raw.data()),
+                     raw.size(), &all)) {
+      g_error = "corrupt loose object " + sha_hex;
+      return false;
+    }
+    size_t nul = all.find('\0');
+    if (nul == std::string::npos) return false;
+    std::string header = all.substr(0, nul);
+    size_t sp = header.find(' ');
+    std::string tname = header.substr(0, sp);
+    if (tname == "commit") *type = OBJ_COMMIT;
+    else if (tname == "tree") *type = OBJ_TREE;
+    else if (tname == "blob") *type = OBJ_BLOB;
+    else if (tname == "tag") *type = OBJ_TAG;
+    else return false;
+    *data = all.substr(nul + 1);
+    return true;
+  }
+
+  load_packs();
+  std::string bin = hex_to_bin(sha_hex);
+  for (auto &pk : packs) {
+    long idx = pk->find(bin);
+    if (idx >= 0)
+      return read_pack_at(*pk, pk->offset_of(static_cast<size_t>(idx)), type,
+                          data);
+  }
+  g_error = "object not found: " + sha_hex;
+  return false;
+}
+
+bool Repo::ref_sha(const std::string &ref, std::string *sha) {
+  // HEAD (and other per-worktree refs) live in git_dir; shared refs and
+  // packed-refs live in common_dir
+  std::string content;
+  bool found = read_file(git_dir + "/" + ref, &content);
+  if (!found && common_dir != git_dir)
+    found = read_file(common_dir + "/" + ref, &content);
+  if (found) {
+    content = trim(content);
+    if (content.rfind("ref: ", 0) == 0)
+      return ref_sha(content.substr(5), sha);
+    if (content.size() == 40 && is_hex(content)) {
+      *sha = content;
+      return true;
+    }
+    return false;
+  }
+  // packed-refs
+  std::string packed;
+  if (read_file(common_dir + "/packed-refs", &packed)) {
+    std::istringstream ss(packed);
+    std::string line;
+    while (std::getline(ss, line)) {
+      if (line.empty() || line[0] == '#' || line[0] == '^') continue;
+      size_t sp = line.find(' ');
+      if (sp == 40 && line.substr(41) == ref) {
+        *sha = line.substr(0, 40);
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+bool Repo::resolve_name(const std::string &rev_in, std::string *sha) {
+  std::string rev = trim(rev_in.empty() ? "HEAD" : rev_in);
+
+  std::string candidate;
+  if (rev.size() == 40 && is_hex(rev)) {
+    candidate = rev;
+  } else if (rev.size() >= 4 && rev.size() < 40 && is_hex(rev)) {
+    // short SHA: must be unambiguous across loose dirs and pack indexes
+    std::string lower = rev;
+    std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
+    int count = 0;
+    std::string found;
+    std::string rest = lower.substr(2);
+    for (const auto &objects : object_dirs) {
+      std::string dir = objects + "/" + lower.substr(0, 2);
+      DIR *d = ::opendir(dir.c_str());
+      if (!d) continue;
+      while (auto *ent = ::readdir(d)) {
+        std::string name = ent->d_name;
+        if (name.size() == 38 && name.rfind(rest, 0) == 0) {
+          ++count;
+          found = lower.substr(0, 2) + name;
+        }
+      }
+      ::closedir(d);
+    }
+    load_packs();
+    std::string even = lower.substr(0, lower.size() & ~size_t(1));
+    int odd = (lower.size() % 2)
+                  ? std::stoi(lower.substr(lower.size() - 1), nullptr, 16)
+                  : -1;
+    for (auto &pk : packs) {
+      std::string f;
+      int c = pk->find_prefix(hex_to_bin(even), odd, &f);
+      count += c;
+      if (c) found = f;
+    }
+    if (count != 1) {
+      g_error = count ? "ambiguous short sha" : "unknown revision: " + rev;
+      return false;
+    }
+    candidate = found;
+  } else {
+    const char *prefixes[] = {"", "refs/", "refs/tags/", "refs/heads/",
+                              "refs/remotes/"};
+    bool ok = false;
+    for (const char *p : prefixes) {
+      if (ref_sha(std::string(p) + rev, &candidate)) {
+        ok = true;
+        break;
+      }
+    }
+    if (!ok) {
+      g_error = "unknown revision: " + rev;
+      return false;
+    }
+  }
+
+  // peel annotated tags to commits (rev-parse behavior for tree walks)
+  for (int i = 0; i < 8; ++i) {
+    int type;
+    std::string data;
+    if (!read_object(candidate, &type, &data)) return false;
+    if (type != OBJ_TAG) break;
+    size_t pos = data.find("object ");
+    if (pos != 0) return false;
+    candidate = data.substr(7, 40);
+  }
+  *sha = candidate;
+  return true;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------- C ABI --
+
+extern "C" {
+
+const char *godb_last_error() { return g_error.c_str(); }
+
+void *godb_open(const char *path) {
+  g_error.clear();
+  std::string p = path ? path : "";
+  std::string git_dir;
+  if (is_dir(p + "/.git")) {
+    git_dir = p + "/.git";
+  } else if (is_file(p + "/.git")) {
+    // worktree / submodule: .git is a file "gitdir: <path>"
+    std::string content;
+    read_file(p + "/.git", &content);
+    content = trim(content);
+    if (content.rfind("gitdir: ", 0) == 0) {
+      git_dir = content.substr(8);
+      if (!git_dir.empty() && git_dir[0] != '/') git_dir = p + "/" + git_dir;
+    }
+  } else if (is_dir(p + "/objects") && is_file(p + "/HEAD")) {
+    git_dir = p;  // bare repository
+  }
+  if (git_dir.empty()) {
+    g_error = "not a git repository: " + p;
+    return nullptr;
+  }
+  // linked worktree: gitdir points at .git/worktrees/<name>, which holds
+  // HEAD but shares objects/refs via its commondir file
+  std::string common_dir = git_dir;
+  std::string common;
+  if (read_file(git_dir + "/commondir", &common)) {
+    common = trim(common);
+    if (!common.empty()) {
+      if (common[0] != '/') common = git_dir + "/" + common;
+      common_dir = common;
+    }
+  }
+  if (!is_dir(common_dir + "/objects")) {
+    g_error = "not a git repository: " + p;
+    return nullptr;
+  }
+  auto *repo = new Repo();
+  repo->git_dir = git_dir;
+  repo->common_dir = common_dir;
+  repo->add_object_dir(common_dir + "/objects");
+  return repo;
+}
+
+void godb_close(void *handle) { delete static_cast<Repo *>(handle); }
+
+// Resolve a revision (name/sha/short sha) to a 40-hex commit sha.
+int godb_resolve(void *handle, const char *revision, char *out_sha41) {
+  g_error.clear();
+  auto *repo = static_cast<Repo *>(handle);
+  std::string sha;
+  if (!repo->resolve_name(revision ? revision : "HEAD", &sha)) return -1;
+  std::memcpy(out_sha41, sha.c_str(), 40);
+  out_sha41[40] = '\0';
+  return 0;
+}
+
+// Root-tree entries of a commit: returns a malloc'd buffer of lines
+// "<mode> <sha40> <type> <name>\n"; caller frees with godb_free.
+char *godb_root_entries(void *handle, const char *commit_sha) {
+  g_error.clear();
+  auto *repo = static_cast<Repo *>(handle);
+  int type;
+  std::string commit;
+  if (!repo->read_object(commit_sha, &type, &commit)) return nullptr;
+  if (type != OBJ_COMMIT) {
+    g_error = "not a commit";
+    return nullptr;
+  }
+  if (commit.rfind("tree ", 0) != 0) {
+    g_error = "malformed commit";
+    return nullptr;
+  }
+  std::string tree_sha = commit.substr(5, 40);
+  std::string tree;
+  if (!repo->read_object(tree_sha, &type, &tree) || type != OBJ_TREE) {
+    g_error = "missing tree " + tree_sha;
+    return nullptr;
+  }
+  // tree format: "<octal mode> <name>\0" + 20 raw sha bytes, repeated
+  std::string out;
+  size_t i = 0;
+  while (i < tree.size()) {
+    size_t sp = tree.find(' ', i);
+    size_t nul = tree.find('\0', sp);
+    if (sp == std::string::npos || nul == std::string::npos ||
+        nul + 20 > tree.size()) {
+      g_error = "malformed tree";
+      return nullptr;
+    }
+    std::string mode = tree.substr(i, sp - i);
+    std::string name = tree.substr(sp + 1, nul - sp - 1);
+    std::string sha = bin_to_hex(
+        reinterpret_cast<const unsigned char *>(tree.data()) + nul + 1);
+    const char *etype = (mode == "40000")    ? "tree"
+                        : (mode == "160000") ? "commit"  // submodule
+                        : (mode == "120000") ? "link"
+                                             : "blob";
+    out += mode + " " + sha + " " + etype + " " + name + "\n";
+    i = nul + 21;
+  }
+  char *buf = static_cast<char *>(std::malloc(out.size() + 1));
+  std::memcpy(buf, out.c_str(), out.size() + 1);
+  return buf;
+}
+
+// Read a blob, truncated to max_len.  Returns malloc'd data (free with
+// godb_free), sets *out_len; nullptr on error.
+unsigned char *godb_read_blob(void *handle, const char *sha, size_t max_len,
+                              size_t *out_len) {
+  g_error.clear();
+  auto *repo = static_cast<Repo *>(handle);
+  int type;
+  std::string data;
+  if (!repo->read_object(sha, &type, &data)) return nullptr;
+  if (type != OBJ_BLOB) {
+    g_error = "not a blob";
+    return nullptr;
+  }
+  size_t n = std::min(max_len, data.size());
+  auto *buf = static_cast<unsigned char *>(std::malloc(n ? n : 1));
+  std::memcpy(buf, data.data(), n);
+  *out_len = n;
+  return buf;
+}
+
+void godb_free(void *p) { std::free(p); }
+
+}  // extern "C"

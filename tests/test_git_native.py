@@ -1,0 +1,172 @@
+"""Native git ODB reader: parity with git plumbing on every repo shape the
+backend must handle (loose, packed+delta, annotated tags, bare, short SHA),
+plus backend equivalence inside GitProject."""
+
+import os
+import subprocess
+
+import pytest
+
+from licensee_tpu.projects.git_project import (
+    GitProject,
+    InvalidRepository,
+    _NativeBackend,
+    _SubprocessBackend,
+)
+from tests.conftest import fixture_path
+
+
+def git(repo, *args, binary=False):
+    out = subprocess.run(
+        ["git", "-C", repo, *args], capture_output=True, check=True
+    ).stdout
+    return out if binary else out.decode().strip()
+
+
+@pytest.fixture()
+def packed_repo(tmp_path):
+    """A repo with packed objects (incl. deltas), an annotated tag, a
+    branch, and loose objects layered on top of the pack."""
+    repo = str(tmp_path / "repo")
+    os.makedirs(repo)
+    run = lambda *a: subprocess.run(a, cwd=repo, check=True, capture_output=True)
+    run("git", "init", "-q")
+    run("git", "config", "user.email", "t@example.invalid")
+    run("git", "config", "user.name", "t")
+    run("git", "config", "commit.gpgsign", "false")
+    with open(os.path.join(repo, "LICENSE"), "w") as f:
+        f.write("MIT License\n" * 500)
+    run("git", "add", ".")
+    run("git", "commit", "-qm", "one")
+    with open(os.path.join(repo, "LICENSE"), "w") as f:
+        f.write("MIT License\n" * 500 + "changed\n")
+    run("git", "add", ".")
+    run("git", "commit", "-qm", "two")
+    run("git", "tag", "-a", "v1", "-m", "tag")
+    run("git", "repack", "-adq")
+    with open(os.path.join(repo, "README.md"), "w") as f:
+        f.write("readme\n")
+    run("git", "add", ".")
+    run("git", "commit", "-qm", "three")
+    return repo
+
+
+def test_native_matches_plumbing(packed_repo):
+    native = _NativeBackend(packed_repo, None)
+    sub = _SubprocessBackend(packed_repo, None)
+    assert native.files() == sub.files()
+    for f in native.files():
+        assert native.load_file(f) == sub.load_file(f)
+    native.close()
+
+
+@pytest.mark.parametrize("rev", ["HEAD", "v1"])
+def test_native_revisions(packed_repo, rev):
+    native = _NativeBackend(packed_repo, rev)
+    sub = _SubprocessBackend(packed_repo, rev)
+    assert native.files() == sub.files()
+    native.close()
+
+
+def test_native_short_sha_revision(packed_repo):
+    short = git(packed_repo, "rev-parse", "--short", "HEAD")
+    native = _NativeBackend(packed_repo, short)
+    assert {f["name"] for f in native.files()} == {"LICENSE", "README.md"}
+    native.close()
+
+
+def test_native_bare_repo(packed_repo, tmp_path):
+    bare = str(tmp_path / "bare.git")
+    subprocess.run(
+        ["git", "clone", "-q", "--bare", packed_repo, bare],
+        check=True, capture_output=True,
+    )
+    native = _NativeBackend(bare, None)
+    assert {f["name"] for f in native.files()} == {"LICENSE", "README.md"}
+    native.close()
+
+
+def test_native_blob_cap(packed_repo):
+    with open(os.path.join(packed_repo, "BIG"), "wb") as f:
+        f.write(b"x" * (200 * 1024))
+    subprocess.run(["git", "add", "."], cwd=packed_repo, check=True,
+                   capture_output=True)
+    subprocess.run(["git", "commit", "-qm", "big"], cwd=packed_repo,
+                   check=True, capture_output=True)
+    native = _NativeBackend(packed_repo, None)
+    big = [f for f in native.files() if f["name"] == "BIG"][0]
+    assert len(native.load_file(big)) == 64 * 1024  # MAX_LICENSE_SIZE cap
+    native.close()
+
+
+def test_native_rejects_non_repo(tmp_path):
+    with pytest.raises(InvalidRepository):
+        _NativeBackend(str(tmp_path), None)
+
+
+def test_native_rejects_unknown_revision(packed_repo):
+    with pytest.raises(InvalidRepository):
+        _NativeBackend(packed_repo, "no-such-branch")
+
+
+def test_git_project_uses_native_backend(git_fixture):
+    repo = git_fixture("mit")
+    project = GitProject(repo)
+    assert isinstance(project._backend, _NativeBackend)
+    assert project.license is not None and project.license.key == "mit"
+    project.close()
+
+
+def test_git_project_detection_parity_both_backends(git_fixture):
+    repo = git_fixture("bsd-2-author")
+    native = GitProject(repo)
+    key_native = native.license.key if native.license else None
+    native.close()
+
+    class _Forced(GitProject):
+        @staticmethod
+        def _open_backend(repo, revision):
+            return _SubprocessBackend(repo, revision)
+
+    sub = _Forced(repo)
+    key_sub = sub.license.key if sub.license else None
+    assert key_native == key_sub == "bsd-2-clause"
+
+
+def test_native_linked_worktree(packed_repo, tmp_path):
+    wt = str(tmp_path / "wt")
+    subprocess.run(
+        ["git", "worktree", "add", "-q", wt, "HEAD"],
+        cwd=packed_repo, check=True, capture_output=True,
+    )
+    native = _NativeBackend(wt, None)
+    sub = _SubprocessBackend(wt, None)
+    assert native.files() == sub.files()
+    native.close()
+
+
+def test_native_shared_clone_alternates(packed_repo, tmp_path):
+    clone = str(tmp_path / "shared")
+    subprocess.run(
+        ["git", "clone", "-q", "--shared", packed_repo, clone],
+        check=True, capture_output=True,
+    )
+    native = _NativeBackend(clone, None)
+    sub = _SubprocessBackend(clone, None)
+    assert native.files() == sub.files()
+    for f in native.files():
+        assert native.load_file(f) == sub.load_file(f)
+    native.close()
+
+
+def test_native_symlink_entry_counts_as_blob(packed_repo):
+    os.symlink("LICENSE", os.path.join(packed_repo, "COPYING"))
+    subprocess.run(["git", "add", "."], cwd=packed_repo, check=True,
+                   capture_output=True)
+    subprocess.run(["git", "commit", "-qm", "symlink"], cwd=packed_repo,
+                   check=True, capture_output=True)
+    native = _NativeBackend(packed_repo, None)
+    sub = _SubprocessBackend(packed_repo, None)
+    assert native.files() == sub.files()
+    assert "COPYING" in {f["name"] for f in native.files()}
+    native.close()
