@@ -1,0 +1,56 @@
+"""Build-on-demand for the native components (g++ + system libs only).
+
+Shared by gitodb.py and textops.py: compile ``native/<name>.cpp`` into a
+cached ``_<name>.so`` next to the bindings, rebuilding when the source is
+newer.  Never hard-fails at import — callers catch NativeUnavailable and
+fall back to pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_NATIVE_SRC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_DIR = os.path.dirname(os.path.abspath(__file__))
+
+_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def build_and_load(name: str, extra_libs: tuple[str, ...] = ()):
+    """Compile native/<name>.cpp -> _<name>.so (cached) and ctypes-load it."""
+    import ctypes
+
+    if os.environ.get("LICENSEE_TPU_NO_NATIVE"):
+        raise NativeUnavailable("disabled by LICENSEE_TPU_NO_NATIVE")
+    src = os.path.join(_NATIVE_SRC_DIR, f"{name}.cpp")
+    lib = os.path.join(_LIB_DIR, f"_{name}.so")
+    if not os.path.exists(src):
+        raise NativeUnavailable(f"missing source {src}")
+    with _lock:
+        if (
+            not os.path.exists(lib)
+            or os.path.getmtime(lib) < os.path.getmtime(src)
+        ):
+            cmd = [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                "-o", lib + ".tmp", src, *[f"-l{l}" for l in extra_libs],
+            ]
+            result = subprocess.run(cmd, capture_output=True, text=True)
+            if result.returncode != 0:
+                raise NativeUnavailable(
+                    f"{name} build failed: {result.stderr[:500]}"
+                )
+            os.replace(lib + ".tmp", lib)
+        try:
+            return ctypes.CDLL(lib)
+        except OSError as exc:
+            raise NativeUnavailable(f"{name} load failed: {exc}") from exc

@@ -11,34 +11,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
-_SRC = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-    "gitodb.cpp",
-)
-_LIB = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_gitodb.so")
+from licensee_tpu.native.build import NativeUnavailable, build_and_load
 
-_build_lock = threading.Lock()
 _lib = None
 _lib_error: str | None = None
-
-
-class NativeUnavailable(RuntimeError):
-    pass
-
-
-def _build() -> None:
-    cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", _LIB + ".tmp", _SRC, "-lz",
-    ]
-    result = subprocess.run(cmd, capture_output=True, text=True)
-    if result.returncode != 0:
-        raise NativeUnavailable(f"gitodb build failed: {result.stderr[:500]}")
-    os.replace(_LIB + ".tmp", _LIB)
 
 
 def _load():
@@ -47,44 +24,31 @@ def _load():
         return _lib
     if _lib_error is not None:
         raise NativeUnavailable(_lib_error)
-    with _build_lock:
-        if _lib is not None:
-            return _lib
-        try:
-            if os.environ.get("LICENSEE_TPU_NO_NATIVE"):
-                raise NativeUnavailable("disabled by LICENSEE_TPU_NO_NATIVE")
-            if not os.path.exists(_SRC):
-                raise NativeUnavailable(f"missing source {_SRC}")
-            if (
-                not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-            ):
-                _build()
-            lib = ctypes.CDLL(_LIB)
-        except NativeUnavailable as exc:
-            _lib_error = str(exc)
-            raise
-        except OSError as exc:
-            _lib_error = f"gitodb load failed: {exc}"
-            raise NativeUnavailable(_lib_error) from exc
+    try:
+        lib = build_and_load("gitodb", ("z",))
+    except NativeUnavailable as exc:
+        _lib_error = str(exc)
+        raise
 
-        lib.godb_last_error.restype = ctypes.c_char_p
-        lib.godb_open.restype = ctypes.c_void_p
-        lib.godb_open.argtypes = [ctypes.c_char_p]
-        lib.godb_close.argtypes = [ctypes.c_void_p]
-        lib.godb_resolve.restype = ctypes.c_int
-        lib.godb_resolve.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-        ]
-        lib.godb_root_entries.restype = ctypes.c_void_p
-        lib.godb_root_entries.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.godb_read_blob.restype = ctypes.c_void_p
-        lib.godb_read_blob.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_size_t),
-        ]
-        lib.godb_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
+    lib.godb_last_error.restype = ctypes.c_char_p
+    lib.godb_open.restype = ctypes.c_void_p
+    lib.godb_open.argtypes = [ctypes.c_char_p]
+    lib.godb_close.argtypes = [ctypes.c_void_p]
+    lib.godb_resolve.restype = ctypes.c_int
+    lib.godb_resolve.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.godb_root_entries.restype = ctypes.c_void_p
+    lib.godb_root_entries.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.godb_read_blob.restype = ctypes.c_void_p
+    lib.godb_read_blob.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.godb_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
     return _lib
 
 
@@ -126,19 +90,25 @@ class GitODB:
         return out.value.decode("ascii")
 
     def root_entries(self, commit_sha: str) -> list[dict]:
-        """Root-tree entries: [{'mode', 'oid', 'type', 'name'}, ...]."""
+        """Root-tree entries: [{'mode', 'oid', 'type', 'name'}, ...].
+
+        Records are NUL-separated (git forbids NUL in tree entry names but
+        permits newlines, so '\\0' is the only safe delimiter)."""
+        n = ctypes.c_size_t()
         ptr = self._lib.godb_root_entries(
-            self._handle, commit_sha.encode("ascii")
+            self._handle, commit_sha.encode("ascii"), ctypes.byref(n)
         )
         if not ptr:
             raise GitODBError(self._error())
         try:
-            text = ctypes.string_at(ptr).decode("utf-8", "replace")
+            text = ctypes.string_at(ptr, n.value).decode("utf-8", "replace")
         finally:
             self._lib.godb_free(ptr)
         entries = []
-        for line in text.splitlines():
-            mode, oid, otype, name = line.split(" ", 3)
+        for record in text.split("\0"):
+            if not record:
+                continue
+            mode, oid, otype, name = record.split(" ", 3)
             entries.append(
                 {"mode": mode, "oid": oid, "type": otype, "name": name}
             )

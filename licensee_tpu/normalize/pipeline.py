@@ -154,10 +154,24 @@ def _get_title_regex():
     return global_title_regex()
 
 
+def _native():
+    """The native textops scanners (or None) — bit-identical C++ twins of
+    the hottest passes; the import is deferred to break the cycle with
+    VARIETAL_WORDS, and textops.load() memoizes itself."""
+    from licensee_tpu.native import textops
+
+    return textops.load()
+
+
 def _plain_strip(content: str, regex: re.Pattern) -> str:
     """Ruby ContentHelper#strip: gsub(regex, ' ').squeeze(' ').strip —
     the squeeze and strip apply even when the regex does not match."""
-    return ruby_strip(squeeze_spaces(regex.sub(lambda _m: " ", content)))
+    nat = _native()
+    if nat is not None:
+        if regex is REGEXES["whitespace"]:
+            return nat.strip_whitespace(content)
+        return nat.squeeze_strip(regex.sub(" ", content))
+    return ruby_strip(squeeze_spaces(regex.sub(" ", content)))
 
 
 class NormalizedContent:
@@ -174,7 +188,15 @@ class NormalizedContent:
         cached = self.__dict__.get("_wordset")
         if cached is None:
             cn = self.content_normalized()
-            cached = frozenset(WORDSET_TOKEN.findall(cn)) if cn is not None else None
+            if cn is None:
+                cached = None
+            else:
+                nat = _native()
+                cached = (
+                    nat.wordset(cn)
+                    if nat is not None
+                    else frozenset(WORDSET_TOKEN.findall(cn))
+                )
             self.__dict__["_wordset"] = cached
         return cached
 
@@ -232,14 +254,23 @@ class NormalizedContent:
         if cached is None:
             c = self.content_without_title_and_version.lower()
 
-            # normalizations (gsub only — no squeeze/strip side effects)
+            # normalizations (gsub only — no squeeze/strip side effects);
+            # the dash/quote/hyphenation/spelling passes run as native
+            # scanners when built (bit-identical, tests/test_textops.py)
+            nat = _native()
             c = _LISTS.sub(lambda m: "- " + m.group(1), c)
-            c = _HTTP.sub(lambda _m: "https:", c)
+            c = _HTTP.sub("https:", c)
             c = c.replace("&", "and")
-            c = _DASHES.sub(lambda _m: "-", c)
-            c = _QUOTES.sub(lambda _m: "'", c)
-            c = _HYPHENATED.sub(lambda m: m.group(1) + "-" + m.group(2), c)
-            c = _SPELLING.sub(lambda m: VARIETAL_WORDS[m.group(0)], c)
+            if nat is not None:
+                c = nat.dashes(c)
+                c = nat.quotes(c)
+                c = nat.hyphenated(c)
+                c = nat.spelling(c)
+            else:
+                c = _DASHES.sub("-", c)
+                c = _QUOTES.sub("'", c)
+                c = _HYPHENATED.sub(lambda m: m.group(1) + "-" + m.group(2), c)
+                c = _SPELLING.sub(lambda m: VARIETAL_WORDS[m.group(0)], c)
             c = REGEXES["span_markup"].sub(lambda m: m.group(1), c)
             c = REGEXES["bullet"].sub(lambda _m: "\n\n- ", c)
             c = _BULLET_JOIN.sub(lambda _m: ")(", c)

@@ -40,6 +40,7 @@ class _NativeBackend:
     def __init__(self, repo: str, revision: str | None):
         from licensee_tpu.native.gitodb import GitODB, GitODBError
 
+        self._files: list[dict] | None = None
         try:
             self._odb = GitODB(repo)
             self._commit = self._odb.resolve(revision or "HEAD")
@@ -50,19 +51,22 @@ class _NativeBackend:
         self._odb.close()
 
     def files(self) -> list[dict]:
-        from licensee_tpu.native.gitodb import GitODBError
+        if self._files is None:
+            from licensee_tpu.native.gitodb import GitODBError
 
-        try:
-            entries = self._odb.root_entries(self._commit)
-        except GitODBError as exc:
-            raise InvalidRepository(str(exc)) from exc
-        # symlinks (mode 120000) are blob-backed and count as blobs, matching
-        # rugged's entry typing and `git ls-tree` (both report them as blob)
-        return [
-            {"name": e["name"], "oid": e["oid"], "dir": "."}
-            for e in entries
-            if e["type"] in ("blob", "link")
-        ]
+            try:
+                entries = self._odb.root_entries(self._commit)
+            except (GitODBError, ValueError) as exc:
+                raise InvalidRepository(str(exc)) from exc
+            # symlinks (mode 120000) are blob-backed and count as blobs,
+            # matching rugged's entry typing and `git ls-tree` (both report
+            # them as blob)
+            self._files = [
+                {"name": e["name"], "oid": e["oid"], "dir": "."}
+                for e in entries
+                if e["type"] in ("blob", "link")
+            ]
+        return self._files
 
     def load_file(self, file: dict) -> bytes:
         from licensee_tpu.native.gitodb import GitODBError

@@ -24,6 +24,7 @@
 #include <dirent.h>
 #include <fstream>
 #include <memory>
+#include <set>
 #include <sstream>
 #include <string>
 #include <sys/stat.h>
@@ -211,24 +212,22 @@ struct Pack {
     return o;
   }
 
-  // prefix search for short SHAs: count matches, record one
-  int find_prefix(const std::string &prefix_bin, int odd_nibble,
-                  std::string *found) const {
+  // prefix search for short SHAs: collect every matching full SHA (the
+  // caller dedupes across loose/pack/alternate stores)
+  void find_prefix(const std::string &prefix_bin, int odd_nibble,
+                   std::set<std::string> *out) const {
     const unsigned char *key =
         reinterpret_cast<const unsigned char *>(prefix_bin.data());
     size_t klen = prefix_bin.size();
-    int count = 0;
     unsigned char b0 = klen ? key[0] : 0;
     size_t first = b0 ? be32(fanout + (b0 - 1) * 4) : 0;
     size_t last = be32(fanout + b0 * 4);
-    for (size_t i = first; i < last && count < 2; ++i) {
+    for (size_t i = first; i < last; ++i) {
       const unsigned char *cand = names + i * 20;
       if (std::memcmp(cand, key, klen) != 0) continue;
       if (odd_nibble >= 0 && (cand[klen] >> 4) != odd_nibble) continue;
-      ++count;
-      *found = bin_to_hex(cand);
+      out->insert(bin_to_hex(cand));
     }
-    return count;
   }
 };
 
@@ -503,8 +502,9 @@ bool Repo::resolve_name(const std::string &rev_in, std::string *sha) {
     // short SHA: must be unambiguous across loose dirs and pack indexes
     std::string lower = rev;
     std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
-    int count = 0;
-    std::string found;
+    // dedupe by full SHA: the same object may be loose AND packed (or in
+    // several packs / alternates) without being ambiguous
+    std::set<std::string> matches;
     std::string rest = lower.substr(2);
     for (const auto &objects : object_dirs) {
       std::string dir = objects + "/" + lower.substr(0, 2);
@@ -512,10 +512,8 @@ bool Repo::resolve_name(const std::string &rev_in, std::string *sha) {
       if (!d) continue;
       while (auto *ent = ::readdir(d)) {
         std::string name = ent->d_name;
-        if (name.size() == 38 && name.rfind(rest, 0) == 0) {
-          ++count;
-          found = lower.substr(0, 2) + name;
-        }
+        if (name.size() == 38 && name.rfind(rest, 0) == 0)
+          matches.insert(lower.substr(0, 2) + name);
       }
       ::closedir(d);
     }
@@ -524,17 +522,13 @@ bool Repo::resolve_name(const std::string &rev_in, std::string *sha) {
     int odd = (lower.size() % 2)
                   ? std::stoi(lower.substr(lower.size() - 1), nullptr, 16)
                   : -1;
-    for (auto &pk : packs) {
-      std::string f;
-      int c = pk->find_prefix(hex_to_bin(even), odd, &f);
-      count += c;
-      if (c) found = f;
-    }
-    if (count != 1) {
-      g_error = count ? "ambiguous short sha" : "unknown revision: " + rev;
+    for (auto &pk : packs) pk->find_prefix(hex_to_bin(even), odd, &matches);
+    if (matches.size() != 1) {
+      g_error = matches.empty() ? "unknown revision: " + rev
+                                : "ambiguous short sha";
       return false;
     }
-    candidate = found;
+    candidate = *matches.begin();
   } else {
     const char *prefixes[] = {"", "refs/", "refs/tags/", "refs/heads/",
                               "refs/remotes/"};
@@ -630,9 +624,12 @@ int godb_resolve(void *handle, const char *revision, char *out_sha41) {
   return 0;
 }
 
-// Root-tree entries of a commit: returns a malloc'd buffer of lines
-// "<mode> <sha40> <type> <name>\n"; caller frees with godb_free.
-char *godb_root_entries(void *handle, const char *commit_sha) {
+// Root-tree entries of a commit: returns a malloc'd buffer of
+// NUL-terminated records "<mode> <sha40> <type> <name>" (git forbids NUL
+// in names but allows newlines, so '\0' is the only safe separator);
+// caller frees with godb_free.
+char *godb_root_entries(void *handle, const char *commit_sha,
+                        size_t *out_len) {
   g_error.clear();
   auto *repo = static_cast<Repo *>(handle);
   int type;
@@ -671,11 +668,13 @@ char *godb_root_entries(void *handle, const char *commit_sha) {
                         : (mode == "160000") ? "commit"  // submodule
                         : (mode == "120000") ? "link"
                                              : "blob";
-    out += mode + " " + sha + " " + etype + " " + name + "\n";
+    out += mode + " " + sha + " " + etype + " " + name;
+    out.push_back('\0');
     i = nul + 21;
   }
-  char *buf = static_cast<char *>(std::malloc(out.size() + 1));
-  std::memcpy(buf, out.c_str(), out.size() + 1);
+  char *buf = static_cast<char *>(std::malloc(out.size() ? out.size() : 1));
+  std::memcpy(buf, out.data(), out.size());
+  *out_len = out.size();
   return buf;
 }
 

@@ -7,6 +7,21 @@ import subprocess
 
 import pytest
 
+def _native_available() -> bool:
+    try:
+        from licensee_tpu.native import gitodb
+
+        gitodb._load()
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(),
+    reason="native gitodb unavailable (disabled or no toolchain)",
+)
+
 from licensee_tpu.projects.git_project import (
     GitProject,
     InvalidRepository,

@@ -1,0 +1,191 @@
+"""Differential tests: every native textops scanner must be bit-identical
+to its Python/Ruby-semantics regex twin on real license texts, adversarial
+edge cases, and random fuzz inputs."""
+
+import random
+import re
+
+import pytest
+
+from licensee_tpu.native import textops as native_textops
+from licensee_tpu.normalize import pipeline as P
+from licensee_tpu.rubytext import ruby_strip, squeeze_spaces
+
+
+ops = native_textops.load()
+pytestmark = pytest.mark.skipif(ops is None, reason="native textops unavailable")
+
+
+def py_squeeze_strip(s):
+    return ruby_strip(squeeze_spaces(s))
+
+
+def py_strip_whitespace(s):
+    return ruby_strip(squeeze_spaces(P.REGEXES["whitespace"].sub(" ", s)))
+
+
+def py_dashes(s):
+    return P._DASHES.sub("-", s)
+
+
+def py_quotes(s):
+    return P._QUOTES.sub("'", s)
+
+
+def py_hyphenated(s):
+    return P._HYPHENATED.sub(lambda m: m.group(1) + "-" + m.group(2), s)
+
+
+def py_spelling(s):
+    return P._SPELLING.sub(lambda m: P.VARIETAL_WORDS[m.group(0)], s)
+
+
+PAIRS = [
+    (py_squeeze_strip, lambda s: ops.squeeze_strip(s)),
+    (py_strip_whitespace, lambda s: ops.strip_whitespace(s)),
+    (py_dashes, lambda s: ops.dashes(s)),
+    (py_quotes, lambda s: ops.quotes(s)),
+    (py_hyphenated, lambda s: ops.hyphenated(s)),
+    (py_spelling, lambda s: ops.spelling(s)),
+]
+
+
+def check_all(s):
+    for py, nat in PAIRS:
+        assert py(s) == nat(s), (py.__name__, repr(s)[:120])
+
+
+EDGE_CASES = [
+    "",
+    " ",
+    "\n",
+    "\x00 padded \x00",
+    "a-b",
+    "a - b",
+    "a --- b",
+    "a---\nb",
+    "a-\nb",
+    "word-\n  next",
+    "word- \n \t next",
+    "word-\n\nnext",   # two newlines: \s* spans both
+    "-start",
+    "end-",
+    "\n-x",
+    "\n--x",
+    "\n---\n",
+    "--",
+    "—–-",
+    "a—b",
+    "a–\nb",
+    "a—\n",
+    "x''y",
+    "‘quoted’ “double”",
+    "`tick`",
+    "licence",
+    "LICENCE",          # spelling is case-sensitive on lowercased input
+    "sub-license sub license sublicense",
+    "favourite favour favours",
+    "per cent percent per  cent",
+    "copyright owner copyright  owner",
+    "xlicence licencex a_licence licence_b",
+    "judgment day",
+    "non-commercial use",
+    "practise makes practice",
+    "whilst wilful fulfil",
+    "organisation's organisational",
+    "centre—piece",
+    "  spaced   out  ",
+    "\t tab \t mix \n newline \v vtab \f feed \r cr ",
+    "a b",         # NBSP is NOT Ruby \s — must survive whitespace strip
+]
+
+
+@pytest.mark.parametrize("case", EDGE_CASES, ids=range(len(EDGE_CASES)))
+def test_edge_cases(case):
+    check_all(case)
+
+
+def test_all_vendored_templates():
+    from licensee_tpu.corpus.license import License
+
+    for lic in License.all(hidden=True, pseudo=False):
+        content = lic.content or ""
+        check_all(content)
+        check_all(content.lower())
+
+
+def test_all_fixture_files():
+    import os
+
+    from tests.conftest import FIXTURES_DIR
+
+    for name in sorted(os.listdir(FIXTURES_DIR)):
+        d = os.path.join(FIXTURES_DIR, name)
+        if not os.path.isdir(d):
+            continue
+        for fname in os.listdir(d):
+            full = os.path.join(d, fname)
+            if os.path.isfile(full):
+                with open(full, "rb") as f:
+                    text = f.read().decode("utf-8", errors="replace")
+                check_all(text)
+                check_all(text.lower())
+
+
+def test_fuzz_random():
+    rng = random.Random(1234)
+    alphabet = (
+        list("abcdefgz_09 \t\n\v\f\r-'\"`()")
+        + ["—", "–", "‘", "’", "“", "”", "é", " "]
+        + ["licence", "favour", "per cent", "sub license", "-\n", "--", " \n "]
+    )
+    for _ in range(400):
+        s = "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 120)))
+        check_all(s)
+
+
+def test_spelling_order_favour_vs_favourite():
+    # alternation order: 'favour' precedes 'favourite'; \b forces the
+    # longer match only when the shorter one fails the boundary
+    assert ops.spelling("favourite") == "favorite"
+    assert ops.spelling("favour") == "favor"
+    assert ops.spelling("favours") == py_spelling("favours")
+
+
+def py_wordset(s):
+    return frozenset(P.WORDSET_TOKEN.findall(s))
+
+
+WORDSET_CASES = [
+    "",
+    "hello world hello",
+    "it's the owner's copy",
+    "boys' own s' x' 'lone",
+    "a'sb s's' ss's x's'",
+    "semi/colon path/to-file -dash- /x/",
+    "under_score 0numbers9",
+    "mixé uniçode tökens",
+    "a-\nb c'd e''f",
+    "'' ' s'",
+]
+
+
+@pytest.mark.parametrize("case", WORDSET_CASES, ids=range(len(WORDSET_CASES)))
+def test_wordset_cases(case):
+    assert ops.wordset(case) == py_wordset(case), repr(case)
+
+
+def test_wordset_on_normalized_templates():
+    from licensee_tpu.corpus.license import License
+
+    for lic in License.all(hidden=True, pseudo=False):
+        cn = lic.content_normalized()
+        assert ops.wordset(cn) == py_wordset(cn), lic.key
+
+
+def test_wordset_fuzz():
+    rng = random.Random(99)
+    alphabet = list("abs'/_-09 \n\t") + ["é", "'s", "s'", "--", "//"]
+    for _ in range(500):
+        s = "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 80)))
+        assert ops.wordset(s) == py_wordset(s), repr(s)
