@@ -103,15 +103,58 @@ def bench_scalar_baseline(n_samples: int = 30) -> float:
     return len(contents) / elapsed
 
 
+def extend_templates(arrays, n_templates: int):
+    """Synthetically widen the template pool to `n_templates` rows (the
+    full-SPDX-scale config of BASELINE.md: ~600 templates) by perturbing
+    real template bitsets — same dtypes, realistic density, distinct rows —
+    so the device path is measured at target corpus width."""
+    import jax.numpy as jnp
+
+    from licensee_tpu.kernels.dice_xla import CorpusArrays
+
+    rng = np.random.default_rng(7)
+    T, W = arrays.bits.shape
+    reps = -(-n_templates // T)
+
+    def tile(a):
+        return np.concatenate([np.asarray(a)] * reps)[:n_templates]
+
+    bits = tile(arrays.bits).copy()
+    for t in range(T, n_templates):  # perturb the synthetic copies
+        lanes = rng.integers(0, W, size=8)
+        bits[t, lanes] ^= rng.integers(1, 2**32, size=8, dtype=np.uint64).astype(
+            np.uint32
+        )
+    n_wf = np.array(
+        [int(np.unpackbits(row.view(np.uint8)).sum()) for row in bits],
+        dtype=np.int32,
+    )
+    return CorpusArrays(
+        bits=jnp.asarray(bits),
+        n_wf=jnp.asarray(n_wf),
+        n_fieldset=jnp.asarray(tile(arrays.n_fieldset)),
+        field_count=jnp.asarray(tile(arrays.field_count)),
+        alt_count=jnp.asarray(tile(arrays.alt_count)),
+        length=jnp.asarray(tile(arrays.length)),
+        cc_flag=jnp.asarray(tile(arrays.cc_flag)),
+        valid=jnp.asarray(np.ones(n_templates, dtype=bool)),
+    )
+
+
 def main() -> None:
     # big batches amortize the per-dispatch latency floor of the TPU
-    # tunnel (~4 ms); 256k blobs puts the bench in the throughput regime
+    # tunnel (~4 ms); 256k blobs puts the bench in the throughput regime.
+    # argv: [n_blobs] [n_templates] — n_templates > 47 measures the
+    # full-SPDX-scale corpus width with synthetic template rows.
     n_blobs = int(sys.argv[1]) if len(sys.argv) > 1 else 262144
+    n_templates = int(sys.argv[2]) if len(sys.argv) > 2 else 0
     from licensee_tpu.corpus.compiler import default_corpus
     from licensee_tpu.kernels.dice_xla import CorpusArrays
 
     corpus = default_corpus()
     arrays = CorpusArrays.from_compiled(corpus)
+    if n_templates > corpus.n_templates:
+        arrays = extend_templates(arrays, n_templates)
     features = build_blob_features(corpus, n_blobs)
 
     rates = {}
@@ -134,7 +177,7 @@ def main() -> None:
         "vs_baseline": round(device_rate / scalar_rate, 1),
         "details": {
             "batch": n_blobs,
-            "templates": corpus.n_templates,
+            "templates": int(arrays.bits.shape[0]),
             "vocab": corpus.vocab_size,
             "method": best_method,
             "rates": {k: round(v, 1) for k, v in rates.items()},

@@ -229,8 +229,28 @@ def cmd_batch_detect(args) -> int:
     """Batch classification of a manifest of files via the TPU Dice kernel."""
     from licensee_tpu.kernels.batch import batch_detect_paths
 
-    paths = [line.strip() for line in open(args.manifest) if line.strip()]
-    results = batch_detect_paths(paths)
+    kwargs = {}
+    if args.corpus and args.corpus != "vendored":
+        from licensee_tpu.corpus.spdx import spdx_corpus
+
+        try:
+            corpus = spdx_corpus(None if args.corpus == "spdx" else args.corpus)
+        except OSError as exc:
+            print(f"error: cannot load corpus {args.corpus!r}: {exc}",
+                  file=sys.stderr)
+            return 1
+        if not corpus.n_templates:
+            print(f"error: no license templates found in {args.corpus!r}",
+                  file=sys.stderr)
+            return 1
+        kwargs["corpus"] = corpus
+    try:
+        with open(args.manifest, encoding="utf-8") as f:
+            paths = [line.strip() for line in f if line.strip()]
+    except OSError as exc:
+        print(f"error: cannot read manifest: {exc}", file=sys.stderr)
+        return 1
+    results = batch_detect_paths(paths, **kwargs)
     for path, result in zip(paths, results):
         print(json.dumps({"path": path, **result}))
     return 0
@@ -280,6 +300,15 @@ def build_parser() -> argparse.ArgumentParser:
         "batch-detect", help="Classify a manifest of files on the TPU batch path"
     )
     batch.add_argument("manifest", help="File with one path per line")
+    batch.add_argument(
+        "--corpus",
+        default="vendored",
+        help=(
+            "Template pool: 'vendored' (choosealicense, default), 'spdx' "
+            "(the vendored SPDX license-list XMLs), or a path to an SPDX "
+            "license-list-XML src/ directory (e.g. the full ~600-license set)"
+        ),
+    )
     batch.set_defaults(func=cmd_batch_detect)
 
     return parser

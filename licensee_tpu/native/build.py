@@ -40,16 +40,29 @@ def build_and_load(name: str, extra_libs: tuple[str, ...] = ()):
             not os.path.exists(lib)
             or os.path.getmtime(lib) < os.path.getmtime(src)
         ):
-            cmd = [
-                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                "-o", lib + ".tmp", src, *[f"-l{l}" for l in extra_libs],
-            ]
-            result = subprocess.run(cmd, capture_output=True, text=True)
-            if result.returncode != 0:
-                raise NativeUnavailable(
-                    f"{name} build failed: {result.stderr[:500]}"
-                )
-            os.replace(lib + ".tmp", lib)
+            # unique temp per process: concurrent builders must not
+            # interleave g++ output into the same file (os.replace of a
+            # complete .so is atomic either way)
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(
+                prefix=f"_{name}.", suffix=".so.tmp", dir=_LIB_DIR
+            )
+            os.close(fd)
+            try:
+                cmd = [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    "-o", tmp, src, *[f"-l{l}" for l in extra_libs],
+                ]
+                result = subprocess.run(cmd, capture_output=True, text=True)
+                if result.returncode != 0:
+                    raise NativeUnavailable(
+                        f"{name} build failed: {result.stderr[:500]}"
+                    )
+                os.replace(tmp, lib)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
         try:
             return ctypes.CDLL(lib)
         except OSError as exc:
