@@ -49,6 +49,10 @@ class CompiledCorpus:
     length: np.ndarray       # int32[T]
     cc_flag: np.ndarray      # bool[T]
     content_hashes: dict[str, str] = field(default_factory=dict)
+    # full (fields included) template wordsets keyed for the Exact matcher's
+    # set-equality test (matchers/exact.rb:6-13); first key wins on collision,
+    # matching the reference's first-match license order
+    exact_sets: dict[frozenset, str] = field(default_factory=dict)
 
     @property
     def n_templates(self) -> int:
@@ -96,6 +100,7 @@ class CompiledCorpus:
         length = np.zeros(T, dtype=np.int32)
         cc_flag = np.zeros(T, dtype=bool)
         hashes: dict[str, str] = {}
+        exact_sets: dict[frozenset, str] = {}
 
         for t, lic in enumerate(pool):
             ids = [vocab[w] for w in lic.wordset_fieldless]
@@ -107,6 +112,7 @@ class CompiledCorpus:
             length[t] = lic.length
             cc_flag[t] = getattr(lic, "creative_commons_q", False)
             hashes[lic.content_hash] = lic.key
+            exact_sets.setdefault(frozenset(lic.wordset), lic.key)
 
         return CompiledCorpus(
             keys=tuple(lic.key for lic in pool),
@@ -119,6 +125,7 @@ class CompiledCorpus:
             length=length,
             cc_flag=cc_flag,
             content_hashes=hashes,
+            exact_sets=exact_sets,
         )
 
 

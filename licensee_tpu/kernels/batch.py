@@ -77,13 +77,10 @@ class BatchClassifier:
         else:
             self._fn = make_best_match_fn(self.arrays, method=method)
         # Exact matcher pre-filter: full wordset (fields included) equality
-        # (matchers/exact.rb:6-13)
-        self._exact_map = {}
-        from licensee_tpu.corpus.license import License
-
-        for key in self.corpus.keys:
-            lic = License.find(key)
-            self._exact_map[frozenset(lic.wordset)] = key
+        # (matchers/exact.rb:6-13), against the corpus's OWN template
+        # renderings (not the vendored pool — custom SPDX corpora carry
+        # keys License.find doesn't know, and their rendering differs)
+        self._exact_map = self.corpus.exact_sets
 
     # -- host featureization --
 

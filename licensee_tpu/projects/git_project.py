@@ -41,14 +41,19 @@ class _NativeBackend:
         from licensee_tpu.native.gitodb import GitODB, GitODBError
 
         self._files: list[dict] | None = None
+        self._odb = None
         try:
             self._odb = GitODB(repo)
             self._commit = self._odb.resolve(revision or "HEAD")
         except GitODBError as exc:
+            # don't leave the native handle to the GC on the error path
+            self.close()
             raise InvalidRepository(str(exc)) from exc
 
     def close(self) -> None:
-        self._odb.close()
+        if self._odb is not None:
+            self._odb.close()
+            self._odb = None
 
     def files(self) -> list[dict]:
         if self._files is None:
@@ -142,6 +147,7 @@ class GitProject(Project):
     def _open_backend(repo: str, revision: str | None):
         from licensee_tpu.native.gitodb import NativeUnavailable
 
+        backend = None
         try:
             backend = _NativeBackend(repo, revision)
             # probe the root tree: a repo shape the native reader cannot
@@ -149,9 +155,9 @@ class GitProject(Project):
             # instead of masquerading as an invalid repository
             backend.files()
             return backend
-        except NativeUnavailable:
-            return _SubprocessBackend(repo, revision)
-        except InvalidRepository:
+        except (NativeUnavailable, InvalidRepository):
+            if backend is not None:
+                backend.close()
             return _SubprocessBackend(repo, revision)
 
     def close(self) -> None:

@@ -73,7 +73,13 @@ class GitHubProject(Project):
 
     def load_file(self, file: dict):
         body = self._request(file["path"], raw=True)
-        return body if body is not None else b""
+        if body is None:
+            # a listed file vanishing mid-detection is an API error, not an
+            # empty license (github_project.rb:48-53 lets octokit raise)
+            raise RepoNotFound(
+                f"Could not load {file['path']} from GitHub repo {self.repo}"
+            )
+        return body
 
     def _dir_files(self, path: str | None = None) -> list[dict]:
         if path:

@@ -180,11 +180,30 @@ struct Pack {
     if (be32(p + 4) != 2) return false;
     fanout = p + 8;
     n = be32(fanout + 255 * 4);
+    // fanout must be monotonic and bounded by n, or find()'s binary
+    // search walks past the names table on a corrupt idx
+    for (int i = 0; i < 256; ++i) {
+      uint32_t v = be32(fanout + i * 4);
+      if (v > n || (i && v < be32(fanout + (i - 1) * 4))) return false;
+    }
     size_t need = 8 + 256 * 4 + n * 20 + n * 4 + n * 4;
     if (idx.size() < need + 40) return false;
     names = fanout + 256 * 4;
     offs = names + n * 20 + n * 4;        // skip crc table
     large = offs + n * 4;
+    // bound the 8-byte large-offset table: a corrupt idx whose 4-byte
+    // entry has the MSB set must not send offset_of() out of bounds
+    size_t large_needed = 0;
+    for (size_t i = 0; i < n; ++i) {
+      uint32_t o = be32(offs + i * 4);
+      if (o & 0x80000000u) {
+        size_t want = static_cast<size_t>(o & 0x7fffffffu) + 1;
+        if (want > large_needed) large_needed = want;
+      }
+    }
+    // trailing 2×20-byte checksums follow the large-offset table
+    if (static_cast<size_t>(large - p) + large_needed * 8 + 40 > idx.size())
+      return false;
     return true;
   }
 
@@ -496,9 +515,25 @@ bool Repo::resolve_name(const std::string &rev_in, std::string *sha) {
   std::string rev = trim(rev_in.empty() ? "HEAD" : rev_in);
 
   std::string candidate;
+  bool resolved = false;
   if (rev.size() == 40 && is_hex(rev)) {
     candidate = rev;
-  } else if (rev.size() >= 4 && rev.size() < 40 && is_hex(rev)) {
+    resolved = true;
+  }
+  if (!resolved) {
+    // refs take precedence over short-SHA prefixes (git rev-parse /
+    // gitrevisions(7)): a branch or tag named like hex ('beef', 'cafe')
+    // must resolve to the ref, never to a colliding object prefix
+    const char *prefixes[] = {"", "refs/", "refs/tags/", "refs/heads/",
+                              "refs/remotes/"};
+    for (const char *p : prefixes) {
+      if (ref_sha(std::string(p) + rev, &candidate)) {
+        resolved = true;
+        break;
+      }
+    }
+  }
+  if (!resolved && rev.size() >= 4 && rev.size() < 40 && is_hex(rev)) {
     // short SHA: must be unambiguous across loose dirs and pack indexes
     std::string lower = rev;
     std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
@@ -523,26 +558,18 @@ bool Repo::resolve_name(const std::string &rev_in, std::string *sha) {
                   ? std::stoi(lower.substr(lower.size() - 1), nullptr, 16)
                   : -1;
     for (auto &pk : packs) pk->find_prefix(hex_to_bin(even), odd, &matches);
-    if (matches.size() != 1) {
-      g_error = matches.empty() ? "unknown revision: " + rev
-                                : "ambiguous short sha";
+    if (matches.size() > 1) {
+      g_error = "ambiguous short sha";
       return false;
     }
-    candidate = *matches.begin();
-  } else {
-    const char *prefixes[] = {"", "refs/", "refs/tags/", "refs/heads/",
-                              "refs/remotes/"};
-    bool ok = false;
-    for (const char *p : prefixes) {
-      if (ref_sha(std::string(p) + rev, &candidate)) {
-        ok = true;
-        break;
-      }
+    if (matches.size() == 1) {
+      candidate = *matches.begin();
+      resolved = true;
     }
-    if (!ok) {
-      g_error = "unknown revision: " + rev;
-      return false;
-    }
+  }
+  if (!resolved) {
+    g_error = "unknown revision: " + rev;
+    return false;
   }
 
   // peel annotated tags to commits (rev-parse behavior for tree walks)

@@ -185,3 +185,21 @@ def test_native_symlink_entry_counts_as_blob(packed_repo):
     assert native.files() == sub.files()
     assert "COPYING" in {f["name"] for f in native.files()}
     native.close()
+
+
+def test_native_hex_named_ref_precedence(packed_repo):
+    """A branch named like hex ('beef') resolves to the ref, not to a
+    colliding short-SHA prefix (git rev-parse precedence)."""
+    git(packed_repo, "branch", "beef", "HEAD~1")
+    expected = git(packed_repo, "rev-parse", "beef")
+    native = _NativeBackend(packed_repo, "beef")
+    assert native._commit == expected
+    native.close()
+
+
+def test_native_hex_named_tag_precedence(packed_repo):
+    git(packed_repo, "tag", "cafe", "HEAD~1")
+    expected = git(packed_repo, "rev-parse", "cafe^{commit}")
+    native = _NativeBackend(packed_repo, "cafe")
+    assert native._commit == expected
+    native.close()

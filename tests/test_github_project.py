@@ -77,3 +77,18 @@ def test_facade_routes_github_urls(monkeypatch):
     )
     licensee_tpu.project("https://github.com/a/b")
     assert captured["url"] == "https://github.com/a/b"
+
+
+def test_vanished_file_raises_not_found():
+    """A listed file that 404s during load is an API error, not an empty
+    license (github_project.rb:48-53 lets octokit raise)."""
+
+    class VanishingGitHubProject(StubbedGitHubProject):
+        def _request(self, path, raw=False):
+            if raw:
+                return None  # every per-file fetch 404s
+            return super()._request(path, raw)
+
+    project = VanishingGitHubProject("https://github.com/user/repo")
+    with pytest.raises(RepoNotFound, match="Could not load"):
+        project.license_file

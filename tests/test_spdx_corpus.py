@@ -95,6 +95,45 @@ def test_choosealicense_cross_detection(corpus):
         assert top == (lic.spdx_id or "").lower(), (lic.key, top)
 
 
+def test_custom_corpus_with_nonvendored_key(tmp_path):
+    """A corpus key outside the vendored License pool (e.g. AAL from the
+    full ~600-license SPDX list) must not sink classifier construction:
+    the Exact prefilter is built from the corpus's own template renderings,
+    not from License.find lookups (ADVICE r1 high)."""
+    import shutil
+
+    from licensee_tpu.corpus.compiler import CompiledCorpus
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    src = tmp_path / "src"
+    src.mkdir()
+    import os
+
+    shutil.copy(os.path.join(vendor_paths.SPDX_DIR, "MIT.xml"), src / "MIT.xml")
+    (src / "AAL.xml").write_text(
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<SPDXLicenseCollection xmlns="http://www.spdx.org/license">\n'
+        '  <license licenseId="AAL" name="Attribution Assurance License">\n'
+        "    <text>\n"
+        "      <p>Redistribution and use in source and binary forms, with or"
+        " without modification, are permitted provided that attribution is"
+        " preserved and the professional identification stanza is retained"
+        " in every copy of this unique software.</p>\n"
+        "    </text>\n"
+        "  </license>\n"
+        "</SPDXLicenseCollection>\n"
+    )
+    templates = load_spdx_dir(str(src))
+    assert {t.key for t in templates} == {"aal", "mit"}
+    corpus = CompiledCorpus.compile(templates)
+    clf = BatchClassifier(corpus=corpus, pad_batch_to=8)
+
+    aal = next(t for t in templates if t.key == "aal")
+    results = clf.classify_blobs([aal.content], threshold=90)
+    assert results[0].key == "aal"
+    assert results[0].matcher == "exact"  # the corpus-built prefilter hit
+
+
 def test_cli_batch_detect_spdx_corpus(tmp_path, capsys):
     import json
 
