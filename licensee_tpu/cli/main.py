@@ -226,9 +226,12 @@ def cmd_version(_args) -> int:
 
 
 def cmd_batch_detect(args) -> int:
-    """Batch classification of a manifest of files via the TPU Dice kernel."""
-    from licensee_tpu.kernels.batch import batch_detect_paths
+    """Batch classification of a manifest of files via the TPU Dice kernel.
 
+    Without --output, rows print to stdout (small manifests).  With
+    --output, the full pipelined BatchProject runs: featurization worker
+    threads, double-buffered device dispatch, resume-on-restart, and
+    per-stage timers (--stats)."""
     kwargs = {}
     if args.corpus and args.corpus != "vendored":
         from licensee_tpu.corpus.spdx import spdx_corpus
@@ -250,9 +253,52 @@ def cmd_batch_detect(args) -> int:
     except OSError as exc:
         print(f"error: cannot read manifest: {exc}", file=sys.stderr)
         return 1
-    results = batch_detect_paths(paths, **kwargs)
-    for path, result in zip(paths, results):
-        print(json.dumps({"path": path, **result}))
+
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    project = BatchProject(
+        paths,
+        method=args.method,
+        batch_size=args.batch_size,
+        workers=args.workers,
+        **kwargs,
+    )
+
+    profiler = None
+    if args.profile:
+        import jax
+
+        jax.profiler.start_trace(args.profile)
+        profiler = args.profile
+    try:
+        if args.output:
+            stats = project.run(args.output, resume=not args.no_resume)
+        else:
+            contents = [project._read(p) for p in paths]
+            results = project.classifier.classify_blobs(
+                [c if c is not None else b"" for c in contents],
+                threshold=project.threshold,
+            )
+            for path, content, result in zip(paths, contents, results):
+                row = {"path": path, **result.as_dict()}
+                if content is None:
+                    # same accounting as the --output pipeline: a read
+                    # failure is not a classification
+                    row["error"] = "read_error"
+                    project.stats.read_errors += 1
+                else:
+                    project._count(result)
+                project.stats.total += 1
+                print(json.dumps(row))
+            stats = project.stats
+    finally:
+        if profiler:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"profile trace written to {profiler}", file=sys.stderr)
+    if args.stats:
+        print(json.dumps(stats.as_dict()), file=sys.stderr)
     return 0
 
 
@@ -309,6 +355,23 @@ def build_parser() -> argparse.ArgumentParser:
             "license-list-XML src/ directory (e.g. the full ~600-license set)"
         ),
     )
+    batch.add_argument(
+        "--output", default=None,
+        help="Write JSONL here via the pipelined BatchProject (resumable)",
+    )
+    batch.add_argument(
+        "--no-resume", action="store_true",
+        help="Restart from scratch instead of resuming a partial --output",
+    )
+    batch.add_argument("--method", default="popcount",
+                       choices=["popcount", "matmul", "pallas"])
+    batch.add_argument("--batch-size", type=int, default=4096)
+    batch.add_argument("--workers", type=int, default=None,
+                       help="Featurization worker threads (default: cpu count)")
+    batch.add_argument("--stats", action="store_true",
+                       help="Print run stats + per-stage timers to stderr")
+    batch.add_argument("--profile", default=None, metavar="DIR",
+                       help="Write a jax.profiler trace to DIR")
     batch.set_defaults(func=cmd_batch_detect)
 
     return parser

@@ -82,6 +82,21 @@ class BatchClassifier:
         # keys License.find doesn't know, and their rendering differs)
         self._exact_map = self.corpus.exact_sets
 
+        # whole-pipeline native path: sanitize -> featurize in 1-2 ctypes
+        # crossings per blob (native/pipeline.cpp); falls back to the
+        # Python pipeline when the toolchain/libpcre2 is unavailable
+        from licensee_tpu.native import pipeline as native_pipeline
+
+        self._nat = native_pipeline.load()
+        self._nat_vocab = None
+        self._exact_hashes: dict[bytes, str] = {}
+        if self._nat is not None:
+            self._nat_vocab = self._nat.vocab(
+                list(self.corpus.vocab.keys()), self.corpus.n_lanes
+            )
+            for wordset, key in self.corpus.exact_sets.items():
+                self._exact_hashes.setdefault(self._nat.exact_hash(wordset), key)
+
     # -- host featureization --
 
     def _prefilter(self, blob: NormalizedBlob) -> BlobResult | None:
@@ -106,6 +121,69 @@ class BatchClassifier:
             )
         return bits, n_words, lengths, cc_fp
 
+    # -- batch preparation (prefilters + featurization in one pass) --
+
+    def prepare_batch(self, contents: list[str | bytes]):
+        """Sanitize, prefilter and featurize a batch of raw blobs.
+
+        Returns (results, bits, n_words, lengths, cc_fp, todo): ``results``
+        holds a BlobResult for prefiltered blobs and None for the ``todo``
+        indexes, whose feature rows are filled and ready for the device.
+        Thread-safe: rows are written independently and the native calls
+        release the GIL, so featurization workers can share one classifier."""
+        B = len(contents)
+        W = self.corpus.n_lanes
+        bits = np.zeros((B, W), dtype=np.uint32)
+        n_words = np.zeros(B, dtype=np.int32)
+        lengths = np.zeros(B, dtype=np.int32)
+        cc_fp = np.zeros(B, dtype=bool)
+        results: list[BlobResult | None] = [None] * B
+
+        if self._nat is not None:
+            for i, raw in enumerate(contents):
+                self._prepare_one_native(
+                    raw, results, bits, n_words, lengths, cc_fp, i
+                )
+        else:
+            blobs = [NormalizedBlob(c) for c in contents]
+            for i, blob in enumerate(blobs):
+                results[i] = self._prefilter(blob)
+                if results[i] is None:
+                    bits[i], n_words[i], lengths[i] = self.corpus.file_features(
+                        blob
+                    )
+                    cc_fp[i] = bool(
+                        CC_FALSE_POSITIVE_REGEX.search(
+                            ruby_strip(blob.content or "")
+                        )
+                    )
+        todo = [i for i, r in enumerate(results) if r is None]
+        return results, bits, n_words, lengths, cc_fp, todo
+
+    def _prepare_one_native(
+        self, raw, results, bits, n_words, lengths, cc_fp, i
+    ) -> None:
+        content = sanitize_content(raw) if raw is not None else ""
+        stripped = ruby_strip(content)
+        feat = self._nat.featurize_raw(self._nat_vocab, stripped, bits[i])
+        if feat is None:
+            # non-ASCII: the downcase between the stages must be
+            # full-Unicode, so it happens in Python (two crossings)
+            s1, flags = self._nat.stage1(stripped)
+            _, nw, ln, h = self._nat.featurize(
+                self._nat_vocab, s1.lower(), bits[i]
+            )
+        else:
+            _, nw, ln, flags, h = feat
+        if flags & 1:
+            results[i] = BlobResult("no-license", "copyright", 100.0)
+        elif h in self._exact_hashes:
+            results[i] = BlobResult(self._exact_hashes[h], "exact", 100.0)
+        else:
+            n_words[i] = nw
+            lengths[i] = ln
+            cc_fp[i] = bool(flags & 2)
+
     # -- classification --
 
     def classify_blobs(
@@ -114,52 +192,51 @@ class BatchClassifier:
         threshold = (
             licensee_tpu.confidence_threshold() if threshold is None else threshold
         )
-        blobs = [NormalizedBlob(c) for c in contents]
-        results: list[BlobResult | None] = [self._prefilter(b) for b in blobs]
-
-        todo = [i for i, r in enumerate(results) if r is None]
-        if todo:
-            for start in range(0, len(todo), self.pad_batch_to):
-                chunk = todo[start : start + self.pad_batch_to]
-                self._classify_chunk(blobs, results, chunk, threshold)
+        results, bits, n_words, lengths, cc_fp, todo = self.prepare_batch(contents)
+        outs = self.dispatch_chunks(bits, n_words, lengths, cc_fp, todo)
+        self.finish_chunks(results, todo, outs, threshold)
         return results  # type: ignore[return-value]
 
-    def _classify_chunk(self, blobs, results, chunk, threshold) -> None:
+    def dispatch_chunks(self, bits, n_words, lengths, cc_fp, todo):
+        """Launch device scoring for the ``todo`` rows in fixed-size padded
+        chunks.  The returned device outputs are lazy (JAX dispatch is
+        asynchronous): the host featurizes the next batch while the device
+        scores this one; finish_chunks() synchronizes."""
+        outs = []
         B = self.pad_batch_to
-        bits, n_words, lengths, cc_fp = self.features([blobs[i] for i in chunk])
-        pad = B - len(chunk)
-        if pad:
-            bits = np.pad(bits, ((0, pad), (0, 0)))
-            n_words = np.pad(n_words, (0, pad))
-            lengths = np.pad(lengths, (0, pad))
-            cc_fp = np.pad(cc_fp, (0, pad))
-        best_idx, best_num, best_den = self._fn(bits, n_words, lengths, cc_fp)
-        best_idx = np.asarray(best_idx)[: len(chunk)]
-        best_num = np.asarray(best_num)[: len(chunk)]
-        best_den = np.asarray(best_den)[: len(chunk)]
+        for start in range(0, len(todo), B):
+            chunk = todo[start : start + B]
+            b = bits[chunk]
+            nw = n_words[chunk]
+            ln = lengths[chunk]
+            cf = cc_fp[chunk]
+            pad = B - len(chunk)
+            if pad:
+                b = np.pad(b, ((0, pad), (0, 0)))
+                nw = np.pad(nw, (0, pad))
+                ln = np.pad(ln, (0, pad))
+                cf = np.pad(cf, (0, pad))
+            outs.append((chunk, self._fn(b, nw, ln, cf)))
+        return outs
 
-        # float64 finish: identical to Ruby's Float score (dice.rb:57-59)
-        scores = np.where(
-            best_den > 0, (best_num * 200.0) / best_den, 0.0
-        )
-        for j, i in enumerate(chunk):
-            if best_num[j] >= 0 and scores[j] >= threshold:
-                results[i] = BlobResult(
-                    self.corpus.keys[int(best_idx[j])],
-                    "dice",
-                    float(scores[j]),
-                    int(best_num[j]),
-                    int(best_den[j]),
-                )
-            else:
-                results[i] = BlobResult(None, None, 0.0)
+    def finish_chunks(self, results, todo, outs, threshold) -> None:
+        """Synchronize device outputs and finish scores in float64 —
+        identical to Ruby's Float score (dice.rb:57-59)."""
+        for chunk, (best_idx, best_num, best_den) in outs:
+            best_idx = np.asarray(best_idx)[: len(chunk)]
+            best_num = np.asarray(best_num)[: len(chunk)]
+            best_den = np.asarray(best_den)[: len(chunk)]
+            scores = np.where(best_den > 0, (best_num * 200.0) / best_den, 0.0)
+            for j, i in enumerate(chunk):
+                if best_num[j] >= 0 and scores[j] >= threshold:
+                    results[i] = BlobResult(
+                        self.corpus.keys[int(best_idx[j])],
+                        "dice",
+                        float(scores[j]),
+                        int(best_num[j]),
+                        int(best_den[j]),
+                    )
+                else:
+                    results[i] = BlobResult(None, None, 0.0)
 
 
-def batch_detect_paths(paths: list[str], **kwargs) -> list[dict]:
-    """Classify files by path (the CLI `batch-detect` command)."""
-    classifier = BatchClassifier(**kwargs)
-    contents = []
-    for path in paths:
-        with open(path, "rb") as f:
-            contents.append(f.read())
-    return [r.as_dict() for r in classifier.classify_blobs(contents)]

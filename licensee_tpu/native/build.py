@@ -35,11 +35,15 @@ def build_and_load(name: str, extra_libs: tuple[str, ...] = ()):
     lib = os.path.join(_LIB_DIR, f"_{name}.so")
     if not os.path.exists(src):
         raise NativeUnavailable(f"missing source {src}")
+    # staleness covers shared headers (scanners.h) too, not just the .cpp
+    newest_src = os.path.getmtime(src)
+    for entry in os.listdir(_NATIVE_SRC_DIR):
+        if entry.endswith(".h"):
+            newest_src = max(
+                newest_src, os.path.getmtime(os.path.join(_NATIVE_SRC_DIR, entry))
+            )
     with _lock:
-        if (
-            not os.path.exists(lib)
-            or os.path.getmtime(lib) < os.path.getmtime(src)
-        ):
+        if not os.path.exists(lib) or os.path.getmtime(lib) < newest_src:
             # unique temp per process: concurrent builders must not
             # interleave g++ output into the same file (os.replace of a
             # complete .so is atomic either way)

@@ -1,0 +1,283 @@
+"""ctypes bindings for the whole-pipeline native path (native/pipeline.cpp).
+
+Where textops.py accelerates individual passes (leaving ~18 regex passes
+and ~17 ctypes crossings per blob in Python), this module runs the ENTIRE
+stage-1/stage-2 normalization — PCRE2 for the complex patterns, the shared
+hand-coded scanners for the rest — plus wordset extraction, vocabulary
+projection, and the Exact-matcher wordset hash, in at most two crossings
+per blob.  Ruby String#downcase is full-Unicode, so the downcase between
+the stages stays in Python (str.lower).
+
+All pattern strings are shipped to C++ from the single source of truth in
+licensee_tpu/normalize/pipeline.py; the only translation is Python's
+``\\Z`` (end of string) to PCRE2's ``\\z``.  ``load()`` returns a
+``NativePipeline`` or ``None`` (no toolchain / no libpcre2 / disabled via
+LICENSEE_TPU_NO_NATIVE), in which case callers keep the pure-Python or
+hybrid path.  Differential tests: tests/test_native_pipeline.py; the
+SHA1 golden corpus (tests/test_normalize_hashes.py) runs through this
+path when built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import re
+
+import numpy as np
+
+from licensee_tpu.native.build import NativeUnavailable, build_and_load
+
+_instance = None
+_failed = False
+
+
+def _flags_str(pattern: re.Pattern) -> str:
+    flags = ""
+    if pattern.flags & re.I:
+        flags += "i"
+    if pattern.flags & re.S:
+        flags += "s"
+    if pattern.flags & re.X:
+        flags += "x"
+    return flags
+
+
+def _pcre_pattern(pattern: re.Pattern) -> bytes:
+    # Python \Z (end of string) == PCRE2 \z; PCRE2's \Z allows a final
+    # newline, which Python's does not.
+    return pattern.pattern.replace("\\Z", "\\z").encode("utf-8")
+
+
+def _build_config() -> bytes:
+    from licensee_tpu.corpus.license import global_title_regex
+    from licensee_tpu.normalize import pipeline as pl
+    from licensee_tpu.project_files.license_file import CC_FALSE_POSITIVE_REGEX
+
+    named: dict[str, re.Pattern] = {
+        "hrs": pl.REGEXES["hrs"],
+        "comment_markup": pl.REGEXES["comment_markup"],
+        "markdown_headings": pl.REGEXES["markdown_headings"],
+        "link_markup": pl.REGEXES["link_markup"],
+        "title": global_title_regex(),
+        "version": pl.REGEXES["version"],
+        "lists": pl._LISTS,
+        "span_markup": pl.REGEXES["span_markup"],
+        "bullet": pl.REGEXES["bullet"],
+        "bullet_join": pl._BULLET_JOIN,
+        "bom": pl.REGEXES["bom"],
+        "cc_dedication": pl.REGEXES["cc_dedication"],
+        "cc_wiki": pl.REGEXES["cc_wiki"],
+        "cc_legal_code": pl.REGEXES["cc_legal_code"],
+        "cc0_info": pl.REGEXES["cc0_info"],
+        "cc0_disclaimer": pl.REGEXES["cc0_disclaimer"],
+        "unlicense_info": pl.REGEXES["unlicense_info"],
+        "border_markup": pl.REGEXES["border_markup"],
+        "url": pl.REGEXES["url"],
+        "strip_copyright": pl._STRIP_COPYRIGHT,
+        "block_markup": pl.REGEXES["block_markup"],
+        "developed_by": pl.REGEXES["developed_by"],
+        "end_of_terms": pl.END_OF_TERMS,
+        "mit_optional": pl.REGEXES["mit_optional"],
+        "copyright_full": pl.COPYRIGHT_FULL_REGEX,
+        "cc_false_positive": CC_FALSE_POSITIVE_REGEX,
+    }
+    records = b"".join(
+        name.encode() + b"\0" + _flags_str(p).encode() + b"\0"
+        + _pcre_pattern(p) + b"\0"
+        for name, p in named.items()
+    )
+    # spelling_table must be last: its payload contains '\0' separators
+    table = b"".join(
+        k.encode() + b"\0" + v.encode() + b"\0"
+        for k, v in pl.VARIETAL_WORDS.items()
+    )
+    return records + b"spelling_table\0\0" + table
+
+
+class VocabHandle:
+    """Token -> id map resident in the native library (per corpus)."""
+
+    def __init__(self, lib, words: list[str], n_lanes: int):
+        self._lib = lib
+        blob = "\0".join(words).encode("utf-8")
+        self.n_lanes = n_lanes
+        self._handle = lib.pipe_vocab_new(blob, len(blob), n_lanes)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.pipe_vocab_del(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePipeline:
+    def __init__(self):
+        lib = build_and_load("pipeline", (":libpcre2-8.so.0",))
+        self._lib = lib
+        lib.pipe_free.argtypes = [ctypes.c_void_p]
+        lib.pipe_new.restype = ctypes.c_void_p
+        lib.pipe_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.pipe_error.restype = ctypes.c_char_p
+        lib.pipe_error.argtypes = [ctypes.c_void_p]
+        lib.pipe_del.argtypes = [ctypes.c_void_p]
+        out_len = ctypes.POINTER(ctypes.c_size_t)
+        lib.pipe_stage1.restype = ctypes.c_void_p
+        lib.pipe_stage1.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, out_len,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.pipe_stage2.restype = ctypes.c_void_p
+        lib.pipe_stage2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, out_len,
+        ]
+        lib.pipe_vocab_new.restype = ctypes.c_void_p
+        lib.pipe_vocab_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+        ]
+        lib.pipe_vocab_del.argtypes = [ctypes.c_void_p]
+        lib.pipe_featurize.restype = ctypes.c_int
+        lib.pipe_featurize.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.pipe_exact_hash.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.pipe_featurize_raw.restype = ctypes.c_int
+        lib.pipe_featurize_raw.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+
+        config = _build_config()
+        self._handle = lib.pipe_new(config, len(config))
+        err = lib.pipe_error(self._handle)
+        if err:
+            msg = err.decode("utf-8", errors="replace")
+            lib.pipe_del(self._handle)
+            raise NativeUnavailable(f"pipeline init failed: {msg}")
+
+    # -- per-blob API --
+
+    def stage1(self, text: str) -> tuple[str, int]:
+        """content_without_title_and_version (minus html/strip, which the
+        caller does) + prefilter flags (bit0 copyright-only, bit1 cc-fp)."""
+        data = text.encode("utf-8")
+        n = ctypes.c_size_t()
+        flags = ctypes.c_int32()
+        ptr = self._lib.pipe_stage1(
+            self._handle, data, len(data), ctypes.byref(n), ctypes.byref(flags)
+        )
+        try:
+            out = ctypes.string_at(ptr, n.value).decode("utf-8")
+        finally:
+            self._lib.pipe_free(ptr)
+        return out, flags.value
+
+    def stage2(self, lowered_stage1: str) -> str:
+        data = lowered_stage1.encode("utf-8")
+        n = ctypes.c_size_t()
+        ptr = self._lib.pipe_stage2(self._handle, data, len(data), ctypes.byref(n))
+        try:
+            return ctypes.string_at(ptr, n.value).decode("utf-8")
+        finally:
+            self._lib.pipe_free(ptr)
+
+    def vocab(self, words: list[str], n_lanes: int) -> VocabHandle:
+        return VocabHandle(self._lib, words, n_lanes)
+
+    def featurize(
+        self,
+        vocab: VocabHandle,
+        lowered_stage1: str,
+        bits_out: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, int, int, bytes]:
+        """(packed vocab bits, |wordset|, normalized char length,
+        16-byte wordset hash) for one blob.  ``bits_out`` may be a
+        caller-provided uint32[n_lanes] row (e.g. a slice of the batch
+        matrix) to avoid a copy."""
+        if bits_out is None:
+            bits_out = np.zeros(vocab.n_lanes, dtype=np.uint32)
+        assert bits_out.dtype == np.uint32 and bits_out.size == vocab.n_lanes
+        data = lowered_stage1.encode("utf-8")
+        scalars = (ctypes.c_int32 * 2)()
+        hash16 = (ctypes.c_uint8 * 16)()
+        rc = self._lib.pipe_featurize(
+            self._handle,
+            vocab._handle,
+            data,
+            len(data),
+            bits_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            scalars,
+            hash16,
+        )
+        if rc != 0:
+            raise RuntimeError(f"pipe_featurize rc={rc}")
+        return bits_out, int(scalars[0]), int(scalars[1]), bytes(hash16)
+
+    def featurize_raw(
+        self,
+        vocab: VocabHandle,
+        stripped_content: str,
+        bits_out: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, int, int, int, bytes] | None:
+        """One-crossing featurize of String#strip'd content: (bits,
+        |wordset|, char length, prefilter flags, wordset hash).  Returns
+        None when the content has non-ASCII bytes — the caller must use
+        the two-crossing stage1 -> str.lower() -> featurize path so the
+        downcase is full-Unicode."""
+        if bits_out is None:
+            bits_out = np.zeros(vocab.n_lanes, dtype=np.uint32)
+        try:
+            data = stripped_content.encode("ascii")
+        except UnicodeEncodeError:
+            return None
+        scalars = (ctypes.c_int32 * 3)()
+        hash16 = (ctypes.c_uint8 * 16)()
+        rc = self._lib.pipe_featurize_raw(
+            self._handle,
+            vocab._handle,
+            data,
+            len(data),
+            bits_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            scalars,
+            hash16,
+        )
+        if rc == 2:
+            return None
+        if rc != 0:
+            raise RuntimeError(f"pipe_featurize_raw rc={rc}")
+        return (
+            bits_out,
+            int(scalars[0]),
+            int(scalars[1]),
+            int(scalars[2]),
+            bytes(hash16),
+        )
+
+    def exact_hash(self, wordset) -> bytes:
+        """The 16-byte hash pipe_featurize computes, for a Python-side
+        wordset (e.g. a compiled template's).  The hash is an
+        order-independent multiset sum, so no sorting on either side."""
+        blob = "\0".join(wordset).encode("utf-8")
+        hash16 = (ctypes.c_uint8 * 16)()
+        self._lib.pipe_exact_hash(blob, len(blob), hash16)
+        return bytes(hash16)
+
+
+def load() -> NativePipeline | None:
+    """The shared NativePipeline instance, or None when unavailable."""
+    global _instance, _failed
+    if _instance is None and not _failed:
+        try:
+            _instance = NativePipeline()
+        except NativeUnavailable:
+            _failed = True
+    return _instance

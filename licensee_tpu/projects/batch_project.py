@@ -1,10 +1,18 @@
 """BatchProject: classify a manifest of millions of blobs.
 
-The scale-out ingestion path of SURVEY.md §7 step 5: manifest -> featurize
-workers -> fixed-width packed batches -> (double-buffered) device feed ->
-JSONL results, with a resumable shard manifest (the checkpoint/resume
+The scale-out ingestion path of SURVEY.md §7 step 5: manifest -> read +
+featurize worker threads -> bounded queue of packed feature batches ->
+device scoring overlapped with the next batches' featurization -> JSONL
+results, with a resumable shard manifest (the checkpoint/resume
 subsystem; the reference's closest analog is its pervasive memoization +
 golden caches, SURVEY.md §5).
+
+Pipelining model: featurization is dominated by native code that releases
+the GIL (native/pipeline.cpp), so a thread pool gives real host
+parallelism on multi-core machines; device dispatch is asynchronous under
+JAX, so batch k's device scoring runs while batches k+1..k+inflight
+featurize.  Results are written strictly in manifest order, preserving the
+line-count == completed-prefix resume invariant.
 
 Host pre-filters (Copyright regex, Exact wordset hash) short-circuit blobs
 before they are packed for HBM, mirroring the first-match-wins chain
@@ -15,7 +23,10 @@ from __future__ import annotations
 
 import json
 import os
-from dataclasses import dataclass
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -30,9 +41,20 @@ class BatchStats:
     dice_matched: int = 0
     unmatched: int = 0
     read_errors: int = 0
+    # per-stage wall-clock seconds (the observability surface of
+    # SURVEY.md §5; read+featurize accumulate across worker threads, so
+    # they can exceed elapsed on multi-core hosts)
+    stage_seconds: dict = field(default_factory=dict)
+
+    def add_stage(self, stage: str, seconds: float) -> None:
+        self.stage_seconds[stage] = self.stage_seconds.get(stage, 0.0) + seconds
 
     def as_dict(self) -> dict:
-        return dict(self.__dict__)
+        d = dict(self.__dict__)
+        d["stage_seconds"] = {
+            k: round(v, 4) for k, v in self.stage_seconds.items()
+        }
+        return d
 
 
 class BatchProject:
@@ -49,6 +71,8 @@ class BatchProject:
         method: str = "popcount",
         batch_size: int = 4096,
         threshold: float | None = None,
+        workers: int | None = None,
+        inflight: int = 3,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -60,6 +84,8 @@ class BatchProject:
         self.threshold = (
             licensee_tpu.confidence_threshold() if threshold is None else threshold
         )
+        self.workers = workers or min(32, (os.cpu_count() or 1))
+        self.inflight = max(1, inflight)
         self.stats = BatchStats()
 
     @classmethod
@@ -73,7 +99,6 @@ class BatchProject:
             with open(path, "rb") as f:
                 return f.read(64 * 1024)  # MAX_LICENSE_SIZE cap (git_project.rb:53)
         except OSError:
-            self.stats.read_errors += 1
             return None
 
     @staticmethod
@@ -97,30 +122,93 @@ class BatchProject:
                 f.truncate(good_end)
         return done
 
+    # -- the pipeline stages --
+
+    def _produce(self, start: int):
+        """Worker-thread stage: read + prefilter + featurize one batch."""
+        chunk = self.paths[start : start + self.batch_size]
+        t0 = time.perf_counter()
+        contents = [self._read(p) for p in chunk]
+        t1 = time.perf_counter()
+        prepared = self.classifier.prepare_batch(
+            [c if c is not None else b"" for c in contents]
+        )
+        t2 = time.perf_counter()
+        read_errs = [c is None for c in contents]
+        return chunk, read_errs, prepared, (t1 - t0, t2 - t1)
+
+    def _dispatch(self, prepared):
+        """Main-thread stage: launch device scoring (asynchronous)."""
+        results, bits, n_words, lengths, cc_fp, todo = prepared
+        if not todo:
+            return None
+        return self.classifier.dispatch_chunks(
+            bits, n_words, lengths, cc_fp, todo
+        )
+
+    def _finish(self, prepared, device_out) -> list:
+        results, bits, n_words, lengths, cc_fp, todo = prepared
+        if device_out is not None:
+            self.classifier.finish_chunks(
+                results, todo, device_out, self.threshold
+            )
+        return results
+
     def run(self, output: str, resume: bool = True) -> BatchStats:
         done = 0
         if resume and os.path.exists(output):
             done = self._resume_point(output)
         mode = "a" if done else "w"
 
-        with open(output, mode, encoding="utf-8") as out:
-            for start in range(done, len(self.paths), self.batch_size):
-                chunk = self.paths[start : start + self.batch_size]
-                contents = [self._read(p) for p in chunk]
-                results = self.classifier.classify_blobs(
-                    [c if c is not None else b"" for c in contents],
-                    threshold=self.threshold,
-                )
-                for path, content, result in zip(chunk, contents, results):
+        starts = deque(range(done, len(self.paths), self.batch_size))
+        t_run = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=self.workers) as pool, open(
+            output, mode, encoding="utf-8"
+        ) as out:
+            futures: deque = deque()
+
+            def submit_next() -> None:
+                if starts:
+                    futures.append(pool.submit(self._produce, starts.popleft()))
+
+            for _ in range(self.inflight):
+                submit_next()
+
+            # pending: batches whose device scoring is in flight
+            pending: deque = deque()
+            while futures or pending:
+                # keep up to 2 device batches in flight before draining
+                while futures and len(pending) < 2:
+                    chunk, read_errs, prepared, (t_read, t_feat) = (
+                        futures.popleft().result()
+                    )
+                    submit_next()
+                    self.stats.add_stage("read", t_read)
+                    self.stats.add_stage("featurize", t_feat)
+                    t0 = time.perf_counter()
+                    device_out = self._dispatch(prepared)
+                    self.stats.add_stage("dispatch", time.perf_counter() - t0)
+                    pending.append((chunk, read_errs, prepared, device_out))
+
+                chunk, read_errs, prepared, device_out = pending.popleft()
+                t0 = time.perf_counter()
+                results = self._finish(prepared, device_out)
+                t1 = time.perf_counter()
+                for path, is_err, result in zip(chunk, read_errs, results):
                     row = {"path": path, **result.as_dict()}
-                    if content is None:
+                    if is_err:
                         # distinguish "could not read" from "no license"
                         row["error"] = "read_error"
+                        self.stats.read_errors += 1
                     else:
                         self._count(result)
                     self.stats.total += 1
                     out.write(json.dumps(row) + "\n")
                 out.flush()
+                t2 = time.perf_counter()
+                self.stats.add_stage("score", t1 - t0)
+                self.stats.add_stage("write", t2 - t1)
+        self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats
 
     def classify_contents(self, contents: list[bytes | str]) -> list:

@@ -1,0 +1,597 @@
+// pipeline: the ENTIRE per-blob normalization + featurization hot path in
+// one native pass.
+//
+// Parity target: lib/licensee/content_helper.rb via the Python twin
+// licensee_tpu/normalize/pipeline.py.  The hybrid round-1 path crossed the
+// ctypes boundary ~17 times per blob and ran the remaining ~18 regex
+// passes in Python; this module runs the full ordered pipeline here, so
+// Python pays TWO crossings per blob (stage1 on original-case text, then
+// stage2/featurize on the Python-lowercased stage1 output — Ruby
+// String#downcase is full-Unicode, so the downcase stays in Python).
+//
+// Complex patterns (the corpus-derived title regex, the copyright
+// pattern, optional-block strips) are executed by PCRE2 in 8-bit
+// no-UTF mode, which reproduces Ruby/Python `re.M | re.A` semantics:
+// \w/\s/\b are ASCII, caseless folding is ASCII, ^/$ are line anchors.
+// The system libpcre2-8 ships without headers, so the stable ABI is
+// declared below.  Simple passes reuse the hand-coded scanners shared
+// with textops.cpp (scanners.h).
+//
+// All pattern strings are passed in from Python at handle-construction
+// time — the single source of truth for the pipeline's regexes stays in
+// licensee_tpu/normalize/pipeline.py.  Differential tests:
+// tests/test_native_pipeline.py; end-to-end oracle: the SHA1 golden
+// corpus (tests/test_normalize_hashes.py runs this path when built).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <algorithm>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "scanners.h"
+
+namespace sc = licensee_scanners;
+
+// ---------------------------------------------------------------------------
+// PCRE2 8-bit ABI (subset), declared by hand: the runtime library is
+// present but the dev headers are not.  Constants from pcre2.h (stable).
+extern "C" {
+typedef struct pcre2_real_code pcre2_code;
+typedef struct pcre2_real_match_data pcre2_match_data;
+pcre2_code *pcre2_compile_8(const uint8_t *, size_t, uint32_t, int *,
+                            size_t *, void *);
+void pcre2_code_free_8(pcre2_code *);
+int pcre2_jit_compile_8(pcre2_code *, uint32_t);
+pcre2_match_data *pcre2_match_data_create_8(uint32_t, void *);
+void pcre2_match_data_free_8(pcre2_match_data *);
+int pcre2_match_8(const pcre2_code *, const uint8_t *, size_t, size_t,
+                  uint32_t, pcre2_match_data *, void *);
+int pcre2_substitute_8(const pcre2_code *, const uint8_t *, size_t, size_t,
+                       uint32_t, pcre2_match_data *, void *, const uint8_t *,
+                       size_t, uint8_t *, size_t *);
+size_t *pcre2_get_ovector_pointer_8(pcre2_match_data *);
+void pcre2_get_error_message_8(int, uint8_t *, size_t);
+}
+
+static const uint32_t kCaseless = 0x00000008u;     // PCRE2_CASELESS
+static const uint32_t kDotall = 0x00000020u;       // PCRE2_DOTALL
+static const uint32_t kExtended = 0x00000080u;     // PCRE2_EXTENDED
+static const uint32_t kMultiline = 0x00000400u;    // PCRE2_MULTILINE
+static const uint32_t kSubGlobal = 0x00000100u;    // PCRE2_SUBSTITUTE_GLOBAL
+static const uint32_t kSubOverflow = 0x00001000u;  // ..._OVERFLOW_LENGTH
+static const uint32_t kJitComplete = 0x00000001u;  // PCRE2_JIT_COMPLETE
+static const uint32_t kNoJit = 0x00002000u;        // PCRE2_NO_JIT
+static const int kNoMatch = -1;                    // PCRE2_ERROR_NOMATCH
+static const int kNoMemory = -48;                  // PCRE2_ERROR_NOMEMORY
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Compiled pattern wrapper
+
+struct Pat {
+  pcre2_code *code = nullptr;
+
+  bool compile(const std::string &pattern, const std::string &flags,
+               std::string *err_out) {
+    uint32_t options = kMultiline;  // Ruby ^/$ are always line anchors
+    for (char f : flags) {
+      if (f == 'i') options |= kCaseless;
+      if (f == 's') options |= kDotall;
+      if (f == 'x') options |= kExtended;
+    }
+    int errcode = 0;
+    size_t erroff = 0;
+    code = pcre2_compile_8(reinterpret_cast<const uint8_t *>(pattern.data()),
+                           pattern.size(), options, &errcode, &erroff, nullptr);
+    if (!code) {
+      uint8_t msg[256];
+      pcre2_get_error_message_8(errcode, msg, sizeof msg);
+      *err_out = "pattern compile failed at " + std::to_string(erroff) + ": " +
+                 reinterpret_cast<char *>(msg);
+      return false;
+    }
+    pcre2_jit_compile_8(code, kJitComplete);  // best-effort
+    return true;
+  }
+
+  ~Pat() {
+    if (code) pcre2_code_free_8(code);
+  }
+};
+
+// One reusable match_data per call frame (1 ovector pair: we only ever
+// need the whole-match span; rc==0 "ovector too small" still means match).
+struct Scratch {
+  pcre2_match_data *md;
+  Scratch() { md = pcre2_match_data_create_8(1, nullptr); }
+  ~Scratch() { pcre2_match_data_free_8(md); }
+};
+
+// search: does `pat` match anywhere in s?  On a JIT resource error,
+// retry interpretively before giving up.
+bool search(const Pat &p, const std::string &s, Scratch &scr,
+            size_t *start_out = nullptr) {
+  int rc = pcre2_match_8(p.code, reinterpret_cast<const uint8_t *>(s.data()),
+                         s.size(), 0, 0, scr.md, nullptr);
+  if (rc < 0 && rc != kNoMatch)
+    rc = pcre2_match_8(p.code, reinterpret_cast<const uint8_t *>(s.data()),
+                       s.size(), 0, kNoJit, scr.md, nullptr);
+  if (rc == kNoMatch || rc < 0) return false;
+  if (start_out) *start_out = pcre2_get_ovector_pointer_8(scr.md)[0];
+  return true;
+}
+
+// gsub: global substitute with a replacement template ("$1" group refs
+// insert the group text raw, like a Python callable returning m.group).
+std::string gsub(const Pat &p, const std::string &s, const char *repl) {
+  size_t repl_len = std::strlen(repl);
+  std::string out;
+  size_t out_len = s.size() + (s.size() >> 2) + 64;
+  for (int attempt = 0; attempt < 3; ++attempt) {
+    out.resize(out_len);
+    size_t n = out_len;
+    int rc = pcre2_substitute_8(
+        p.code, reinterpret_cast<const uint8_t *>(s.data()), s.size(), 0,
+        kSubGlobal | kSubOverflow, nullptr, nullptr,
+        reinterpret_cast<const uint8_t *>(repl), repl_len,
+        reinterpret_cast<uint8_t *>(out.data()), &n);
+    if (rc == kNoMemory) {
+      out_len = n;  // overflow-length mode reports the required size
+      continue;
+    }
+    if (rc < 0) {
+      // substitute failed (e.g. JIT resource limit): retry interpretively
+      n = out_len;
+      rc = pcre2_substitute_8(
+          p.code, reinterpret_cast<const uint8_t *>(s.data()), s.size(), 0,
+          kSubGlobal | kSubOverflow | kNoJit, nullptr, nullptr,
+          reinterpret_cast<const uint8_t *>(repl), repl_len,
+          reinterpret_cast<uint8_t *>(out.data()), &n);
+      if (rc == kNoMemory) {
+        out_len = n;
+        continue;
+      }
+      if (rc < 0) return s;  // give up: pass through unchanged
+    }
+    out.resize(n);
+    return out;
+  }
+  return s;
+}
+
+// Ruby ContentHelper#strip: gsub(regex, ' ').squeeze(' ').strip — the
+// squeeze and strip apply even when the regex does not match.  `clean`
+// tracks the invariant "squeeze(' ').strip would be a no-op": true after
+// any plain_strip, preserved by passes that leave the string unchanged,
+// so consecutive non-matching strip passes cost one regex search each.
+std::string plain_strip(const Pat &p, std::string s, Scratch &scr,
+                        bool *clean) {
+  if (!search(p, s, scr)) {
+    if (*clean) return s;
+    *clean = true;
+    return sc::squeeze_strip(s.data(), s.size());
+  }
+  std::string subbed = gsub(p, s, " ");
+  *clean = true;
+  return sc::squeeze_strip(subbed.data(), subbed.size());
+}
+
+// Plain gsub pass: skipped outright on no match (Python sub returns the
+// string unchanged); a real substitution may introduce double spaces, so
+// it invalidates `clean`.
+std::string gsub_pass(const Pat &p, std::string s, const char *repl,
+                      Scratch &scr, bool *clean) {
+  if (!search(p, s, scr)) return s;
+  *clean = false;
+  return gsub(p, s, repl);
+}
+
+bool contains(const std::string &s, const char *needle) {
+  return s.find(needle) != std::string::npos;
+}
+
+// Ruby String#split("\n") drops trailing empty fields.
+std::vector<std::pair<size_t, size_t>> split_lines(const std::string &s) {
+  std::vector<std::pair<size_t, size_t>> lines;
+  size_t start = 0;
+  for (size_t i = 0; i <= s.size(); ++i) {
+    if (i == s.size() || s[i] == '\n') {
+      lines.emplace_back(start, i - start);
+      start = i + 1;
+      if (i == s.size()) break;
+    }
+  }
+  while (!lines.empty() && lines.back().second == 0) lines.pop_back();
+  return lines;
+}
+
+// ---------------------------------------------------------------------------
+// Pipeline handle
+
+struct Pipeline {
+  std::map<std::string, Pat> pats;
+  sc::Spelling spelling;
+  std::string error;
+
+  const Pat *pat(const char *name) const {
+    auto it = pats.find(name);
+    return it == pats.end() ? nullptr : &it->second;
+  }
+
+  // content_helper.rb:238-240 — peel title/copyright-style lines from the
+  // front until the regex stops matching.
+  std::string strip_loop(const Pat &p, std::string c, Scratch &scr,
+                         bool *clean) const {
+    for (int guard = 0; guard < 1000 && search(p, c, scr); ++guard) {
+      std::string next = plain_strip(p, c, scr, clean);
+      if (next == c) break;  // cannot happen for these patterns; safety
+      c = std::move(next);
+    }
+    return c;
+  }
+
+  // content_helper.rb:246-252 — only strip when every line is a comment
+  std::string strip_comments(std::string c, Scratch &scr,
+                             bool *clean) const {
+    const Pat &p = *pat("comment_markup");
+    auto lines = split_lines(c);
+    if (lines.size() <= 1) return c;
+    for (auto &ln : lines) {
+      std::string line = c.substr(ln.first, ln.second);
+      if (!search(p, line, scr)) return c;
+    }
+    return plain_strip(p, std::move(c), scr, clean);
+  }
+
+  // Stage 1: content_without_title_and_version (content_helper.rb:144-151)
+  // minus the html conversion and the initial String#strip, which stay in
+  // Python (full-Unicode / external-converter concerns).
+  std::string stage1(std::string c, Scratch &scr) const {
+    bool clean = sc::is_squeezed_clean(c.data(), c.size());
+    c = plain_strip(*pat("hrs"), std::move(c), scr, &clean);
+    c = strip_comments(std::move(c), scr, &clean);
+    c = plain_strip(*pat("markdown_headings"), std::move(c), scr, &clean);
+    c = gsub_pass(*pat("link_markup"), std::move(c), "$1", scr, &clean);
+    c = strip_loop(*pat("title"), std::move(c), scr, &clean);
+    c = plain_strip(*pat("version"), std::move(c), scr, &clean);
+    return c;
+  }
+
+  // Stage 2: content_normalized (content_helper.rb:153-168), input is the
+  // Python-downcased stage-1 output.
+  std::string stage2(std::string c, Scratch &scr) const {
+    bool clean = sc::is_squeezed_clean(c.data(), c.size());
+    c = gsub_pass(*pat("lists"), std::move(c), "- $1", scr, &clean);
+    // gsub(/http:/, 'https:') and gsub(/&/, 'and') — literal span scans
+    // (replacements introduce no spaces, so `clean` is preserved)
+    if (c.find('&') != std::string::npos ||
+        c.find("http:") != std::string::npos) {
+      std::string r;
+      r.reserve(c.size() + 16);
+      size_t i = 0;
+      while (i < c.size()) {
+        size_t amp = c.find('&', i);
+        size_t http = c.find("http:", i);
+        size_t next = std::min(amp, http);
+        if (next == std::string::npos) break;
+        r.append(c, i, next - i);
+        if (next == amp && amp < http) {
+          r += "and";
+          i = next + 1;
+        } else {
+          r += "https:";
+          i = next + 5;
+        }
+      }
+      r.append(c, i, std::string::npos);
+      c = std::move(r);
+    }
+    c = sc::dashes(c.data(), c.size());
+    c = sc::quotes(c.data(), c.size());
+    c = sc::hyphenated(c.data(), c.size());
+    c = spelling.run(c.data(), c.size());
+    c = gsub_pass(*pat("span_markup"), std::move(c), "$1", scr, &clean);
+    c = gsub_pass(*pat("bullet"), std::move(c), "\n\n- ", scr, &clean);
+    c = gsub_pass(*pat("bullet_join"), std::move(c), ")(", scr, &clean);
+
+    // strip methods (content_helper.rb:89-105), in order
+    c = plain_strip(*pat("bom"), std::move(c), scr, &clean);
+    if (contains(c, "creative commons")) {
+      c = plain_strip(*pat("cc_dedication"), std::move(c), scr, &clean);
+      c = plain_strip(*pat("cc_wiki"), std::move(c), scr, &clean);
+    }
+    if (contains(c, "associating cc0")) {
+      c = plain_strip(*pat("cc_legal_code"), std::move(c), scr, &clean);
+      c = plain_strip(*pat("cc0_info"), std::move(c), scr, &clean);
+      c = plain_strip(*pat("cc0_disclaimer"), std::move(c), scr, &clean);
+    }
+    if (contains(c, "unlicense")) {
+      c = plain_strip(*pat("unlicense_info"), std::move(c), scr, &clean);
+    }
+    c = gsub_pass(*pat("border_markup"), std::move(c), "$1", scr, &clean);
+    c = strip_loop(*pat("title"), std::move(c), scr, &clean);
+    c = plain_strip(*pat("version"), std::move(c), scr, &clean);
+    c = plain_strip(*pat("url"), std::move(c), scr, &clean);
+    c = strip_loop(*pat("strip_copyright"), std::move(c), scr, &clean);
+    c = strip_loop(*pat("title"), std::move(c), scr, &clean);
+    c = plain_strip(*pat("block_markup"), std::move(c), scr, &clean);
+    c = plain_strip(*pat("developed_by"), std::move(c), scr, &clean);
+    size_t eot;
+    if (search(*pat("end_of_terms"), c, scr, &eot)) {
+      c.resize(eot);
+      clean = false;  // truncation can expose a strippable tail
+    }
+    c = sc::strip_whitespace(c.data(), c.size());
+    clean = true;
+    c = plain_strip(*pat("mit_optional"), std::move(c), scr, &clean);
+    return c;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Vocab handle: token -> id open-addressing map (FNV-1a), plus lane count
+
+struct Vocab {
+  std::string blob;  // '\0'-joined words, id = order
+  struct Entry {
+    uint64_t hash;
+    uint32_t off, len, id;
+    bool used = false;
+  };
+  std::vector<Entry> table;
+  uint32_t n_lanes = 0;
+
+  static uint64_t fnv(const char *p, size_t n) { return sc::token_hash(p, n); }
+
+  void load(const char *data, size_t len, uint32_t lanes) {
+    blob.assign(data, len);
+    n_lanes = lanes;
+    std::vector<std::pair<uint32_t, uint32_t>> words;
+    size_t start = 0;
+    for (size_t i = 0; i <= blob.size(); ++i) {
+      if (i == blob.size() || blob[i] == '\0') {
+        words.emplace_back(static_cast<uint32_t>(start),
+                           static_cast<uint32_t>(i - start));
+        start = i + 1;
+        if (i == blob.size()) break;
+      }
+    }
+    if (len == 0) words.clear();
+    size_t cap = 16;
+    while (cap < words.size() * 2) cap <<= 1;
+    table.assign(cap, Entry{});
+    for (uint32_t id = 0; id < words.size(); ++id) {
+      uint64_t h = fnv(blob.data() + words[id].first, words[id].second);
+      size_t slot = h & (cap - 1);
+      while (table[slot].used) slot = (slot + 1) & (cap - 1);
+      table[slot] = Entry{h, words[id].first, words[id].second, id, true};
+    }
+  }
+
+  // returns id or UINT32_MAX; `h` is the token's FNV-1a64 (same function
+  // the wordset scan folds inline)
+  uint32_t find_hashed(const char *p, size_t n, uint64_t h) const {
+    if (table.empty()) return UINT32_MAX;
+    size_t cap = table.size();
+    size_t slot = h & (cap - 1);
+    while (table[slot].used) {
+      const Entry &e = table[slot];
+      if (e.hash == h && e.len == n &&
+          std::memcmp(blob.data() + e.off, p, n) == 0)
+        return e.id;
+      slot = (slot + 1) & (cap - 1);
+    }
+    return UINT32_MAX;
+  }
+};
+
+// 128-bit ORDER-INDEPENDENT hash of a unique wordset: the multiset-sum of
+// two per-token 64-bit values derived from the token's FNV-1a64 (set
+// equality == multiset equality for unique tokens; summing makes the hash
+// independent of discovery order, so neither side has to sort).  Python
+// computes the identical value for template wordsets via pipe_exact_hash.
+inline uint64_t mix64(uint64_t h) {
+  // splitmix64 finalizer: makes the second stream independent of the first
+  h += 0x9e3779b97f4a7c15ull;
+  h = (h ^ (h >> 30)) * 0xbf58476d1ce4e5b9ull;
+  h = (h ^ (h >> 27)) * 0x94d049bb133111ebull;
+  return h ^ (h >> 31);
+}
+
+void wordset_hash(const std::vector<uint64_t> &token_hashes, uint8_t *out16) {
+  uint64_t h1 = static_cast<uint64_t>(token_hashes.size());
+  uint64_t h2 = ~h1;
+  for (uint64_t h : token_hashes) {
+    h1 += h;
+    h2 += mix64(h);
+  }
+  std::memcpy(out16, &h1, 8);
+  std::memcpy(out16 + 8, &h2, 8);
+}
+
+char *to_buf(const std::string &s, size_t *out_len) {
+  char *buf = static_cast<char *>(std::malloc(s.size() ? s.size() : 1));
+  std::memcpy(buf, s.data(), s.size());
+  *out_len = s.size();
+  return buf;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C surface
+
+extern "C" {
+
+void pipe_free(void *p) { std::free(p); }
+
+// config: repeated "name\0flags\0pattern\0" records.  The record named
+// "spelling_table" carries the flat "from\0to\0..." table in its pattern
+// field — because the table itself contains '\0' separators, it must be
+// the LAST record and runs to the end of the config blob.
+void *pipe_new(const char *config, size_t config_len) {
+  auto *pl = new Pipeline();
+  size_t i = 0;
+  while (i < config_len) {
+    const char *name = config + i;
+    size_t nl = std::strlen(name);
+    i += nl + 1;
+    const char *flags = config + i;
+    size_t fl = std::strlen(flags);
+    i += fl + 1;
+    if (std::strcmp(name, "spelling_table") == 0) {
+      pl->spelling.load(config + i, config_len - i);
+      break;
+    }
+    const char *pattern = config + i;
+    size_t plen = std::strlen(pattern);
+    i += plen + 1;
+    Pat &p = pl->pats[name];
+    if (!p.compile(std::string(pattern, plen), std::string(flags, fl),
+                   &pl->error)) {
+      pl->error = std::string(name) + ": " + pl->error;
+      return pl;  // caller checks pipe_error
+    }
+  }
+  return pl;
+}
+
+const char *pipe_error(void *handle) {
+  auto *pl = static_cast<Pipeline *>(handle);
+  return pl->error.empty() ? nullptr : pl->error.c_str();
+}
+
+void pipe_del(void *handle) { delete static_cast<Pipeline *>(handle); }
+
+// Stage 1.  flags_out bit0: copyright-notice-only file (the Copyright
+// matcher's full-content test, matchers/copyright.rb:13, on the as-given
+// input which Python has already String#strip'd); bit1: CC-NC/ND false
+// positive guard (license_file.rb:63-65).
+char *pipe_stage1(void *handle, const char *data, size_t len, size_t *out_len,
+                  int32_t *flags_out) {
+  auto *pl = static_cast<Pipeline *>(handle);
+  Scratch scr;
+  std::string in(data, len);
+  int32_t flags = 0;
+  if (flags_out) {
+    const Pat *cfull = pl->pat("copyright_full");
+    const Pat *ccfp = pl->pat("cc_false_positive");
+    if (cfull && search(*cfull, in, scr)) flags |= 1;
+    if (ccfp && search(*ccfp, in, scr)) flags |= 2;
+    *flags_out = flags;
+  }
+  return to_buf(pl->stage1(std::move(in), scr), out_len);
+}
+
+// Stage 2 on the Python-downcased stage-1 output.
+char *pipe_stage2(void *handle, const char *data, size_t len,
+                  size_t *out_len) {
+  auto *pl = static_cast<Pipeline *>(handle);
+  Scratch scr;
+  return to_buf(pl->stage2(std::string(data, len), scr), out_len);
+}
+
+void *pipe_vocab_new(const char *words, size_t words_len, uint32_t n_lanes) {
+  auto *v = new Vocab();
+  v->load(words, words_len, n_lanes);
+  return v;
+}
+
+void pipe_vocab_del(void *handle) { delete static_cast<Vocab *>(handle); }
+
+// Featurize: run stage 2 on the downcased stage-1 text, then extract the
+// wordset and project it onto the corpus vocabulary.
+//   bits_out   uint32[n_lanes]  (memset + vocab-id bit per in-vocab token)
+//   out        int32[2]: [0]=|wordset| (unique tokens, OOV included),
+//                        [1]=normalized length in CHARACTERS
+//   hash_out   uint8[16]: 128-bit hash of the sorted unique wordset, for
+//              the Exact prefilter (matchers/exact.rb:6-13)
+// Returns 0 on success.
+int pipe_featurize(void *handle, void *vocab_handle, const char *data,
+                   size_t len, uint32_t *bits_out, int32_t *out,
+                   uint8_t *hash_out) {
+  auto *pl = static_cast<Pipeline *>(handle);
+  auto *vocab = static_cast<Vocab *>(vocab_handle);
+  Scratch scr;
+  std::string c = pl->stage2(std::string(data, len), scr);
+
+  std::vector<uint64_t> hashes;
+  std::vector<sc::Slice> uniq = sc::wordset_unique(c.data(), c.size(), &hashes);
+  std::memset(bits_out, 0, vocab->n_lanes * sizeof(uint32_t));
+  for (size_t k = 0; k < uniq.size(); ++k) {
+    uint32_t id = vocab->find_hashed(c.data() + uniq[k].off, uniq[k].len,
+                                     hashes[k]);
+    if (id != UINT32_MAX && (id >> 5) < vocab->n_lanes)
+      bits_out[id >> 5] |= (1u << (id & 31));
+  }
+  out[0] = static_cast<int32_t>(uniq.size());
+  // character length = non-continuation UTF-8 bytes
+  size_t chars = 0;
+  for (char ch : c)
+    if ((static_cast<unsigned char>(ch) & 0xc0) != 0x80) ++chars;
+  out[1] = static_cast<int32_t>(chars);
+
+  wordset_hash(hashes, hash_out);
+  return 0;
+}
+
+// Whole-blob fast path: flags + stage1 + downcase + stage2 + featurize in
+// ONE crossing, valid only when the stage-1 output is pure ASCII (then
+// ASCII downcase == Ruby String#downcase == Python str.lower).  Returns 0
+// on success; 2 when the text contains non-ASCII bytes — the caller must
+// fall back to the two-crossing path where Python does the full-Unicode
+// downcase.  out: [0]=|wordset| [1]=char length [2]=prefilter flags.
+int pipe_featurize_raw(void *handle, void *vocab_handle, const char *data,
+                       size_t len, uint32_t *bits_out, int32_t *out,
+                       uint8_t *hash_out) {
+  auto *pl = static_cast<Pipeline *>(handle);
+  auto *vocab = static_cast<Vocab *>(vocab_handle);
+  for (size_t i = 0; i < len; ++i)
+    if (static_cast<unsigned char>(data[i]) >= 0x80) return 2;
+  Scratch scr;
+  std::string in(data, len);
+  int32_t flags = 0;
+  if (search(*pl->pat("copyright_full"), in, scr)) flags |= 1;
+  if (search(*pl->pat("cc_false_positive"), in, scr)) flags |= 2;
+  out[2] = flags;
+
+  std::string c = pl->stage1(std::move(in), scr);
+  for (char &ch : c)
+    if (ch >= 'A' && ch <= 'Z') ch += 'a' - 'A';
+  c = pl->stage2(std::move(c), scr);
+
+  std::vector<uint64_t> hashes;
+  std::vector<sc::Slice> uniq = sc::wordset_unique(c.data(), c.size(), &hashes);
+  std::memset(bits_out, 0, vocab->n_lanes * sizeof(uint32_t));
+  for (size_t k = 0; k < uniq.size(); ++k) {
+    uint32_t id = vocab->find_hashed(c.data() + uniq[k].off, uniq[k].len,
+                                     hashes[k]);
+    if (id != UINT32_MAX && (id >> 5) < vocab->n_lanes)
+      bits_out[id >> 5] |= (1u << (id & 31));
+  }
+  out[0] = static_cast<int32_t>(uniq.size());
+  out[1] = static_cast<int32_t>(c.size());  // pure ASCII: bytes == chars
+  wordset_hash(hashes, hash_out);
+  return 0;
+}
+
+// Hash a '\0'-joined unique-token blob (Python-side template wordsets, any
+// order) with the same multiset hash pipe_featurize computes.
+void pipe_exact_hash(const char *blob, size_t len, uint8_t *hash_out) {
+  std::vector<uint64_t> hashes;
+  size_t start = 0;
+  for (size_t i = 0; i <= len; ++i) {
+    if (i == len || blob[i] == '\0') {
+      if (i > start) hashes.push_back(Vocab::fnv(blob + start, i - start));
+      start = i + 1;
+      if (i == len) break;
+    }
+  }
+  wordset_hash(hashes, hash_out);
+}
+
+}  // extern "C"

@@ -1,0 +1,503 @@
+// Shared hand-coded scanners for the normalization hot path.
+//
+// Bodies extracted from textops.cpp (round 1) so that both the
+// per-pass textops bindings and the whole-pipeline pipeline.cpp compile
+// the same single source of truth.  Every function is a byte-exact
+// re-implementation of one Ruby/Python regex pass (see textops.cpp and
+// licensee_tpu/normalize/pipeline.py for the parity citations); the
+// differential tests in tests/test_textops.py and
+// tests/test_native_pipeline.py hold them to that.
+
+#ifndef LICENSEE_TPU_SCANNERS_H_
+#define LICENSEE_TPU_SCANNERS_H_
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace licensee_scanners {
+
+// byte class tables: one L1 load per byte beats chained comparisons in
+// every scanner's inner loop
+struct ByteTables {
+  bool space[256] = {};  // Ruby \s (ASCII-only): [ \t\n\v\f\r]
+  bool word[256] = {};   // Ruby \w (ASCII-only): [A-Za-z0-9_]
+  bool tok[256] = {};    // wordset token unit: \w, '/', '-'
+  constexpr ByteTables() {
+    space[' '] = space['\t'] = space['\n'] = space['\v'] = space['\f'] =
+        space['\r'] = true;
+    for (int c = 0; c < 256; ++c)
+      word[c] = (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+                (c >= '0' && c <= '9') || c == '_';
+    for (int c = 0; c < 256; ++c) tok[c] = word[c] || c == '/' || c == '-';
+  }
+};
+
+inline constexpr ByteTables kBT{};
+
+inline bool is_space(unsigned char c) { return kBT.space[c]; }
+inline bool is_word(unsigned char c) { return kBT.word[c]; }
+
+// length of the dash token at p (end exclusive), 0 if none.
+// tokens: '-' (1 byte), U+2013 "\xe2\x80\x93", U+2014 "\xe2\x80\x94"
+inline size_t dash_token(const char *p, const char *end) {
+  if (p >= end) return 0;
+  if (*p == '-') return 1;
+  if (end - p >= 3 && static_cast<unsigned char>(p[0]) == 0xe2 &&
+      static_cast<unsigned char>(p[1]) == 0x80 &&
+      (static_cast<unsigned char>(p[2]) == 0x93 ||
+       static_cast<unsigned char>(p[2]) == 0x94))
+    return 3;
+  return 0;
+}
+
+// quote tokens: ` ' " (1 byte) and U+2018/19/1C/1D (3 bytes)
+inline size_t quote_token(const char *p, const char *end) {
+  if (p >= end) return 0;
+  if (*p == '`' || *p == '\'' || *p == '"') return 1;
+  if (end - p >= 3 && static_cast<unsigned char>(p[0]) == 0xe2 &&
+      static_cast<unsigned char>(p[1]) == 0x80) {
+    unsigned char c = static_cast<unsigned char>(p[2]);
+    if (c == 0x98 || c == 0x99 || c == 0x9c || c == 0x9d) return 3;
+  }
+  return 0;
+}
+
+inline bool is_strippable(unsigned char c) { return is_space(c) || c == '\0'; }
+
+// Does squeeze(' ').strip leave s unchanged?  (No interior double space,
+// no strippable end bytes.)  Used by the pipeline to skip no-op passes.
+inline bool is_squeezed_clean(const char *data, size_t len) {
+  if (len == 0) return true;
+  if (is_strippable(data[0]) || is_strippable(data[len - 1])) return false;
+  return memmem(data, len, "  ", 2) == nullptr;
+}
+
+// Ruby `squeeze(' ').strip`: collapse runs of the SPACE character only,
+// then strip [ \t\n\v\f\r\0] from both ends (String#strip includes NUL).
+// (strip commutes with the interior squeeze, so ends are trimmed first
+// and the interior is copied span-wise between double-space sites.)
+inline std::string squeeze_strip(const char *data, size_t len) {
+  size_t a = 0, b = len;
+  while (a < b && is_strippable(data[a])) ++a;
+  while (b > a && is_strippable(data[b - 1])) --b;
+  std::string out;
+  out.reserve(b - a);
+  size_t i = a;
+  while (i < b) {
+    const char *dbl =
+        static_cast<const char *>(memmem(data + i, b - i, "  ", 2));
+    if (!dbl) {
+      out.append(data + i, b - i);
+      break;
+    }
+    size_t pos = static_cast<size_t>(dbl - data);
+    out.append(data + i, pos - i + 1);  // keep one space of the run
+    i = pos;
+    while (i < b && data[i] == ' ') ++i;
+  }
+  return out;
+}
+
+// gsub(/\s+/, ' ') then squeeze(' ').strip — the full whitespace strip
+// pass (`_plain_strip(c, REGEXES['whitespace'])`) in one scan.  Output
+// never exceeds input, so it is built with raw stores into a
+// pre-sized buffer.
+inline std::string strip_whitespace(const char *data, size_t len) {
+  if (len == 0) return std::string();
+  std::string out;
+  out.resize(len);
+  char *base = &out[0];
+  char *dst = base;
+  size_t i = 0;
+  while (i < len) {
+    char ch = data[i++];
+    if (kBT.space[static_cast<unsigned char>(ch)]) {
+      while (i < len && kBT.space[static_cast<unsigned char>(data[i])]) ++i;
+      *dst++ = ' ';  // squeeze makes the double-space case moot
+    } else {
+      *dst++ = ch;
+    }
+  }
+  const char *a = base, *b = dst;
+  while (a < b && is_strippable(*a)) ++a;
+  while (b > a && is_strippable(b[-1])) --b;
+  return std::string(a, b - a);
+}
+
+// gsub(/(?<=[^\n])([—–-]+)(?=[^\n])/, '-'): collapse dash runs, with the
+// regex's exact backtracking behavior at line boundaries:
+//   * a run must be preceded by a non-newline char (else its first token
+//     is skipped and the rule applies to the remainder of the run);
+//   * a run followed by newline/EOS keeps its final token (the lookahead
+//     forces the greedy quantifier to back off one token).
+inline std::string dashes(const char *data, size_t len) {
+  std::string out;
+  out.reserve(len);
+  const char *p = data;
+  const char *end = data + len;
+  while (p < end) {
+    // span copy up to the next dash candidate ('-' or the 0xe2 lead byte
+    // of the en/em dashes)
+    const char *start = p;
+    while (p < end) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '-' || c == 0xe2) break;
+      ++p;
+    }
+    out.append(start, p - start);
+    if (p >= end) break;
+    size_t t = dash_token(p, end);
+    if (!t) {
+      out.push_back(*p++);  // bare 0xe2 that is not a dash
+      continue;
+    }
+    // the lookbehind (?<=[^\n]) examines the SUBJECT, so the previous
+    // input byte decides (match positions never sit inside a run because
+    // the quantifier is greedy and sub scans left to right)
+    bool prev_is_newline_or_bos = (p == data) || (p[-1] == '\n');
+    // collect the maximal run
+    std::vector<size_t> tokens;
+    const char *q = p;
+    while (size_t tt = dash_token(q, end)) {
+      tokens.push_back(tt);
+      q += tt;
+    }
+    size_t n = tokens.size();
+    size_t start_tok = prev_is_newline_or_bos ? 1 : 0;  // skip t1 if no lookbehind
+    bool followed = (q < end) && (*q != '\n');
+
+    if (start_tok >= n) {
+      // no matchable tokens: emit run verbatim
+      out.append(p, q - p);
+    } else if (followed) {
+      // tokens[0:start_tok] verbatim, rest -> '-'
+      const char *r = p;
+      for (size_t k = 0; k < start_tok; ++k) r += tokens[k];
+      out.append(p, r - p);
+      out.push_back('-');
+    } else if (n - start_tok >= 2) {
+      // lookahead fails at run end: last token survives
+      const char *r = p;
+      for (size_t k = 0; k < start_tok; ++k) r += tokens[k];
+      out.append(p, r - p);
+      out.push_back('-');
+      out.append(q - tokens[n - 1], tokens[n - 1]);
+    } else {
+      out.append(p, q - p);
+    }
+    p = q;
+  }
+  return out;
+}
+
+// gsub(/[`'"‘“’”]/, "'") — output never grows (3-byte curly quotes fold
+// to one byte), so raw stores into a pre-sized buffer.
+inline std::string quotes(const char *data, size_t len) {
+  if (len == 0) return std::string();
+  std::string out;
+  out.resize(len);
+  char *base = &out[0];
+  char *dst = base;
+  const char *end = data + len;
+  const char *p = data;
+  while (p < end) {
+    unsigned char c = static_cast<unsigned char>(*p);
+    if (c == '`' || c == '\'' || c == '"') {
+      *dst++ = '\'';
+      ++p;
+    } else if (c == 0xe2) {
+      size_t t = quote_token(p, end);
+      if (t) {
+        *dst++ = '\'';
+        p += t;
+      } else {
+        *dst++ = *p++;
+      }
+    } else {
+      *dst++ = *p++;
+    }
+  }
+  out.resize(dst - base);
+  return out;
+}
+
+// gsub(/(\w+)-\s*\n\s*(\w+)/, '\1-\2'): join words hyphenated across a
+// line break.  Scanning resumes at match END, exactly like re.sub: the
+// \w+ consumed as a match's group 2 is past the resume point and can
+// never serve as the NEXT match's group 1 ("e-\nc-\n0" keeps its second
+// break) — `eligible_from` tracks that frontier.
+inline std::string hyphenated(const char *data, size_t len) {
+  std::string out;
+  out.reserve(len);
+  size_t i = 0;
+  size_t eligible_from = 0;  // group-1 chars must sit at/after this index
+  while (i < len) {
+    // span copy up to the next '-'
+    const char *dash =
+        static_cast<const char *>(std::memchr(data + i, '-', len - i));
+    if (!dash) {
+      out.append(data + i, len - i);
+      break;
+    }
+    size_t pos = static_cast<size_t>(dash - data);
+    out.append(data + i, pos - i);
+    i = pos;
+    if (i == 0 || i <= eligible_from || !is_word(data[i - 1])) {
+      out.push_back('-');
+      ++i;
+      continue;
+    }
+    // candidate: '-' preceded by an eligible word char.  Look ahead:
+    // \s* containing at least one '\n', then a word char.
+    size_t j = i + 1;
+    bool saw_newline = false;
+    while (j < len && is_space(data[j])) {
+      if (data[j] == '\n') saw_newline = true;
+      ++j;
+    }
+    if (saw_newline && j < len && is_word(data[j])) {
+      // match: emit '-', then group 2 = the maximal word run, whose end
+      // is the regex resume point
+      out.push_back('-');
+      size_t k = j;
+      while (k < len && is_word(data[k])) out.push_back(data[k++]);
+      i = k;
+      eligible_from = k;
+    } else {
+      out.push_back('-');
+      ++i;
+    }
+  }
+  return out;
+}
+
+// gsub(/\b(?:variant1|variant2|...)\b/) { VARIETAL_WORDS[match] } — the
+// SPDX spelling folds.  Alternation order is the insertion order of the
+// table (first alternative whose end lands on a word boundary wins).
+// The table arrives from Python as flat "from\0to\0from\0to\0..." so the
+// single source of truth stays in pipeline.py.
+struct Spelling {
+  std::vector<std::string> from, to;
+  // two-byte dispatch: an 8 KiB bitmap (L1-resident) gates a compact
+  // sorted (pair-key, variant-index) array (a few hundred bytes, also
+  // L1-resident — a 64K-bucket table would miss cache at 40% of word
+  // starts, since variant prefixes like "co"/"an"/"wi" are shared by the
+  // commonest English words).  Every variant is ≥2 bytes, so one-char
+  // words can never match; within a pair the array preserves table order
+  // (= alternation order).
+  std::vector<std::pair<uint16_t, uint16_t>> pair_cands;  // sorted by key
+  uint64_t pair_bits[1024] = {};
+
+  void load(const char *table, size_t table_len) {
+    size_t i = 0;
+    while (i < table_len) {
+      const char *f = table + i;
+      size_t fl = std::strlen(f);
+      i += fl + 1;
+      const char *t = table + i;
+      size_t tl = std::strlen(t);
+      i += tl + 1;
+      from.emplace_back(f, fl);
+      to.emplace_back(t, tl);
+    }
+    for (uint32_t k = 0; k < from.size(); ++k) {
+      uint16_t key = static_cast<uint16_t>(
+          (static_cast<unsigned char>(from[k][0]) << 8) |
+          static_cast<unsigned char>(from[k][1]));
+      pair_cands.emplace_back(key, static_cast<uint16_t>(k));
+      pair_bits[key >> 6] |= 1ull << (key & 63);
+    }
+    std::stable_sort(pair_cands.begin(), pair_cands.end(),
+                     [](const auto &a, const auto &b) {
+                       return a.first < b.first;
+                     });
+  }
+
+  std::string run(const char *data, size_t len) const {
+    // A match can only begin at a word boundary followed by a word char,
+    // so walk word starts and bulk-copy everything else.
+    std::string out;
+    size_t i = 0;
+    size_t emitted = 0;  // everything before this input index is in `out`
+    while (i < len) {
+      // skip the gap to the next word start
+      while (i < len && !is_word(data[i])) ++i;
+      if (i >= len) break;
+      bool replaced = false;
+      if (i + 1 < len) {
+        uint16_t key = static_cast<uint16_t>(
+            (static_cast<unsigned char>(data[i]) << 8) |
+            static_cast<unsigned char>(data[i + 1]));
+        if (!(pair_bits[key >> 6] & (1ull << (key & 63)))) {
+          while (i < len && is_word(data[i])) ++i;
+          continue;
+        }
+        auto it = std::lower_bound(
+            pair_cands.begin(), pair_cands.end(), key,
+            [](const auto &a, uint16_t k) { return a.first < k; });
+        for (; it != pair_cands.end() && it->first == key; ++it) {
+          uint32_t k = it->second;
+          const std::string &f = from[k];
+          if (i + f.size() <= len &&
+              std::memcmp(data + i, f.data(), f.size()) == 0) {
+            // \b after: end of input or non-word char next (every variant
+            // ends with a word char)
+            if (i + f.size() == len || !is_word(data[i + f.size()])) {
+              if (out.empty() && emitted == 0) out.reserve(len + 16);
+              out.append(data + emitted, i - emitted);
+              out.append(to[k]);
+              i += f.size();
+              emitted = i;
+              replaced = true;
+              break;
+            }
+          }
+        }
+      }
+      // after a replacement the scan is mid-word (variants end in a word
+      // char); either way skip to the end of the current word — the next
+      // match needs a fresh word boundary
+      while (i < len && is_word(data[i])) ++i;
+      (void)replaced;
+    }
+    if (emitted == 0) return std::string(data, len);
+    out.append(data + emitted, len - emitted);
+    return out;
+  }
+};
+
+// Token hash used by the wordset uniqueness table, the vocab map and the
+// Exact-matcher multiset hash.  8-byte chunks instead of byte-serial FNV:
+// the multiply chain is per-chunk, so short tokens cost ~2 multiplies.
+// Internal to the native layer — Python only ever sees hashes computed
+// here (pipe_exact_hash / pipe_featurize), so the function just has to be
+// deterministic and consistent across the .so.
+inline uint64_t token_hash(const char *p, size_t n) {
+  uint64_t h = 0x9e3779b97f4a7c15ull ^ (n * 0xff51afd7ed558ccdull);
+  while (n >= 8) {
+    uint64_t k;
+    std::memcpy(&k, p, 8);
+    h = (h ^ k) * 0x9ddfea08eb382d69ull;
+    h ^= h >> 29;
+    p += 8;
+    n -= 8;
+  }
+  if (n) {
+    uint64_t k = 0;
+    std::memcpy(&k, p, n);
+    h = (h ^ k) * 0x9ddfea08eb382d69ull;
+    h ^= h >> 29;
+  }
+  return h;
+}
+
+// The wordset token regex (content_helper.rb:109):
+//   (?:[\w/-](?:'s|(?<=s)')?)+
+// i.e. runs of [A-Za-z0-9_/-] units, where a unit may be followed by "'s",
+// or by a bare "'" when the unit char itself is 's'.  Collects the UNIQUE
+// tokens (first-seen order) as (offset, length) slices into `data`.
+struct Slice {
+  size_t off, len;
+};
+
+// Scan for unique tokens; FNV-1a64 of each token is computed inline during
+// the scan (per-token hashes land in `hashes_out` when non-null) so that
+// downstream consumers (vocab lookup, the Exact-matcher multiset hash)
+// never re-read the bytes.
+inline std::vector<Slice> wordset_unique(const char *data, size_t len,
+                                         std::vector<uint64_t> *hashes_out =
+                                             nullptr) {
+  auto is_tok = [](unsigned char c) {
+    return is_word(c) || c == '/' || c == '-';
+  };
+  std::vector<Slice> uniques;
+  // compact flat open-addressing scratch (12B entries, cache-friendly),
+  // thread_local so worker threads in the ingestion pipeline never
+  // contend; cleared per call (memset of ≤~100 KiB is cheap)
+  struct Entry {
+    uint32_t off_plus1;  // 0 = empty
+    uint32_t len;
+    uint32_t tag;        // upper 32 bits of the token hash
+  };
+  thread_local std::vector<Entry> table;
+  size_t want = 64;
+  // unique tokens ≈ len/8..len/6 for license text; keep load ≤ ~0.6
+  while (want < len / 4) want <<= 1;
+  if (table.size() < want) table.resize(want);
+  std::memset(table.data(), 0, want * sizeof(Entry));
+  size_t mask = want - 1;  // probes stay within the cleared prefix
+  std::vector<uint64_t> local_hashes;
+  std::vector<uint64_t> *hs = hashes_out ? hashes_out : &local_hashes;
+  size_t inserted = 0;
+  // pathological inputs (runs of 1-char tokens) can exceed the len/4
+  // estimate: double + rehash from the collected uniques when load > 0.7
+  auto grow = [&]() {
+    want <<= 1;
+    if (table.size() < want) table.resize(want);
+    std::memset(table.data(), 0, want * sizeof(Entry));
+    mask = want - 1;
+    for (size_t k = 0; k < uniques.size(); ++k) {
+      uint64_t hh = (*hs)[k];
+      size_t s2 = hh & mask;
+      while (table[s2].off_plus1) s2 = (s2 + 1) & mask;
+      table[s2] = Entry{static_cast<uint32_t>(uniques[k].off + 1),
+                        static_cast<uint32_t>(uniques[k].len),
+                        static_cast<uint32_t>(hh >> 32)};
+    }
+  };
+  size_t i = 0;
+  while (i < len) {
+    if (!is_tok(data[i])) {
+      ++i;
+      continue;
+    }
+    size_t start = i;
+    while (i < len) {
+      if (is_tok(data[i])) {
+        char c = data[i];
+        ++i;
+        // optional apostrophe suffix after this unit char
+        if (i < len && data[i] == '\'') {
+          if (i + 1 < len && data[i + 1] == 's') {
+            // "'s" — the regex consumes "'s" whenever present after a
+            // unit char
+            i += 2;
+          } else if (c == 's') {
+            i += 1;  // (?<=s)'
+          }
+        }
+      } else {
+        break;
+      }
+    }
+    size_t n = i - start;
+    uint64_t h = token_hash(data + start, n);
+    size_t slot = h & mask;
+    const uint32_t tag = static_cast<uint32_t>(h >> 32);
+    bool seen = false;
+    while (table[slot].off_plus1) {
+      const Entry &e = table[slot];
+      if (e.tag == tag && e.len == n &&
+          std::memcmp(data + e.off_plus1 - 1, data + start, n) == 0) {
+        seen = true;
+        break;
+      }
+      slot = (slot + 1) & mask;
+    }
+    if (!seen) {
+      table[slot] = Entry{static_cast<uint32_t>(start + 1),
+                          static_cast<uint32_t>(n), tag};
+      uniques.push_back({start, n});
+      hs->push_back(h);
+      if (++inserted * 10 > want * 7) grow();
+    }
+  }
+  return uniques;
+}
+
+}  // namespace licensee_scanners
+
+#endif  // LICENSEE_TPU_SCANNERS_H_

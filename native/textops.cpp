@@ -2,13 +2,9 @@
 //
 // The normalization pipeline (licensee_tpu/normalize/pipeline.py, parity
 // target lib/licensee/content_helper.rb) is the host-side bottleneck of
-// batch ingestion: ~34 ordered regex substitutions per blob.  The five
-// passes implemented here account for ~60% of that time and are all
-// expressible as single-scan byte automata with EXACTLY the same output
-// as the Ruby/Python regexes (all character classes are ASCII under
-// Ruby semantics / re.A; the only multi-byte characters involved are
-// the literal Unicode dashes and quotes, matched as fixed UTF-8
-// sequences).
+// batch ingestion.  The scanner bodies live in scanners.h (shared with
+// the whole-pipeline pipeline.cpp); this file is the per-pass ctypes
+// surface used by the hybrid Python path.
 //
 // Every function takes (data, len) and returns a malloc'd buffer + length
 // (free with top_free); inputs are treated as opaque bytes, so embedded
@@ -16,25 +12,15 @@
 // tests/test_textops.py; the end-to-end oracle is the license-hash golden
 // corpus.
 
-#include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <string>
-#include <vector>
+
+#include "scanners.h"
+
+namespace sc = licensee_scanners;
 
 namespace {
-
-// Ruby \s (ASCII-only): [ \t\n\v\f\r]
-inline bool is_space(unsigned char c) {
-  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
-         c == '\r';
-}
-
-// Ruby \w (ASCII-only): [A-Za-z0-9_]
-inline bool is_word(unsigned char c) {
-  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
-         (c >= '0' && c <= '9') || c == '_';
-}
 
 char *to_buf(const std::string &s, size_t *out_len) {
   char *buf = static_cast<char *>(std::malloc(s.size() ? s.size() : 1));
@@ -43,324 +29,55 @@ char *to_buf(const std::string &s, size_t *out_len) {
   return buf;
 }
 
-// length of the dash token at p (end exclusive), 0 if none.
-// tokens: '-' (1 byte), U+2013 "\xe2\x80\x93", U+2014 "\xe2\x80\x94"
-inline size_t dash_token(const char *p, const char *end) {
-  if (p >= end) return 0;
-  if (*p == '-') return 1;
-  if (end - p >= 3 && static_cast<unsigned char>(p[0]) == 0xe2 &&
-      static_cast<unsigned char>(p[1]) == 0x80 &&
-      (static_cast<unsigned char>(p[2]) == 0x93 ||
-       static_cast<unsigned char>(p[2]) == 0x94))
-    return 3;
-  return 0;
-}
-
-// quote tokens: ` ' " (1 byte) and U+2018/19/1C/1D (3 bytes)
-inline size_t quote_token(const char *p, const char *end) {
-  if (p >= end) return 0;
-  if (*p == '`' || *p == '\'' || *p == '"') return 1;
-  if (end - p >= 3 && static_cast<unsigned char>(p[0]) == 0xe2 &&
-      static_cast<unsigned char>(p[1]) == 0x80) {
-    unsigned char c = static_cast<unsigned char>(p[2]);
-    if (c == 0x98 || c == 0x99 || c == 0x9c || c == 0x9d) return 3;
-  }
-  return 0;
-}
-
 }  // namespace
 
 extern "C" {
 
 void top_free(void *p) { std::free(p); }
 
-// Ruby `squeeze(' ').strip`: collapse runs of the SPACE character only,
-// then strip [ \t\n\v\f\r\0] from both ends (String#strip includes NUL).
 char *top_squeeze_strip(const char *data, size_t len, size_t *out_len) {
-  std::string out;
-  out.reserve(len);
-  for (size_t i = 0; i < len; ++i) {
-    if (data[i] == ' ' && !out.empty() && out.back() == ' ') continue;
-    out.push_back(data[i]);
-  }
-  size_t a = 0, b = out.size();
-  auto strippable = [](unsigned char c) { return is_space(c) || c == '\0'; };
-  while (a < b && strippable(out[a])) ++a;
-  while (b > a && strippable(out[b - 1])) --b;
-  return to_buf(out.substr(a, b - a), out_len);
+  return to_buf(sc::squeeze_strip(data, len), out_len);
 }
 
-// gsub(/\s+/, ' ') then squeeze(' ').strip — the full whitespace strip
-// pass (`_plain_strip(c, REGEXES['whitespace'])`) in one scan.
 char *top_strip_whitespace(const char *data, size_t len, size_t *out_len) {
-  std::string out;
-  out.reserve(len);
-  size_t i = 0;
-  while (i < len) {
-    if (is_space(data[i])) {
-      while (i < len && is_space(data[i])) ++i;
-      out.push_back(' ');  // squeeze makes the double-space case moot
-    } else {
-      out.push_back(data[i++]);
-    }
-  }
-  size_t a = 0, b = out.size();
-  auto strippable = [](unsigned char c) { return is_space(c) || c == '\0'; };
-  while (a < b && strippable(out[a])) ++a;
-  while (b > a && strippable(out[b - 1])) --b;
-  return to_buf(out.substr(a, b - a), out_len);
+  return to_buf(sc::strip_whitespace(data, len), out_len);
 }
 
-// gsub(/(?<=[^\n])([—–-]+)(?=[^\n])/, '-'): collapse dash runs, with the
-// regex's exact backtracking behavior at line boundaries:
-//   * a run must be preceded by a non-newline char (else its first token
-//     is skipped and the rule applies to the remainder of the run);
-//   * a run followed by newline/EOS keeps its final token (the lookahead
-//     forces the greedy quantifier to back off one token).
 char *top_dashes(const char *data, size_t len, size_t *out_len) {
-  std::string out;
-  out.reserve(len);
-  const char *p = data;
-  const char *end = data + len;
-  bool prev_is_newline_or_bos = true;
-  while (p < end) {
-    size_t t = dash_token(p, end);
-    if (!t) {
-      prev_is_newline_or_bos = (*p == '\n');
-      out.push_back(*p++);
-      continue;
-    }
-    // collect the maximal run
-    std::vector<size_t> tokens;
-    const char *q = p;
-    while (size_t tt = dash_token(q, end)) {
-      tokens.push_back(tt);
-      q += tt;
-    }
-    size_t n = tokens.size();
-    size_t start_tok = prev_is_newline_or_bos ? 1 : 0;  // skip t1 if no lookbehind
-    bool followed = (q < end) && (*q != '\n');
-
-    if (start_tok >= n) {
-      // no matchable tokens: emit run verbatim
-      out.append(p, q - p);
-    } else if (followed) {
-      // tokens[0:start_tok] verbatim, rest -> '-'
-      const char *r = p;
-      for (size_t k = 0; k < start_tok; ++k) r += tokens[k];
-      out.append(p, r - p);
-      out.push_back('-');
-    } else if (n - start_tok >= 2) {
-      // lookahead fails at run end: last token survives
-      const char *r = p;
-      for (size_t k = 0; k < start_tok; ++k) r += tokens[k];
-      out.append(p, r - p);
-      out.push_back('-');
-      out.append(q - tokens[n - 1], tokens[n - 1]);
-    } else {
-      out.append(p, q - p);
-    }
-    p = q;
-    prev_is_newline_or_bos = false;  // runs never contain '\n'
-  }
-  return to_buf(out, out_len);
+  return to_buf(sc::dashes(data, len), out_len);
 }
 
-// gsub(/[`'"‘“’”]/, "'")
 char *top_quotes(const char *data, size_t len, size_t *out_len) {
-  std::string out;
-  out.reserve(len);
-  const char *p = data;
-  const char *end = data + len;
-  while (p < end) {
-    size_t t = quote_token(p, end);
-    if (t) {
-      out.push_back('\'');
-      p += t;
-    } else {
-      out.push_back(*p++);
-    }
-  }
-  return to_buf(out, out_len);
+  return to_buf(sc::quotes(data, len), out_len);
 }
 
-// gsub(/(\w+)-\s*\n\s*(\w+)/, '\1-\2'): join words hyphenated across a
-// line break.  Scanning resumes at match END, exactly like re.sub: the
-// \w+ consumed as a match's group 2 is past the resume point and can
-// never serve as the NEXT match's group 1 ("e-\nc-\n0" keeps its second
-// break) — `eligible_from` tracks that frontier.
 char *top_hyphenated(const char *data, size_t len, size_t *out_len) {
-  std::string out;
-  out.reserve(len);
-  size_t i = 0;
-  size_t eligible_from = 0;  // group-1 chars must sit at/after this index
-  while (i < len) {
-    char c = data[i];
-    if (c != '-' || i == 0 || i <= eligible_from ||
-        !is_word(data[i - 1])) {
-      out.push_back(c);
-      ++i;
-      continue;
-    }
-    // candidate: '-' preceded by an eligible word char.  Look ahead:
-    // \s* containing at least one '\n', then a word char.
-    size_t j = i + 1;
-    bool saw_newline = false;
-    while (j < len && is_space(data[j])) {
-      if (data[j] == '\n') saw_newline = true;
-      ++j;
-    }
-    if (saw_newline && j < len && is_word(data[j])) {
-      // match: emit '-', then group 2 = the maximal word run, whose end
-      // is the regex resume point
-      out.push_back('-');
-      size_t k = j;
-      while (k < len && is_word(data[k])) out.push_back(data[k++]);
-      i = k;
-      eligible_from = k;
-    } else {
-      out.push_back(c);
-      ++i;
-    }
-  }
-  return to_buf(out, out_len);
+  return to_buf(sc::hyphenated(data, len), out_len);
 }
-
-// gsub(/\b(?:variant1|variant2|...)\b/) { VARIETAL_WORDS[match] } — the
-// SPDX spelling folds.  Alternation order is the insertion order of the
-// table (first alternative whose end lands on a word boundary wins).
-// The table is passed in from Python as flat "from\0to\0from\0to\0..."
-// so the single source of truth stays in pipeline.py.
-struct Spelling {
-  std::vector<std::string> from, to;
-  // first-byte dispatch: indexes of variants starting with byte b
-  std::vector<std::vector<uint32_t>> by_first;
-};
 
 void *top_spelling_new(const char *table, size_t table_len) {
-  auto *sp = new Spelling();
-  size_t i = 0;
-  while (i < table_len) {
-    const char *f = table + i;
-    size_t fl = std::strlen(f);
-    i += fl + 1;
-    const char *t = table + i;
-    size_t tl = std::strlen(t);
-    i += tl + 1;
-    sp->from.emplace_back(f, fl);
-    sp->to.emplace_back(t, tl);
-  }
-  sp->by_first.resize(256);
-  for (uint32_t k = 0; k < sp->from.size(); ++k)
-    sp->by_first[static_cast<unsigned char>(sp->from[k][0])].push_back(k);
+  auto *sp = new sc::Spelling();
+  sp->load(table, table_len);
   return sp;
 }
 
-void top_spelling_del(void *handle) { delete static_cast<Spelling *>(handle); }
+void top_spelling_del(void *handle) {
+  delete static_cast<sc::Spelling *>(handle);
+}
 
 char *top_spelling(void *handle, const char *data, size_t len,
                    size_t *out_len) {
-  auto *sp = static_cast<Spelling *>(handle);
-  std::string out;
-  out.reserve(len);
-  size_t i = 0;
-  bool prev_word = false;  // was data[i-1] a word char?
-  while (i < len) {
-    unsigned char c = data[i];
-    // \b before the match: position must be a word boundary with a word
-    // char following (every variant starts with a word char)
-    if (!prev_word && is_word(c)) {
-      const auto &cands = sp->by_first[c];
-      bool replaced = false;
-      for (uint32_t k : cands) {
-        const std::string &f = sp->from[k];
-        if (i + f.size() <= len && std::memcmp(data + i, f.data(), f.size()) == 0) {
-          // \b after: end of input or non-word char next (every variant
-          // ends with a word char)
-          if (i + f.size() == len || !is_word(data[i + f.size()])) {
-            out.append(sp->to[k]);
-            i += f.size();
-            prev_word = true;  // variants end in a word char
-            replaced = true;
-            break;
-          }
-        }
-      }
-      if (replaced) continue;
-    }
-    prev_word = is_word(c);
-    out.push_back(static_cast<char>(c));
-    ++i;
-  }
-  return to_buf(out, out_len);
+  auto *sp = static_cast<sc::Spelling *>(handle);
+  return to_buf(sp->run(data, len), out_len);
 }
 
-}  // extern "C"
-
-extern "C" {
-
-// The wordset token regex (content_helper.rb:109):
-//   (?:[\w/-](?:'s|(?<=s)')?)+
-// i.e. runs of [A-Za-z0-9_/-] units, where a unit may be followed by "'s",
-// or by a bare "'" when the unit char itself is 's'.  Emits the UNIQUE
-// tokens (first-seen order), '\0'-joined, for Python to frozenset().
+// Emits the UNIQUE wordset tokens (first-seen order), '\0'-joined, for
+// Python to frozenset().
 char *top_wordset(const char *data, size_t len, size_t *out_len) {
-  auto is_tok = [](unsigned char c) {
-    return is_word(c) || c == '/' || c == '-';
-  };
   std::string out;
-  // open-addressing set of string views into `out` would dangle on
-  // realloc; a simple hash set of offsets+lens into `data` works because
-  // tokens are contiguous in the input... except the apostrophe forms
-  // make tokens contiguous substrings of the input anyway.
-  struct Slice { size_t off, len; };
-  std::vector<std::vector<Slice>> buckets(1 << 12);
-  auto hash = [&](const char *p, size_t n) {
-    uint64_t h = 1469598103934665603ull;
-    for (size_t k = 0; k < n; ++k)
-      h = (h ^ static_cast<unsigned char>(p[k])) * 1099511628211ull;
-    return h;
-  };
-  size_t i = 0;
-  while (i < len) {
-    if (!is_tok(data[i])) {
-      ++i;
-      continue;
-    }
-    size_t start = i;
-    while (i < len) {
-      if (is_tok(data[i])) {
-        char c = data[i];
-        ++i;
-        // optional apostrophe suffix after this unit char
-        if (i < len && data[i] == '\'') {
-          if (i + 1 < len && data[i + 1] == 's' ) {
-            // "'s" — but only if it keeps the token going or ends it; the
-            // regex consumes "'s" whenever present after a unit char
-            i += 2;
-          } else if (c == 's') {
-            i += 1;  // (?<=s)'
-          }
-        }
-      } else {
-        break;
-      }
-    }
-    size_t n = i - start;
-    uint64_t h = hash(data + start, n);
-    auto &bucket = buckets[h & (buckets.size() - 1)];
-    bool seen = false;
-    for (const Slice &s : bucket) {
-      if (s.len == n && std::memcmp(data + s.off, data + start, n) == 0) {
-        seen = true;
-        break;
-      }
-    }
-    if (!seen) {
-      bucket.push_back({start, n});
-      if (!out.empty()) out.push_back('\0');
-      out.append(data + start, n);
-    }
+  for (const sc::Slice &s : sc::wordset_unique(data, len)) {
+    if (!out.empty()) out.push_back('\0');
+    out.append(data + s.off, s.len);
   }
   return to_buf(out, out_len);
 }

@@ -1,0 +1,158 @@
+"""Differential tests: the whole-pipeline native path (native/pipeline.cpp)
+must be byte-identical to the Python normalization pipeline on every
+fixture, every rendered vendored template, and adversarial inputs.
+
+The native path is PCRE2 + hand-coded scanners; the Python path is the
+re-module pipeline (which itself is pinned to the Ruby reference by the
+SHA1 golden corpus in tests/test_normalize_hashes.py).  Equality here
+therefore chains the native path to the Ruby goldens.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from licensee_tpu.rubytext import ruby_strip
+
+
+def _native():
+    try:
+        from licensee_tpu.native import pipeline as npipe
+
+        return npipe.load()
+    except Exception:
+        return None
+
+
+nat = _native()
+pytestmark = pytest.mark.skipif(
+    nat is None, reason="native pipeline unavailable (no toolchain/libpcre2)"
+)
+
+from licensee_tpu.kernels.batch import NormalizedBlob  # noqa: E402
+from tests.conftest import FIXTURES_DIR  # noqa: E402
+
+
+def _fixture_files():
+    out = []
+    for d in sorted(glob.glob(os.path.join(FIXTURES_DIR, "*"))):
+        if os.path.isdir(d):
+            for f in sorted(glob.glob(os.path.join(d, "*"))):
+                if os.path.isfile(f):
+                    out.append(f)
+    return out
+
+
+ADVERSARIAL = [
+    b"",
+    b"\xef\xbb\xbfMIT License",
+    ("a b c d e f g h " * 2000).encode(),  # 1-char-token table growth
+    "licença ática—«q» d'été's ’s".encode(),
+    b"Copyright (c) 2024 Example\nAll rights reserved.",
+    b"http://example.com & http://other.example\n\n- item one\n\n- item two",
+    b"== Title ==\n*emphasis* [link](http://x) `code`\n> quoted\n\nEnd of terms and conditions",
+    b"word-\ncontinued hyphen-\n  ated licence favour organisation",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    from licensee_tpu.corpus.compiler import default_corpus
+
+    corpus = default_corpus()
+    return corpus, nat.vocab(list(corpus.vocab.keys()), corpus.n_lanes)
+
+
+def _cases():
+    cases = [(p, open(p, "rb").read()) for p in _fixture_files()]
+    import re
+
+    from licensee_tpu.corpus.license import License
+
+    for lic in License.all(hidden=True, pseudo=False):
+        rendered = re.sub(r"\[(\w+)\]", "example", lic.content or "")
+        cases.append((f"template:{lic.key}", rendered.encode()))
+    cases += [(f"adversarial:{i}", raw) for i, raw in enumerate(ADVERSARIAL)]
+    return cases
+
+
+@pytest.mark.parametrize("name,raw", _cases(), ids=[c[0] for c in _cases()])
+def test_native_pipeline_matches_python(name, raw, vocab):
+    corpus, vh = vocab
+    blob = NormalizedBlob(raw)
+    stripped = ruby_strip(blob.content or "")
+
+    s1, flags = nat.stage1(stripped)
+    assert s1 == blob.content_without_title_and_version
+
+    assert nat.stage2(s1.lower()) == blob.content_normalized()
+
+    bits, n_words, length, h = nat.featurize(vh, s1.lower())
+    py_bits, py_nw, py_len = corpus.file_features(blob)
+    assert np.array_equal(bits, py_bits)
+    assert n_words == len(blob.wordset or ())
+    assert length == blob.length
+
+    # the one-crossing ASCII fast path must agree with the two-crossing path
+    fast = nat.featurize_raw(vh, stripped)
+    if fast is not None:
+        fbits, fnw, flen, fflags, fh = fast
+        assert np.array_equal(fbits, bits)
+        assert (fnw, flen, fh) == (n_words, length, h)
+        assert fflags == flags
+
+    # prefilter flags == the Python regexes
+    from licensee_tpu.normalize.pipeline import COPYRIGHT_FULL_REGEX
+    from licensee_tpu.project_files.license_file import CC_FALSE_POSITIVE_REGEX
+
+    py_flags = (1 if COPYRIGHT_FULL_REGEX.search(stripped) else 0) | (
+        2 if CC_FALSE_POSITIVE_REGEX.search(stripped) else 0
+    )
+    assert flags == py_flags
+
+    # wordset multiset-hash round trip (the Exact prefilter oracle)
+    if blob.wordset is not None:
+        assert h == nat.exact_hash(blob.wordset)
+
+
+def test_exact_hash_order_independent():
+    a = nat.exact_hash(["alpha", "beta", "gamma"])
+    b = nat.exact_hash(["gamma", "alpha", "beta"])
+    assert a == b
+    assert nat.exact_hash(["alpha", "beta"]) != a
+
+
+def test_classifier_native_matches_python_fallback(monkeypatch):
+    """BatchClassifier must classify identically with and without the
+    native whole-pipeline path."""
+    import re
+
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.kernels import batch as batch_mod
+
+    contents = []
+    for i, lic in enumerate(License.all(hidden=True, pseudo=False)[:12]):
+        text = re.sub(r"\[(\w+)\]", "example", lic.content or "")
+        if i % 3 == 0:
+            text += f"\nnoise words {i} here"
+        contents.append(text.encode())
+    contents.append(b"Copyright (c) 2020 Nobody")
+    contents.append("licença não detectável".encode())
+
+    native_clf = batch_mod.BatchClassifier(pad_batch_to=8)
+    assert native_clf._nat is not None
+    native_results = native_clf.classify_blobs(contents)
+
+    from licensee_tpu.native import pipeline as npipe_mod
+
+    monkeypatch.setattr(npipe_mod, "_instance", None)
+    monkeypatch.setattr(npipe_mod, "_failed", True)  # force the fallback
+    py_clf = batch_mod.BatchClassifier(pad_batch_to=8)
+    assert py_clf._nat is None
+    py_results = py_clf.classify_blobs(contents)
+
+    for n, p in zip(native_results, py_results):
+        assert (n.key, n.matcher) == (p.key, p.matcher)
+        assert n.confidence == pytest.approx(p.confidence, abs=0)
