@@ -254,15 +254,33 @@ def cmd_batch_detect(args) -> int:
         print(f"error: cannot read manifest: {exc}", file=sys.stderr)
         return 1
 
+    mesh = "auto"
+    if args.mesh:
+        if args.mesh == "none":
+            mesh = None
+        else:
+            try:
+                parts = [int(p) for p in args.mesh.split(",")]
+                mesh = (parts[0], parts[1] if len(parts) > 1 else 1)
+            except ValueError:
+                print(f"error: bad --mesh {args.mesh!r} (want DATA[,MODEL])",
+                      file=sys.stderr)
+                return 1
+
     from licensee_tpu.projects.batch_project import BatchProject
 
-    project = BatchProject(
-        paths,
-        method=args.method,
-        batch_size=args.batch_size,
-        workers=args.workers,
-        **kwargs,
-    )
+    try:
+        project = BatchProject(
+            paths,
+            method=args.method,
+            batch_size=args.batch_size,
+            workers=args.workers,
+            mesh=mesh,
+            **kwargs,
+        )
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
 
     profiler = None
     if args.profile:
@@ -278,6 +296,7 @@ def cmd_batch_detect(args) -> int:
             results = project.classifier.classify_blobs(
                 [c if c is not None else b"" for c in contents],
                 threshold=project.threshold,
+                filenames=[os.path.basename(p) for p in paths],
             )
             for path, content, result in zip(paths, contents, results):
                 row = {"path": path, **result.as_dict()}
@@ -286,6 +305,9 @@ def cmd_batch_detect(args) -> int:
                     # failure is not a classification
                     row["error"] = "read_error"
                     project.stats.read_errors += 1
+                elif result.error:
+                    row["error"] = result.error
+                    project.stats.featurize_errors += 1
                 else:
                     project._count(result)
                 project.stats.total += 1
@@ -365,6 +387,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     batch.add_argument("--method", default="popcount",
                        choices=["popcount", "matmul", "pallas"])
+    batch.add_argument(
+        "--mesh", default=None, metavar="DATA[,MODEL]",
+        help=(
+            "Device mesh for the scorer: DATA chips shard the blob batch, "
+            "MODEL chips shard the template matrix vocab-wise (default: "
+            "all visible devices data-parallel; 'none' forces one device)"
+        ),
+    )
     batch.add_argument("--batch-size", type=int, default=4096)
     batch.add_argument("--workers", type=int, default=None,
                        help="Featurization worker threads (default: cpu count)")

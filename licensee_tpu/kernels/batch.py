@@ -38,6 +38,7 @@ class BlobResult:
     confidence: float
     score_num: int = 0
     score_den: int = 0
+    error: str | None = None
 
     def as_dict(self) -> dict:
         return {
@@ -61,6 +62,7 @@ class BatchClassifier:
         corpus: CompiledCorpus | None = None,
         method: str = "popcount",
         pad_batch_to: int = 1024,
+        mesh="auto",
     ):
         from licensee_tpu.kernels.dice_xla import CorpusArrays, make_best_match_fn
 
@@ -68,7 +70,19 @@ class BatchClassifier:
         self.method = method
         self.pad_batch_to = pad_batch_to
         self.arrays = CorpusArrays.from_compiled(self.corpus)
-        if method == "pallas":
+        # Scale-out is the default product path (SURVEY.md §2.7 DP row):
+        # with >1 visible device the scorer is jitted over a
+        # ('data', 'model') mesh so the blob batch shards across chips.
+        # mesh may be a jax Mesh, an (n_data, n_model) tuple, "auto"
+        # (all devices, data-parallel), or None (single device).
+        self.mesh = self._resolve_mesh(mesh, method, pad_batch_to)
+        if self.mesh is not None:
+            from licensee_tpu.parallel.mesh import make_sharded_scorer
+
+            self._fn = make_sharded_scorer(
+                self.arrays, self.mesh, method=method
+            )
+        elif method == "pallas":
             from licensee_tpu.kernels.dice_pallas import (
                 make_best_match_fn_pallas,
             )
@@ -90,12 +104,79 @@ class BatchClassifier:
         self._nat = native_pipeline.load()
         self._nat_vocab = None
         self._exact_hashes: dict[bytes, str] = {}
+        # per-hash confirmation constants: the template's in-vocab bit
+        # projection + |wordset|, a cheap necessary condition checked
+        # before the airtight Python recheck (see _confirm_exact)
+        self._exact_feats: dict[bytes, tuple[np.ndarray, int, str]] = {}
+        self._confirm_cache: dict[bytes, str | bool] = {}
         if self._nat is not None:
+            from licensee_tpu.corpus.compiler import pack_ids
+
             self._nat_vocab = self._nat.vocab(
                 list(self.corpus.vocab.keys()), self.corpus.n_lanes
             )
             for wordset, key in self.corpus.exact_sets.items():
-                self._exact_hashes.setdefault(self._nat.exact_hash(wordset), key)
+                h = self._nat.exact_hash(wordset)
+                if h in self._exact_hashes:
+                    continue
+                self._exact_hashes[h] = key
+                ids = [
+                    self.corpus.vocab[w]
+                    for w in wordset
+                    if w in self.corpus.vocab
+                ]
+                self._exact_feats[h] = (
+                    pack_ids(ids, self.corpus.n_lanes),
+                    len(wordset),
+                    key,
+                )
+
+    @staticmethod
+    def _resolve_mesh(mesh, method: str, pad_batch_to: int):
+        """Resolve the mesh argument to a jax Mesh (or None = single device).
+
+        The dispatch batch is padded to a fixed ``pad_batch_to``, so the
+        data axis must divide it; "auto" shrinks the data axis to the
+        largest device count that does."""
+        if mesh is None:
+            return None
+        from jax.sharding import Mesh
+
+        from licensee_tpu.parallel.mesh import build_mesh
+
+        if isinstance(mesh, Mesh):
+            resolved = mesh
+        elif mesh == "auto":
+            if method == "pallas":
+                # the hand-scheduled pallas kernel drives one chip; DP over
+                # it would need a shard_map wrapper it doesn't have yet
+                return None
+            import jax
+
+            n = len(jax.devices())
+            while pad_batch_to % n:
+                n -= 1
+            if n == 1:
+                return None
+            resolved = build_mesh(n_data=n, n_model=1)
+        else:
+            n_data, n_model = mesh
+            if n_data < 1 or n_model < 1:
+                raise ValueError(
+                    f"mesh axes must be positive, got ({n_data}, {n_model})"
+                )
+            resolved = build_mesh(n_data=n_data, n_model=n_model)
+        if method == "pallas":
+            raise ValueError(
+                "the pallas method is single-device; pass mesh=None"
+            )
+        n_data = resolved.shape["data"]
+        if pad_batch_to % n_data:
+            raise ValueError(
+                f"pad_batch_to={pad_batch_to} is not divisible by the "
+                f"data axis ({n_data})"
+            )
+        return resolved
 
     # -- host featureization --
 
@@ -123,14 +204,34 @@ class BatchClassifier:
 
     # -- batch preparation (prefilters + featurization in one pass) --
 
-    def prepare_batch(self, contents: list[str | bytes]):
+    def prepare_batch(
+        self,
+        contents: list[str | bytes],
+        prefilter: bool = True,
+        filenames: list[str | None] | None = None,
+    ):
         """Sanitize, prefilter and featurize a batch of raw blobs.
 
         Returns (results, bits, n_words, lengths, cc_fp, todo): ``results``
         holds a BlobResult for prefiltered blobs and None for the ``todo``
         indexes, whose feature rows are filled and ready for the device.
         Thread-safe: rows are written independently and the native calls
-        release the GIL, so featurization workers can share one classifier."""
+        release the GIL, so featurization workers can share one classifier.
+
+        ``prefilter=False`` skips the Copyright/Exact short-circuits so the
+        result is pure Dice semantics (the DiceXLA registry matcher runs in
+        a chain where Copyright and Exact already had their turn,
+        project_files/license_file.rb:67-69).
+
+        ``filenames`` (optional, parallel to ``contents``) enables the
+        filename-gated normalizations — today that is the HTML->markdown
+        conversion for ``*.html`` license files (content_helper.rb:293-299
+        applies reverse_markdown; the gate lives in
+        normalize/pipeline.py:_strip_html).
+
+        A blob whose featurization raises is contained: it gets an
+        ``error`` result row and the rest of the batch proceeds (a single
+        poisoned blob must not wedge a 10M-file run)."""
         B = len(contents)
         W = self.corpus.n_lanes
         bits = np.zeros((B, W), dtype=np.uint32)
@@ -139,31 +240,52 @@ class BatchClassifier:
         cc_fp = np.zeros(B, dtype=bool)
         results: list[BlobResult | None] = [None] * B
 
-        if self._nat is not None:
-            for i, raw in enumerate(contents):
-                self._prepare_one_native(
-                    raw, results, bits, n_words, lengths, cc_fp, i
-                )
-        else:
-            blobs = [NormalizedBlob(c) for c in contents]
-            for i, blob in enumerate(blobs):
-                results[i] = self._prefilter(blob)
-                if results[i] is None:
-                    bits[i], n_words[i], lengths[i] = self.corpus.file_features(
-                        blob
+        for i, raw in enumerate(contents):
+            filename = filenames[i] if filenames else None
+            try:
+                if self._nat is not None:
+                    self._prepare_one_native(
+                        raw, results, bits, n_words, lengths, cc_fp, i,
+                        prefilter=prefilter, filename=filename,
                     )
-                    cc_fp[i] = bool(
-                        CC_FALSE_POSITIVE_REGEX.search(
-                            ruby_strip(blob.content or "")
+                else:
+                    blob = NormalizedBlob(raw, filename=filename)
+                    results[i] = self._prefilter(blob) if prefilter else None
+                    if results[i] is None:
+                        bits[i], n_words[i], lengths[i] = (
+                            self.corpus.file_features(blob)
                         )
-                    )
+                        cc_fp[i] = bool(
+                            CC_FALSE_POSITIVE_REGEX.search(
+                                ruby_strip(blob.content or "")
+                            )
+                        )
+            except Exception as exc:  # noqa: BLE001 — per-blob containment
+                results[i] = BlobResult(
+                    None, None, 0.0, error=f"featurize_error: {exc}"
+                )
+                bits[i] = 0
+                n_words[i] = 0
+                lengths[i] = 0
+                cc_fp[i] = False
         todo = [i for i, r in enumerate(results) if r is None]
         return results, bits, n_words, lengths, cc_fp, todo
 
+    @staticmethod
+    def _is_html(filename: str | None) -> bool:
+        return bool(filename) and filename.lower().endswith((".html", ".htm"))
+
     def _prepare_one_native(
-        self, raw, results, bits, n_words, lengths, cc_fp, i
+        self, raw, results, bits, n_words, lengths, cc_fp, i, prefilter=True,
+        filename=None,
     ) -> None:
         content = sanitize_content(raw) if raw is not None else ""
+        if self._is_html(filename):
+            # the native PCRE2 pipeline has no HTML parser; convert here so
+            # the stages see markdown, exactly like the scalar path
+            from licensee_tpu.normalize.html2md import html_to_markdown
+
+            content = html_to_markdown(content)
         stripped = ruby_strip(content)
         feat = self._nat.featurize_raw(self._nat_vocab, stripped, bits[i])
         if feat is None:
@@ -175,24 +297,58 @@ class BatchClassifier:
             )
         else:
             _, nw, ln, flags, h = feat
-        if flags & 1:
+        if prefilter and flags & 1:
             results[i] = BlobResult("no-license", "copyright", 100.0)
-        elif h in self._exact_hashes:
-            results[i] = BlobResult(self._exact_hashes[h], "exact", 100.0)
-        else:
-            n_words[i] = nw
-            lengths[i] = ln
-            cc_fp[i] = bool(flags & 2)
+            return
+        if prefilter and h in self._exact_hashes:
+            # the 128-bit additive multiset hash is a filter, not a proof:
+            # confirm with real set equality before answering 'exact'
+            # (linear-sum hashes admit engineered collisions)
+            key = self._confirm_exact(content, h, bits[i], nw)
+            if key is not None:
+                results[i] = BlobResult(key, "exact", 100.0)
+                return
+        n_words[i] = nw
+        lengths[i] = ln
+        cc_fp[i] = bool(flags & 2)
+
+    def _confirm_exact(self, content: str, h, blob_bits, nw) -> str | None:
+        """Confirm a wordset-hash hit with true set equality
+        (matchers/exact.rb:6-13) without putting every verbatim LICENSE on
+        the slow path: first a cheap necessary condition (the blob's
+        in-vocab bit projection and total word count must equal the
+        template's), then the full Python recheck, memoized by content SHA1
+        so the dominant duplicated-verbatim-blob case confirms once."""
+        import hashlib
+
+        tpl_bits, tpl_count, _key = self._exact_feats[h]
+        if nw != tpl_count or not np.array_equal(blob_bits, tpl_bits):
+            return None
+        digest = hashlib.sha1(content.encode("utf-8", "surrogatepass")).digest()
+        cached = self._confirm_cache.get(digest)
+        if cached is None:
+            blob = NormalizedBlob(content)
+            wordset = frozenset(blob.wordset or frozenset())
+            cached = self._exact_map.get(wordset) or False
+            if len(self._confirm_cache) < 65536:
+                self._confirm_cache[digest] = cached
+        return cached or None
 
     # -- classification --
 
     def classify_blobs(
-        self, contents: list[str | bytes], threshold: float | None = None
+        self,
+        contents: list[str | bytes],
+        threshold: float | None = None,
+        prefilter: bool = True,
+        filenames: list[str | None] | None = None,
     ) -> list[BlobResult]:
         threshold = (
             licensee_tpu.confidence_threshold() if threshold is None else threshold
         )
-        results, bits, n_words, lengths, cc_fp, todo = self.prepare_batch(contents)
+        results, bits, n_words, lengths, cc_fp, todo = self.prepare_batch(
+            contents, prefilter=prefilter, filenames=filenames
+        )
         outs = self.dispatch_chunks(bits, n_words, lengths, cc_fp, todo)
         self.finish_chunks(results, todo, outs, threshold)
         return results  # type: ignore[return-value]
@@ -216,6 +372,10 @@ class BatchClassifier:
                 nw = np.pad(nw, (0, pad))
                 ln = np.pad(ln, (0, pad))
                 cf = np.pad(cf, (0, pad))
+            if self.mesh is not None:
+                from licensee_tpu.parallel.mesh import shard_batch
+
+                b, nw, ln, cf = shard_batch(self.mesh, b, nw, ln, cf)
             outs.append((chunk, self._fn(b, nw, ln, cf)))
         return outs
 

@@ -9,6 +9,7 @@ from licensee_tpu.matchers.base import Matcher
 from licensee_tpu.matchers.copyright_matcher import Copyright
 from licensee_tpu.matchers.exact import Exact
 from licensee_tpu.matchers.dice import Dice
+from licensee_tpu.matchers.dice_xla_matcher import DiceXLA
 from licensee_tpu.matchers.reference_matcher import Reference
 from licensee_tpu.matchers.package import (
     Cabal,
@@ -27,6 +28,7 @@ __all__ = [
     "Copyright",
     "Exact",
     "Dice",
+    "DiceXLA",
     "Reference",
     "Package",
     "Gemspec",

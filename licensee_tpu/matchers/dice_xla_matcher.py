@@ -43,9 +43,15 @@ class DiceXLA(Matcher):
         if cached is None:
             classifier = _shared_classifier()
             content = self.file.content
+            # prefilter=False: this matcher is a drop-in for Dice inside
+            # the first-match-wins chain, where Copyright and Exact have
+            # already had their turn (license_file.rb:67-69) — the batch
+            # prefilters would change its answer on copyright-only files
             cached = classifier.classify_blobs(
                 [content if content is not None else ""],
                 threshold=licensee_tpu.confidence_threshold(),
+                prefilter=False,
+                filenames=[getattr(self.file, "filename", None)],
             )[0]
             self.__dict__["_xla_result"] = cached
         return cached

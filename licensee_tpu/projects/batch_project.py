@@ -41,6 +41,7 @@ class BatchStats:
     dice_matched: int = 0
     unmatched: int = 0
     read_errors: int = 0
+    featurize_errors: int = 0
     # per-stage wall-clock seconds (the observability surface of
     # SURVEY.md §5; read+featurize accumulate across worker threads, so
     # they can exceed elapsed on multi-core hosts)
@@ -73,12 +74,13 @@ class BatchProject:
         threshold: float | None = None,
         workers: int | None = None,
         inflight: int = 3,
+        mesh="auto",
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
         self.paths = list(manifest_paths)
         self.classifier = BatchClassifier(
-            corpus=corpus, method=method, pad_batch_to=batch_size
+            corpus=corpus, method=method, pad_batch_to=batch_size, mesh=mesh
         )
         self.batch_size = batch_size
         self.threshold = (
@@ -131,7 +133,8 @@ class BatchProject:
         contents = [self._read(p) for p in chunk]
         t1 = time.perf_counter()
         prepared = self.classifier.prepare_batch(
-            [c if c is not None else b"" for c in contents]
+            [c if c is not None else b"" for c in contents],
+            filenames=[os.path.basename(p) for p in chunk],
         )
         t2 = time.perf_counter()
         read_errs = [c is None for c in contents]
@@ -200,6 +203,10 @@ class BatchProject:
                         # distinguish "could not read" from "no license"
                         row["error"] = "read_error"
                         self.stats.read_errors += 1
+                    elif result.error:
+                        # poisoned blob: contained per-row, run continues
+                        row["error"] = result.error
+                        self.stats.featurize_errors += 1
                     else:
                         self._count(result)
                     self.stats.total += 1
@@ -214,7 +221,10 @@ class BatchProject:
     def classify_contents(self, contents: list[bytes | str]) -> list:
         results = self.classifier.classify_blobs(contents, threshold=self.threshold)
         for result in results:
-            self._count(result)
+            if result.error:
+                self.stats.featurize_errors += 1
+            else:
+                self._count(result)
         self.stats.total += len(contents)
         return results
 

@@ -111,6 +111,44 @@ def test_resume_stats_count_only_new_rows(tmp_path):
     assert len(out.read_text().splitlines()) == len(paths)
 
 
+def test_poisoned_blob_is_contained(tmp_path, monkeypatch):
+    """A featurizer exception on one blob must produce an error row for
+    that blob only — the run continues and every other row is classified
+    (resume would otherwise wedge at the same offset forever)."""
+    import licensee_tpu.kernels.batch as batch_mod
+
+    poison = b"\x00POISON\x00"
+    real_sanitize = batch_mod.sanitize_content
+
+    def exploding_sanitize(raw):
+        if isinstance(raw, bytes) and b"POISON" in raw:
+            raise RuntimeError("synthetic featurizer edge case")
+        return real_sanitize(raw)
+
+    monkeypatch.setattr(batch_mod, "sanitize_content", exploding_sanitize)
+
+    paths = []
+    mit = open(fixture_path("mit/LICENSE.txt"), "rb").read()
+    for i, content in enumerate([mit, poison, mit, b"not a license"]):
+        p = tmp_path / f"LICENSE_{i}"
+        p.write_bytes(content)
+        paths.append(str(p))
+
+    out = tmp_path / "results.jsonl"
+    project = BatchProject(paths, batch_size=4)
+    stats = project.run(str(out))
+
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(rows) == 4
+    assert rows[0]["key"] == "mit" and "error" not in rows[0]
+    assert rows[1]["key"] is None
+    assert rows[1]["error"].startswith("featurize_error")
+    assert rows[2]["key"] == "mit"
+    assert rows[3]["key"] is None and "error" not in rows[3]
+    assert stats.featurize_errors == 1
+    assert stats.total == 4
+
+
 def test_pipelined_run_matches_serial_classify(tmp_path):
     """The threaded read->featurize->dispatch pipeline must produce
     byte-identical rows to the serial classify path, in manifest order."""
