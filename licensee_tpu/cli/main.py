@@ -267,6 +267,19 @@ def cmd_batch_detect(args) -> int:
                       file=sys.stderr)
                 return 1
 
+    # multi-host opt-in via env (LICENSEE_TPU_COORDINATOR / _NUM_PROCESSES /
+    # _PROCESS_ID): this process classifies its manifest stripe and writes
+    # its own output shard
+    from licensee_tpu.parallel.distributed import maybe_initialize
+
+    process_index, process_count = maybe_initialize()
+    if process_count > 1 and not args.output:
+        print(
+            "error: multi-host runs need --output (per-host JSONL shards)",
+            file=sys.stderr,
+        )
+        return 1
+
     from licensee_tpu.projects.batch_project import BatchProject
 
     try:

@@ -87,6 +87,18 @@ class CompiledCorpus:
             for word in sorted(lic.wordset_fieldless):
                 if word not in vocab:
                     vocab[word] = len(vocab)
+        # Also cover every template's FULL wordset (field words included),
+        # appended after the scoring words so template bit rows are
+        # unchanged (field-word columns stay 0 in every row — they can
+        # never contribute to an overlap).  This makes the batch Exact
+        # check exact by construction: a blob whose in-vocab projection
+        # equals a template's full-wordset bits AND whose |wordset| equals
+        # the template's has zero out-of-vocab words, hence wordset
+        # equality (matchers/exact.rb:6-13) — no hash trust needed.
+        for lic in pool:
+            for word in sorted(lic.wordset - lic.wordset_fieldless):
+                if word not in vocab:
+                    vocab[word] = len(vocab)
 
         n_lanes = -(-len(vocab) // LANE)
         n_lanes = -(-n_lanes // lane_align) * lane_align

@@ -104,11 +104,9 @@ class BatchClassifier:
         self._nat = native_pipeline.load()
         self._nat_vocab = None
         self._exact_hashes: dict[bytes, str] = {}
-        # per-hash confirmation constants: the template's in-vocab bit
-        # projection + |wordset|, a cheap necessary condition checked
-        # before the airtight Python recheck (see _confirm_exact)
+        # per-hash equality-proof constants: the template's FULL-wordset
+        # in-vocab bit projection + |wordset| (see _confirm_exact)
         self._exact_feats: dict[bytes, tuple[np.ndarray, int, str]] = {}
-        self._confirm_cache: dict[bytes, str | bool] = {}
         if self._nat is not None:
             from licensee_tpu.corpus.compiler import pack_ids
 
@@ -153,12 +151,16 @@ class BatchClassifier:
                 return None
             import jax
 
-            n = len(jax.devices())
+            # local devices: in multi-host runs each process scores its
+            # own manifest stripe on its own ICI-connected chips
+            # (parallel/distributed.py), so the mesh never spans DCN
+            local = jax.local_devices()
+            n = len(local)
             while pad_batch_to % n:
                 n -= 1
             if n == 1:
                 return None
-            resolved = build_mesh(n_data=n, n_model=1)
+            resolved = build_mesh(n_data=n, n_model=1, devices=local)
         else:
             n_data, n_model = mesh
             if n_data < 1 or n_model < 1:
@@ -301,10 +303,9 @@ class BatchClassifier:
             results[i] = BlobResult("no-license", "copyright", 100.0)
             return
         if prefilter and h in self._exact_hashes:
-            # the 128-bit additive multiset hash is a filter, not a proof:
-            # confirm with real set equality before answering 'exact'
-            # (linear-sum hashes admit engineered collisions)
-            key = self._confirm_exact(content, h, bits[i], nw)
+            # the 128-bit additive multiset hash only TRIGGERS the check;
+            # the answer rests on a complete equality proof (below)
+            key = self._confirm_exact(h, bits[i], nw)
             if key is not None:
                 results[i] = BlobResult(key, "exact", 100.0)
                 return
@@ -312,27 +313,21 @@ class BatchClassifier:
         lengths[i] = ln
         cc_fp[i] = bool(flags & 2)
 
-    def _confirm_exact(self, content: str, h, blob_bits, nw) -> str | None:
-        """Confirm a wordset-hash hit with true set equality
-        (matchers/exact.rb:6-13) without putting every verbatim LICENSE on
-        the slow path: first a cheap necessary condition (the blob's
-        in-vocab bit projection and total word count must equal the
-        template's), then the full Python recheck, memoized by content SHA1
-        so the dominant duplicated-verbatim-blob case confirms once."""
-        import hashlib
+    def _confirm_exact(self, h, blob_bits, nw) -> str | None:
+        """Set-equality proof for an exact-hash hit, O(n_lanes) per blob.
 
-        tpl_bits, tpl_count, _key = self._exact_feats[h]
+        The compiler's vocab covers every template's FULL wordset
+        (corpus/compiler.py), so for template T with word count c and
+        in-vocab bit projection P:  a blob with |wordset| == c and bit
+        projection == P has exactly c in-vocab words forming T's set and
+        c - c = 0 out-of-vocab words — i.e. wordset equality
+        (matchers/exact.rb:6-13), independent of any hash property.  The
+        additive hash (linear, collidable in principle) is never trusted,
+        only used to pick the candidate template."""
+        tpl_bits, tpl_count, key = self._exact_feats[h]
         if nw != tpl_count or not np.array_equal(blob_bits, tpl_bits):
             return None
-        digest = hashlib.sha1(content.encode("utf-8", "surrogatepass")).digest()
-        cached = self._confirm_cache.get(digest)
-        if cached is None:
-            blob = NormalizedBlob(content)
-            wordset = frozenset(blob.wordset or frozenset())
-            cached = self._exact_map.get(wordset) or False
-            if len(self._confirm_cache) < 65536:
-                self._confirm_cache[digest] = cached
-        return cached or None
+        return key
 
     # -- classification --
 

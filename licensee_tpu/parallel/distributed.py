@@ -1,0 +1,88 @@
+"""Multi-host (DCN) bootstrap for batch classification.
+
+The reference is single-process Ruby (SURVEY.md §2.7 — no communication
+backend of any kind); this module is the TPU-native multi-host design.
+
+Placement rationale: the scoring workload has no cross-blob communication
+— the mesh's data axis emits zero collectives (each blob's best-match is
+independent), and the only collective in the program is the model-axis
+``psum`` of partial popcounts.  The scaling recipe (axes that communicate
+stay on the fastest fabric) therefore maps:
+
+* **model axis** → within a host's local chips, riding ICI;
+* **data axis**  → across hosts, as *manifest striping*: each process
+  classifies a contiguous stripe of the global manifest on its local mesh
+  and writes its own JSONL shard.  This is mathematically identical to a
+  global-mesh data axis (no collectives to lose) and keeps every host's
+  failure/resume domain independent — shard files resume per-host.
+
+DCN carries only the ``jax.distributed`` bootstrap handshake.
+
+Environment contract (all three must be set to opt in):
+
+* ``LICENSEE_TPU_COORDINATOR``   — ``host:port`` of process 0
+* ``LICENSEE_TPU_NUM_PROCESSES`` — world size
+* ``LICENSEE_TPU_PROCESS_ID``    — this process's rank
+
+On TPU pod slices where the runtime provides cluster metadata,
+``jax.distributed.initialize()`` auto-detects instead; call
+``maybe_initialize`` with ``auto=True`` env ``LICENSEE_TPU_DISTRIBUTED=auto``.
+"""
+
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def maybe_initialize(env=None) -> tuple[int, int]:
+    """Initialize `jax.distributed` from the environment (idempotent).
+
+    Returns ``(process_index, process_count)`` — ``(0, 1)`` when no
+    multi-host environment is configured."""
+    global _initialized
+    env = os.environ if env is None else env
+
+    coord = env.get("LICENSEE_TPU_COORDINATOR")
+    auto = env.get("LICENSEE_TPU_DISTRIBUTED") == "auto"
+    if not coord and not auto:
+        return 0, 1
+
+    import jax
+
+    if not _initialized:
+        if coord:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(env["LICENSEE_TPU_NUM_PROCESSES"]),
+                process_id=int(env["LICENSEE_TPU_PROCESS_ID"]),
+            )
+        else:
+            jax.distributed.initialize()
+        _initialized = True
+    return jax.process_index(), jax.process_count()
+
+
+def manifest_stripe(n: int, process_index: int, process_count: int) -> tuple[int, int]:
+    """[lo, hi) bounds of this process's contiguous manifest stripe.
+
+    Contiguous (not strided) so each shard's resume invariant — output
+    line count == completed prefix of the stripe — holds independently;
+    the remainder spreads one extra item over the first shards."""
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} out of range for "
+            f"process_count {process_count}"
+        )
+    base, rem = divmod(n, process_count)
+    lo = process_index * base + min(process_index, rem)
+    hi = lo + base + (1 if process_index < rem else 0)
+    return lo, hi
+
+
+def shard_output_path(output: str, process_index: int, process_count: int) -> str:
+    """Per-host JSONL shard path (process 0 of 1 keeps the plain path)."""
+    if process_count <= 1:
+        return output
+    return f"{output}.shard-{process_index:05d}-of-{process_count:05d}"

@@ -75,13 +75,50 @@ class BatchProject:
         workers: int | None = None,
         inflight: int = 3,
         mesh="auto",
+        classifier=None,
+        process_index: int | None = None,
+        process_count: int | None = None,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
-        self.paths = list(manifest_paths)
-        self.classifier = BatchClassifier(
+        # Multi-host: this process owns a contiguous stripe of the global
+        # manifest and writes its own output shard (see
+        # parallel/distributed.py for the DCN placement rationale).
+        # Explicit kwargs win; otherwise the jax.distributed world (if
+        # initialized) decides; otherwise single-process.
+        if (process_index is None) != (process_count is None):
+            raise ValueError(
+                "process_index and process_count must be given together"
+            )
+        if process_count is None:
+            try:
+                import jax
+
+                process_count = jax.process_count()
+                process_index = jax.process_index()
+            except Exception:
+                process_count, process_index = 1, 0
+        self.process_index = process_index
+        self.process_count = process_count
+        paths = list(manifest_paths)
+        if self.process_count > 1:
+            from licensee_tpu.parallel.distributed import manifest_stripe
+
+            lo, hi = manifest_stripe(
+                len(paths), self.process_index, self.process_count
+            )
+            paths = paths[lo:hi]
+        self.paths = paths
+        # a caller-supplied classifier (pad_batch_to must equal batch_size)
+        # reuses its compiled scorer across runs — e.g. a warmed-up one
+        self.classifier = classifier or BatchClassifier(
             corpus=corpus, method=method, pad_batch_to=batch_size, mesh=mesh
         )
+        if self.classifier.pad_batch_to != batch_size:
+            raise ValueError(
+                f"classifier pad_batch_to={self.classifier.pad_batch_to} "
+                f"!= batch_size={batch_size}"
+            )
         self.batch_size = batch_size
         self.threshold = (
             licensee_tpu.confidence_threshold() if threshold is None else threshold
@@ -158,6 +195,12 @@ class BatchProject:
         return results
 
     def run(self, output: str, resume: bool = True) -> BatchStats:
+        if self.process_count > 1:
+            from licensee_tpu.parallel.distributed import shard_output_path
+
+            output = shard_output_path(
+                output, self.process_index, self.process_count
+            )
         done = 0
         if resume and os.path.exists(output):
             done = self._resume_point(output)

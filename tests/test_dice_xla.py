@@ -129,3 +129,35 @@ def test_dice_xla_matcher_plugin():
     matcher = DiceXLA(file)
     assert matcher.match == gpl
     assert matcher.confidence == 100.0
+
+
+def test_exact_proof_rejects_oov_word_swap(classifier):
+    """The Exact prefilter must not answer 'exact' for a blob whose
+    in-vocab projection and word count match a template but whose actual
+    wordset differs (the engineered-hash-collision shape): the compiler
+    vocab covers every template's full wordset, so equality of (bits,
+    count) IS set equality — verify both directions."""
+    if classifier._nat is None:
+        pytest.skip("native pipeline unavailable")
+    corpus = classifier.corpus
+    # every template's full wordset must be inside the vocab (the proof's
+    # precondition)
+    for wordset in corpus.exact_sets:
+        missing = [w for w in wordset if w not in corpus.vocab]
+        assert not missing, missing[:5]
+    # and the stored projections popcount back to the full word count
+    for h, (tpl_bits, tpl_count, key) in classifier._exact_feats.items():
+        popc = int(np.unpackbits(tpl_bits.view(np.uint8)).sum())
+        assert popc == tpl_count, key
+
+    # same count, one word swapped for an out-of-vocab word: even if an
+    # attacker matched the additive hash, the bits/count proof fails
+    mit = dict(zip(corpus.keys, range(len(corpus.keys))))
+    lic = {l.key: l for l in License.all(hidden=True, pseudo=False)}["mit"]
+    words = sorted(lic.wordset)
+    swapped = set(words[1:]) | {"zzzunvocabword"}
+    fake_h = classifier._nat.exact_hash(lic.wordset)
+    blob = NormalizedBlob(" ".join(sorted(swapped)))
+    bits, nw, _ln = corpus.file_features(blob)
+    assert nw == len(lic.wordset)  # same cardinality as the template
+    assert classifier._confirm_exact(fake_h, bits, nw) is None
