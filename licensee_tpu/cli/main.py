@@ -225,6 +225,36 @@ def cmd_version(_args) -> int:
     return 0
 
 
+def cmd_help(args) -> int:
+    """Thor-style command listing (parity: `licensee help`, bin_spec.rb:21
+    expects a "commands:" header naming every subcommand)."""
+    if args.topic:
+        # `help detect` -> that subcommand's own --help text (argparse
+        # raises SystemExit(0) after printing; keep main() returnable)
+        try:
+            args.parser.parse_args([args.topic, "--help"])
+        except SystemExit as exc:
+            return int(exc.code or 0)
+        return 0
+    print("Licensee commands:")
+    sub_actions = next(
+        a
+        for a in args.parser._actions
+        if isinstance(a, argparse._SubParsersAction)
+    )
+    for choice in args.parser._subparsers._group_actions[0].choices:
+        help_text = next(
+            (
+                c.help
+                for c in sub_actions._choices_actions
+                if c.dest == choice
+            ),
+            "",
+        )
+        print(f"  licensee-tpu {choice:<24} # {help_text}")
+    return 0
+
+
 def cmd_batch_detect(args) -> int:
     """Batch classification of a manifest of files via the TPU Dice kernel.
 
@@ -377,6 +407,10 @@ def build_parser() -> argparse.ArgumentParser:
     version = sub.add_parser("version", help="Print the version")
     version.set_defaults(func=cmd_version)
 
+    help_cmd = sub.add_parser("help", help="Describe available commands")
+    help_cmd.add_argument("topic", nargs="?", default=None)
+    help_cmd.set_defaults(func=cmd_help, parser=parser)
+
     batch = sub.add_parser(
         "batch-detect", help="Classify a manifest of files on the TPU batch path"
     )
@@ -423,7 +457,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "batch-detect", "-h", "--help"}
+    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

@@ -1,0 +1,106 @@
+"""The installed CLI entry point run as a real subprocess
+(parity: spec/bin_spec.rb + the subprocess contexts of
+spec/licensee/commands/detect_spec.rb) — exercises the shebang, the
+sys.path shim, argv handling and process exit codes, none of which the
+in-process tests in test_cli.py touch."""
+
+import json
+import os
+import subprocess
+import sys
+
+import yaml
+
+from tests.conftest import fixture_path
+
+BIN = os.path.join(os.path.dirname(__file__), "..", "bin", "licensee-tpu")
+
+
+def run_bin(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, BIN, *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        timeout=120,
+    )
+
+
+def test_help_returns_zero_and_lists_commands():
+    proc = run_bin("help")
+    assert proc.returncode == 0
+    assert "Licensee commands:" in proc.stdout
+    for command in ("detect", "diff", "license-path", "version"):
+        assert command in proc.stdout
+
+
+def test_detect_path_argument():
+    proc = run_bin("detect", fixture_path("mit"))
+    assert proc.returncode == 0
+    parsed = yaml.safe_load(proc.stdout)
+    assert parsed["License"] == "MIT"
+    assert parsed["LICENSE.txt"]["Matcher"].endswith(".Exact")
+
+
+def test_detect_no_arguments_uses_cwd():
+    proc = run_bin("detect", cwd=fixture_path("mit"))
+    assert proc.returncode == 0
+    assert yaml.safe_load(proc.stdout)["License"] == "MIT"
+
+
+def test_default_command_is_detect():
+    proc = run_bin(fixture_path("mit"))
+    assert proc.returncode == 0
+    assert yaml.safe_load(proc.stdout)["License"] == "MIT"
+
+
+def test_detect_json():
+    proc = run_bin("detect", "--json", fixture_path("mit"))
+    assert proc.returncode == 0
+    parsed = json.loads(proc.stdout)
+    assert parsed["licenses"][0]["key"] == "mit"
+    assert parsed["matched_files"][0]["matcher"]["name"] == "exact"
+
+
+def test_detect_exit_code_one_when_no_license(tmp_path):
+    (tmp_path / "README.md").write_text("no license here")
+    proc = run_bin("detect", str(tmp_path))
+    assert proc.returncode == 1
+
+
+def test_diff():
+    proc = run_bin("diff", fixture_path("mit"), "--license", "mit")
+    assert proc.returncode == 0
+    assert "Similarity:" in proc.stdout
+
+
+def test_diff_stdin():
+    """diff reads license text from STDIN when no path is given
+    (commands/diff.rb:16-17)."""
+    with open(
+        os.path.join(fixture_path("mit"), "LICENSE.txt"), encoding="utf-8"
+    ) as f:
+        content = f.read()
+    proc = subprocess.run(
+        [sys.executable, BIN, "diff", "--license", "mit"],
+        input=content,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "100.00%" in proc.stdout
+
+
+def test_license_path():
+    proc = run_bin("license-path", fixture_path("mit"))
+    assert proc.returncode == 0
+    assert proc.stdout.strip().endswith("LICENSE.txt")
+
+
+def test_version():
+    import licensee_tpu
+
+    proc = run_bin("version")
+    assert proc.returncode == 0
+    assert proc.stdout.strip() == licensee_tpu.__version__

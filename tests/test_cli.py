@@ -39,6 +39,34 @@ def test_detect_json(capsys):
     }
 
 
+def test_detect_json_full_golden(capsys, tmp_path):
+    """The reference compares ENTIRE `detect --json` output against the
+    detect.json golden (spec/licensee/commands/detect_spec.rb:62-74),
+    dropping only the gemspec's raw content.  The fixture embeds the
+    project files' contents, so the project is reconstructed from the
+    golden itself; any drift in any to_h field fails here."""
+    import copy
+
+    from tests.conftest import FIXTURES_DIR
+
+    with open(f"{FIXTURES_DIR}/detect.json", encoding="utf-8") as f:
+        fixture = json.load(f)
+    (tmp_path / "LICENSE.md").write_text(
+        fixture["matched_files"][0]["content"], encoding="utf-8"
+    )
+    (tmp_path / "licensee.gemspec").write_text(
+        fixture["matched_files"][1]["content"], encoding="utf-8"
+    )
+    rc, out = run_cli(["detect", "--json", str(tmp_path)], capsys)
+    assert rc == 0
+    parsed = json.loads(out)
+    expected = copy.deepcopy(fixture)
+    # parity with the spec: matched_files[1] content is not compared
+    expected["matched_files"][1].pop("content", None)
+    parsed["matched_files"][1].pop("content", None)
+    assert parsed == expected
+
+
 def test_detect_no_license_exit_code(capsys, tmp_path):
     (tmp_path / "foo.md").write_text("bar")
     rc, _ = run_cli(["detect", str(tmp_path)], capsys)
