@@ -244,6 +244,23 @@ class License(NormalizedContent):
         pattern = self.source_regex_pattern
         return rb(pattern) if pattern else None
 
+    @property
+    def reference_regex(self) -> re.Pattern:
+        """The compiled title|source union the Reference matcher scans a
+        README with (reference.rb:9-13).  Compiled once per License (the
+        pool is process-global and memoized), not per matcher call —
+        recompiling ~47 large unions for every README is fatal at
+        batch-readme-scan scale."""
+        cached = self.__dict__.get("_reference_regex")
+        if cached is None:
+            parts = [self.title_regex_pattern]
+            source = self.source_regex_pattern
+            if source:
+                parts.append(source)
+            cached = rb(r"\b(?:" + "|".join(parts) + r")\b")
+            self.__dict__["_reference_regex"] = cached
+        return cached
+
     # -- predicates (license.rb:196-231) --
 
     @property

@@ -242,26 +242,31 @@ class BatchClassifier:
         cc_fp = np.zeros(B, dtype=bool)
         results: list[BlobResult | None] = [None] * B
 
+        from licensee_tpu.native.pipeline import NativeResourceError
+
         for i, raw in enumerate(contents):
             filename = filenames[i] if filenames else None
             try:
                 if self._nat is not None:
-                    self._prepare_one_native(
+                    try:
+                        self._prepare_one_native(
+                            raw, results, bits, n_words, lengths, cc_fp, i,
+                            prefilter=prefilter, filename=filename,
+                        )
+                    except NativeResourceError:
+                        # PCRE2 hit a match/depth limit on this blob;
+                        # Python re has no such limit — redo just this
+                        # blob on the pure-Python pipeline (same answer,
+                        # slower) instead of emitting a false error row
+                        self._prepare_one_python(
+                            raw, results, bits, n_words, lengths, cc_fp, i,
+                            prefilter=prefilter, filename=filename,
+                        )
+                else:
+                    self._prepare_one_python(
                         raw, results, bits, n_words, lengths, cc_fp, i,
                         prefilter=prefilter, filename=filename,
                     )
-                else:
-                    blob = NormalizedBlob(raw, filename=filename)
-                    results[i] = self._prefilter(blob) if prefilter else None
-                    if results[i] is None:
-                        bits[i], n_words[i], lengths[i] = (
-                            self.corpus.file_features(blob)
-                        )
-                        cc_fp[i] = bool(
-                            CC_FALSE_POSITIVE_REGEX.search(
-                                ruby_strip(blob.content or "")
-                            )
-                        )
             except Exception as exc:  # noqa: BLE001 — per-blob containment
                 results[i] = BlobResult(
                     None, None, 0.0, error=f"featurize_error: {exc}"
@@ -272,6 +277,20 @@ class BatchClassifier:
                 cc_fp[i] = False
         todo = [i for i, r in enumerate(results) if r is None]
         return results, bits, n_words, lengths, cc_fp, todo
+
+    def _prepare_one_python(
+        self, raw, results, bits, n_words, lengths, cc_fp, i, prefilter=True,
+        filename=None,
+    ) -> None:
+        """The pure-Python twin of _prepare_one_native — the fallback when
+        the native library is absent or failed this blob over."""
+        blob = NormalizedBlob(raw, filename=filename)
+        results[i] = self._prefilter(blob) if prefilter else None
+        if results[i] is None:
+            bits[i], n_words[i], lengths[i] = self.corpus.file_features(blob)
+            cc_fp[i] = bool(
+                CC_FALSE_POSITIVE_REGEX.search(ruby_strip(blob.content or ""))
+            )
 
     @staticmethod
     def _is_html(filename: str | None) -> bool:

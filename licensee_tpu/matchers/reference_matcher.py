@@ -7,7 +7,6 @@ Matches a README body that mentions a license by title or by source URL.
 from __future__ import annotations
 
 from licensee_tpu.matchers.base import Matcher
-from licensee_tpu.rubytext import rb
 
 
 class Reference(Matcher):
@@ -17,12 +16,10 @@ class Reference(Matcher):
         if content is None:
             return None
         for lic in self.potential_matches:
-            parts = [lic.title_regex_pattern]
-            source = lic.source_regex_pattern
-            if source:
-                parts.append(source)
-            pattern = rb(r"\b(?:" + "|".join(parts) + r")\b")
-            if pattern.search(content):
+            # compiled once per License and memoized there; the License
+            # pool itself is process-global, so a batch readme scan pays
+            # zero re.compile after the first file
+            if lic.reference_regex.search(content):
                 return lic
         return None
 

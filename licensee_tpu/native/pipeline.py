@@ -31,6 +31,15 @@ _instance = None
 _failed = False
 
 
+class NativeResourceError(RuntimeError):
+    """PCRE2 hit a resource limit (MATCHLIMIT/DEPTHLIMIT) on this blob.
+
+    Python `re` has no such limits, so treating this as "no match" would
+    silently diverge from the fallback path on adversarial inputs
+    (nested-quantifier patterns vs pathological text).  Callers catch
+    this and re-run the single blob through the pure-Python pipeline."""
+
+
 def _flags_str(pattern: re.Pattern) -> str:
     flags = ""
     if pattern.flags & re.I:
@@ -175,6 +184,8 @@ class NativePipeline:
         ptr = self._lib.pipe_stage1(
             self._handle, data, len(data), ctypes.byref(n), ctypes.byref(flags)
         )
+        if not ptr:
+            raise NativeResourceError("pipe_stage1: PCRE2 resource limit")
         try:
             out = ctypes.string_at(ptr, n.value).decode("utf-8")
         finally:
@@ -185,6 +196,8 @@ class NativePipeline:
         data = lowered_stage1.encode("utf-8")
         n = ctypes.c_size_t()
         ptr = self._lib.pipe_stage2(self._handle, data, len(data), ctypes.byref(n))
+        if not ptr:
+            raise NativeResourceError("pipe_stage2: PCRE2 resource limit")
         try:
             return ctypes.string_at(ptr, n.value).decode("utf-8")
         finally:
@@ -218,6 +231,8 @@ class NativePipeline:
             scalars,
             hash16,
         )
+        if rc == 3:
+            raise NativeResourceError("pipe_featurize: PCRE2 resource limit")
         if rc != 0:
             raise RuntimeError(f"pipe_featurize rc={rc}")
         return bits_out, int(scalars[0]), int(scalars[1]), bytes(hash16)
@@ -252,6 +267,8 @@ class NativePipeline:
         )
         if rc == 2:
             return None
+        if rc == 3:
+            raise NativeResourceError("pipe_featurize_raw: PCRE2 resource limit")
         if rc != 0:
             raise RuntimeError(f"pipe_featurize_raw rc={rc}")
         return (

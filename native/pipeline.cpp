@@ -105,8 +105,14 @@ struct Pat {
 
 // One reusable match_data per call frame (1 ovector pair: we only ever
 // need the whole-match span; rc==0 "ovector too small" still means match).
+// `err` latches the first PCRE2 resource failure (MATCHLIMIT/DEPTHLIMIT/
+// bad input) that survived the interpretive retry: Python `re` has no
+// such limits, so mapping these to "no match" would silently diverge
+// from the fallback path on adversarial blobs — the entry points check
+// it and fail the whole blob over to the Python pipeline instead.
 struct Scratch {
   pcre2_match_data *md;
+  int err = 0;
   Scratch() { md = pcre2_match_data_create_8(1, nullptr); }
   ~Scratch() { pcre2_match_data_free_8(md); }
 };
@@ -120,14 +126,19 @@ bool search(const Pat &p, const std::string &s, Scratch &scr,
   if (rc < 0 && rc != kNoMatch)
     rc = pcre2_match_8(p.code, reinterpret_cast<const uint8_t *>(s.data()),
                        s.size(), 0, kNoJit, scr.md, nullptr);
-  if (rc == kNoMatch || rc < 0) return false;
+  if (rc == kNoMatch) return false;
+  if (rc < 0) {
+    scr.err = rc;  // resource limit, NOT a no-match — blob must fail over
+    return false;
+  }
   if (start_out) *start_out = pcre2_get_ovector_pointer_8(scr.md)[0];
   return true;
 }
 
 // gsub: global substitute with a replacement template ("$1" group refs
 // insert the group text raw, like a Python callable returning m.group).
-std::string gsub(const Pat &p, const std::string &s, const char *repl) {
+std::string gsub(const Pat &p, const std::string &s, const char *repl,
+                 Scratch &scr) {
   size_t repl_len = std::strlen(repl);
   std::string out;
   size_t out_len = s.size() + (s.size() >> 2) + 64;
@@ -155,7 +166,10 @@ std::string gsub(const Pat &p, const std::string &s, const char *repl) {
         out_len = n;
         continue;
       }
-      if (rc < 0) return s;  // give up: pass through unchanged
+      if (rc < 0) {
+        scr.err = rc;  // resource failure: silent pass-through would
+        return s;      // diverge from Python re — fail the blob over
+      }
     }
     out.resize(n);
     return out;
@@ -175,7 +189,7 @@ std::string plain_strip(const Pat &p, std::string s, Scratch &scr,
     *clean = true;
     return sc::squeeze_strip(s.data(), s.size());
   }
-  std::string subbed = gsub(p, s, " ");
+  std::string subbed = gsub(p, s, " ", scr);
   *clean = true;
   return sc::squeeze_strip(subbed.data(), subbed.size());
 }
@@ -187,7 +201,7 @@ std::string gsub_pass(const Pat &p, std::string s, const char *repl,
                       Scratch &scr, bool *clean) {
   if (!search(p, s, scr)) return s;
   *clean = false;
-  return gsub(p, s, repl);
+  return gsub(p, s, repl, scr);
 }
 
 bool contains(const std::string &s, const char *needle) {
@@ -457,6 +471,23 @@ void *pipe_new(const char *config, size_t config_len) {
       return pl;  // caller checks pipe_error
     }
   }
+  // Every pattern name the stage code dereferences must exist: if the
+  // Python-side _build_config ever drifts (a record renamed/omitted),
+  // surface a clean NativeUnavailable at init instead of a segfault at
+  // the first pipe_stage1 call.
+  static const char *kRequired[] = {
+      "hrs", "comment_markup", "markdown_headings", "link_markup", "title",
+      "version", "lists", "span_markup", "bullet", "bullet_join", "bom",
+      "cc_dedication", "cc_wiki", "cc_legal_code", "cc0_info",
+      "cc0_disclaimer", "unlicense_info", "border_markup", "url",
+      "strip_copyright", "block_markup", "developed_by", "end_of_terms",
+      "mit_optional", "copyright_full", "cc_false_positive"};
+  for (const char *name : kRequired) {
+    if (!pl->pat(name)) {
+      pl->error = std::string("missing required pattern: ") + name;
+      return pl;
+    }
+  }
   return pl;
 }
 
@@ -471,6 +502,9 @@ void pipe_del(void *handle) { delete static_cast<Pipeline *>(handle); }
 // matcher's full-content test, matchers/copyright.rb:13, on the as-given
 // input which Python has already String#strip'd); bit1: CC-NC/ND false
 // positive guard (license_file.rb:63-65).
+// Returns nullptr on a PCRE2 resource failure (MATCHLIMIT/DEPTHLIMIT on
+// pathological input) — the caller must fail the blob over to the Python
+// pipeline, which has no such limits.
 char *pipe_stage1(void *handle, const char *data, size_t len, size_t *out_len,
                   int32_t *flags_out) {
   auto *pl = static_cast<Pipeline *>(handle);
@@ -478,21 +512,24 @@ char *pipe_stage1(void *handle, const char *data, size_t len, size_t *out_len,
   std::string in(data, len);
   int32_t flags = 0;
   if (flags_out) {
-    const Pat *cfull = pl->pat("copyright_full");
-    const Pat *ccfp = pl->pat("cc_false_positive");
-    if (cfull && search(*cfull, in, scr)) flags |= 1;
-    if (ccfp && search(*ccfp, in, scr)) flags |= 2;
+    if (search(*pl->pat("copyright_full"), in, scr)) flags |= 1;
+    if (search(*pl->pat("cc_false_positive"), in, scr)) flags |= 2;
     *flags_out = flags;
   }
-  return to_buf(pl->stage1(std::move(in), scr), out_len);
+  std::string out = pl->stage1(std::move(in), scr);
+  if (scr.err) return nullptr;
+  return to_buf(out, out_len);
 }
 
-// Stage 2 on the Python-downcased stage-1 output.
+// Stage 2 on the Python-downcased stage-1 output.  nullptr on resource
+// failure, as pipe_stage1.
 char *pipe_stage2(void *handle, const char *data, size_t len,
                   size_t *out_len) {
   auto *pl = static_cast<Pipeline *>(handle);
   Scratch scr;
-  return to_buf(pl->stage2(std::string(data, len), scr), out_len);
+  std::string out = pl->stage2(std::string(data, len), scr);
+  if (scr.err) return nullptr;
+  return to_buf(out, out_len);
 }
 
 void *pipe_vocab_new(const char *words, size_t words_len, uint32_t n_lanes) {
@@ -518,6 +555,7 @@ int pipe_featurize(void *handle, void *vocab_handle, const char *data,
   auto *vocab = static_cast<Vocab *>(vocab_handle);
   Scratch scr;
   std::string c = pl->stage2(std::string(data, len), scr);
+  if (scr.err) return 3;  // resource failure: caller falls back to Python
 
   std::vector<uint64_t> hashes;
   std::vector<sc::Slice> uniq = sc::wordset_unique(c.data(), c.size(), &hashes);
@@ -563,6 +601,7 @@ int pipe_featurize_raw(void *handle, void *vocab_handle, const char *data,
   for (char &ch : c)
     if (ch >= 'A' && ch <= 'Z') ch += 'a' - 'A';
   c = pl->stage2(std::move(c), scr);
+  if (scr.err) return 3;  // resource failure: caller falls back to Python
 
   std::vector<uint64_t> hashes;
   std::vector<sc::Slice> uniq = sc::wordset_unique(c.data(), c.size(), &hashes);

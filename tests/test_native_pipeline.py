@@ -156,3 +156,41 @@ def test_classifier_native_matches_python_fallback(monkeypatch):
     for n, p in zip(native_results, py_results):
         assert (n.key, n.matcher) == (p.key, p.matcher)
         assert n.confidence == pytest.approx(p.confidence, abs=0)
+
+
+def test_resource_limit_fails_over_to_python(monkeypatch):
+    """A PCRE2 resource-limit failure on one blob must NOT produce an
+    error row or a silent no-match: the blob re-runs on the pure-Python
+    pipeline (which has no such limits) and classifies normally."""
+    import re
+
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.kernels import batch as batch_mod
+    from licensee_tpu.native.pipeline import NativeResourceError
+
+    clf = batch_mod.BatchClassifier(pad_batch_to=4)
+    if clf._nat is None:
+        pytest.skip("native pipeline unavailable")
+
+    mit = next(
+        lic for lic in License.all(hidden=True, pseudo=False)
+        if lic.key == "mit"
+    )
+    text = re.sub(r"\[(\w+)\]", "example", mit.content or "").encode()
+
+    # first blob: native path pretends to hit MATCHLIMIT; second: normal
+    calls = {"n": 0}
+    real = clf._prepare_one_native
+
+    def flaky(raw, *args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise NativeResourceError("pipe_featurize_raw: PCRE2 resource limit")
+        return real(raw, *args, **kwargs)
+
+    monkeypatch.setattr(clf, "_prepare_one_native", flaky)
+    results = clf.classify_blobs([text, text])
+    assert calls["n"] == 2
+    for r in results:
+        assert r.error is None
+        assert (r.key, r.matcher) == ("mit", "exact")
