@@ -319,6 +319,7 @@ def cmd_batch_detect(args) -> int:
             batch_size=args.batch_size,
             workers=args.workers,
             mesh=mesh,
+            mode=args.mode,
             **kwargs,
         )
     except ValueError as exc:
@@ -432,8 +433,25 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-resume", action="store_true",
         help="Restart from scratch instead of resuming a partial --output",
     )
-    batch.add_argument("--method", default="popcount",
-                       choices=["popcount", "matmul", "pallas"])
+    batch.add_argument(
+        "--method", default="auto",
+        choices=["auto", "popcount", "matmul", "pallas", "pallas-mxu"],
+        help=(
+            "Device scoring path (default auto: popcount at vendored "
+            "width, matmul at full-SPDX width — the measured winners; "
+            "see the ADR in kernels/dice_pallas.py)"
+        ),
+    )
+    batch.add_argument(
+        "--mode", default="license",
+        choices=["license", "readme", "package"],
+        help=(
+            "Which project-file chain to run per blob: 'license' "
+            "(Copyright/Exact/Dice), 'readme' (extract the License "
+            "section, then the license chain + Reference fallback), or "
+            "'package' (filename-dispatched package-manifest matchers)"
+        ),
+    )
     batch.add_argument(
         "--mesh", default=None, metavar="DATA[,MODEL]",
         help=(

@@ -48,6 +48,26 @@ class BlobResult:
         }
 
 
+@dataclass
+class PreparedBatch:
+    """One featurized batch between the host produce stage and the device.
+
+    ``results`` carries a BlobResult for every blob short-circuited on the
+    host (prefilters, package matchers, featurize errors) and None for the
+    ``todo`` rows, whose feature arrays are device-ready.  ``sections``
+    (readme mode only) keeps each blob's extracted license section so the
+    Reference matcher can run as the post-Dice fallback
+    (readme_file.rb:32-34 appends Matchers::Reference to the chain)."""
+
+    results: list
+    bits: np.ndarray
+    n_words: np.ndarray
+    lengths: np.ndarray
+    cc_fp: np.ndarray
+    todo: list
+    sections: list | None = None
+
+
 class BatchClassifier:
     """Classify many blobs against a compiled corpus.
 
@@ -60,13 +80,43 @@ class BatchClassifier:
     def __init__(
         self,
         corpus: CompiledCorpus | None = None,
-        method: str = "popcount",
+        method: str = "auto",
         pad_batch_to: int = 1024,
         mesh="auto",
+        mode: str = "license",
     ):
         from licensee_tpu.kernels.dice_xla import CorpusArrays, make_best_match_fn
 
+        if mode not in ("license", "readme", "package"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
+        if mode == "package":
+            # package manifests are matched by filename-dispatched lenient
+            # regexes alone (package_manager_file.rb matcher table) — the
+            # device never sees them, so no corpus is compiled and no
+            # scorer built; an explicit mesh is a caller error, not a
+            # silently-ignored option
+            if mesh is not None and mesh != "auto":
+                raise ValueError(
+                    "package mode runs host-only; pass mesh=None"
+                )
+            self.corpus = corpus
+            self.method = method
+            self.pad_batch_to = pad_batch_to
+            self.mesh = None
+            self._fn = None
+            self.arrays = None
+            self._exact_map = {}
+            self._nat = None
+            self._exact_hashes = {}
+            self._exact_feats = {}
+            return
         self.corpus = corpus or default_corpus()
+        if method == "auto":
+            # measured crossover on v5e (see the ADR in dice_pallas.py):
+            # popcount wins at vendored width, matmul from a few hundred
+            # templates up (the MXU amortizes the 32x unpack)
+            method = "popcount" if self.corpus.n_templates <= 128 else "matmul"
         self.method = method
         self.pad_batch_to = pad_batch_to
         self.arrays = CorpusArrays.from_compiled(self.corpus)
@@ -88,6 +138,12 @@ class BatchClassifier:
             )
 
             self._fn = make_best_match_fn_pallas(self.arrays)
+        elif method == "pallas-mxu":
+            from licensee_tpu.kernels.dice_pallas import (
+                make_best_match_fn_pallas_mxu,
+            )
+
+            self._fn = make_best_match_fn_pallas_mxu(self.arrays)
         else:
             self._fn = make_best_match_fn(self.arrays, method=method)
         # Exact matcher pre-filter: full wordset (fields included) equality
@@ -145,9 +201,9 @@ class BatchClassifier:
         if isinstance(mesh, Mesh):
             resolved = mesh
         elif mesh == "auto":
-            if method == "pallas":
-                # the hand-scheduled pallas kernel drives one chip; DP over
-                # it would need a shard_map wrapper it doesn't have yet
+            if method.startswith("pallas"):
+                # the hand-scheduled pallas kernels drive one chip; DP over
+                # them would need a shard_map wrapper they don't have yet
                 return None
             import jax
 
@@ -168,9 +224,9 @@ class BatchClassifier:
                     f"mesh axes must be positive, got ({n_data}, {n_model})"
                 )
             resolved = build_mesh(n_data=n_data, n_model=n_model)
-        if method == "pallas":
+        if method.startswith("pallas"):
             raise ValueError(
-                "the pallas method is single-device; pass mesh=None"
+                "the pallas methods are single-device; pass mesh=None"
             )
         n_data = resolved.shape["data"]
         if pad_batch_to % n_data:
@@ -231,9 +287,18 @@ class BatchClassifier:
         applies reverse_markdown; the gate lives in
         normalize/pipeline.py:_strip_html).
 
+        In readme mode each blob is first reduced to its "## License"
+        section (readme_file.rb CONTENT_REGEX via
+        ReadmeFile.license_content — the same extraction Project#readme
+        applies before constructing the file, project.rb:74-80); a blob
+        with no such section matches nothing.  The extracted sections are
+        kept on the returned batch for the Reference fallback.
+
         A blob whose featurization raises is contained: it gets an
         ``error`` result row and the rest of the batch proceeds (a single
         poisoned blob must not wedge a 10M-file run)."""
+        if self.mode == "package":
+            return self._prepare_package_batch(contents, filenames)
         B = len(contents)
         W = self.corpus.n_lanes
         bits = np.zeros((B, W), dtype=np.uint32)
@@ -241,10 +306,43 @@ class BatchClassifier:
         lengths = np.zeros(B, dtype=np.int32)
         cc_fp = np.zeros(B, dtype=bool)
         results: list[BlobResult | None] = [None] * B
+        sections: list | None = None
+        if self.mode == "readme":
+            from licensee_tpu.project_files.readme_file import ReadmeFile
+
+            sections = [None] * B
+            extracted = []
+            for raw in contents:
+                try:
+                    content = (
+                        sanitize_content(raw) if raw is not None else ""
+                    )
+                    extracted.append(ReadmeFile.license_content(content))
+                except Exception as exc:  # noqa: BLE001 — per-blob containment
+                    extracted.append(
+                        BlobResult(
+                            None, None, 0.0, error=f"featurize_error: {exc}"
+                        )
+                    )
+            for i, section in enumerate(extracted):
+                if isinstance(section, BlobResult):
+                    results[i] = section
+                elif section is None:
+                    # no license section in the README -> the project never
+                    # constructs a ReadmeFile at all (project.rb:76-78)
+                    results[i] = BlobResult(None, None, 0.0)
+                else:
+                    sections[i] = section
+            contents = [
+                sections[i] if sections[i] is not None else ""
+                for i in range(B)
+            ]
 
         from licensee_tpu.native.pipeline import NativeResourceError
 
         for i, raw in enumerate(contents):
+            if results[i] is not None:
+                continue
             filename = filenames[i] if filenames else None
             try:
                 if self._nat is not None:
@@ -276,7 +374,45 @@ class BatchClassifier:
                 lengths[i] = 0
                 cc_fp[i] = False
         todo = [i for i, r in enumerate(results) if r is None]
-        return results, bits, n_words, lengths, cc_fp, todo
+        return PreparedBatch(
+            results, bits, n_words, lengths, cc_fp, todo, sections
+        )
+
+    def _prepare_package_batch(self, contents, filenames) -> PreparedBatch:
+        """Package-manifest mode: the whole chain is host regexes.
+
+        Each blob runs the filename-dispatched matcher table of
+        package_manager_file.rb (gemspec/npm/cabal/nuget by extension,
+        DESCRIPTION/dist.ini/LICENSE.spdx/Cargo.toml by name) and reports
+        the declared license — `other` for declared-but-unknown values,
+        no match when no matcher claims the filename."""
+        from licensee_tpu.project_files.package_manager_file import (
+            PackageManagerFile,
+        )
+
+        B = len(contents)
+        results: list[BlobResult | None] = [None] * B
+        for i, raw in enumerate(contents):
+            filename = filenames[i] if filenames else None
+            try:
+                pf = PackageManagerFile(raw, filename)
+                matcher = pf.matcher
+                lic = matcher.match if matcher is not None else None
+                if matcher is not None and lic is not None:
+                    results[i] = BlobResult(
+                        lic.key, matcher.name, float(matcher.confidence)
+                    )
+                else:
+                    results[i] = BlobResult(None, None, 0.0)
+            except Exception as exc:  # noqa: BLE001 — per-blob containment
+                results[i] = BlobResult(
+                    None, None, 0.0, error=f"featurize_error: {exc}"
+                )
+        empty = np.zeros((B, 0), dtype=np.uint32)
+        zeros = np.zeros(B, dtype=np.int32)
+        return PreparedBatch(
+            results, empty, zeros, zeros, np.zeros(B, dtype=bool), []
+        )
 
     def _prepare_one_python(
         self, raw, results, bits, n_words, lengths, cc_fp, i, prefilter=True,
@@ -360,18 +496,25 @@ class BatchClassifier:
         threshold = (
             licensee_tpu.confidence_threshold() if threshold is None else threshold
         )
-        results, bits, n_words, lengths, cc_fp, todo = self.prepare_batch(
+        prepared = self.prepare_batch(
             contents, prefilter=prefilter, filenames=filenames
         )
-        outs = self.dispatch_chunks(bits, n_words, lengths, cc_fp, todo)
-        self.finish_chunks(results, todo, outs, threshold)
-        return results  # type: ignore[return-value]
+        outs = self.dispatch_chunks(prepared)
+        self.finish_chunks(prepared, outs, threshold)
+        return prepared.results  # type: ignore[return-value]
 
-    def dispatch_chunks(self, bits, n_words, lengths, cc_fp, todo):
+    def dispatch_chunks(self, prepared: PreparedBatch):
         """Launch device scoring for the ``todo`` rows in fixed-size padded
         chunks.  The returned device outputs are lazy (JAX dispatch is
         asynchronous): the host featurizes the next batch while the device
         scores this one; finish_chunks() synchronizes."""
+        bits, n_words, lengths, cc_fp, todo = (
+            prepared.bits,
+            prepared.n_words,
+            prepared.lengths,
+            prepared.cc_fp,
+            prepared.todo,
+        )
         outs = []
         B = self.pad_batch_to
         for start in range(0, len(todo), B):
@@ -393,9 +536,15 @@ class BatchClassifier:
             outs.append((chunk, self._fn(b, nw, ln, cf)))
         return outs
 
-    def finish_chunks(self, results, todo, outs, threshold) -> None:
+    def finish_chunks(self, prepared: PreparedBatch, outs, threshold) -> None:
         """Synchronize device outputs and finish scores in float64 —
-        identical to Ruby's Float score (dice.rb:57-59)."""
+        identical to Ruby's Float score (dice.rb:57-59).
+
+        In readme mode a blob the Dice pass left unmatched falls through
+        to the Reference matcher (the last entry of the readme chain,
+        readme_file.rb:32-34): a license named by title or source URL in
+        the extracted section matches at confidence 90."""
+        results = prepared.results
         for chunk, (best_idx, best_num, best_den) in outs:
             best_idx = np.asarray(best_idx)[: len(chunk)]
             best_num = np.asarray(best_num)[: len(chunk)]
@@ -412,5 +561,26 @@ class BatchClassifier:
                     )
                 else:
                     results[i] = BlobResult(None, None, 0.0)
+        if self.mode == "readme" and prepared.sections is not None:
+            for i, section in enumerate(prepared.sections):
+                r = results[i]
+                if section is None or r is None or r.key or r.error:
+                    continue
+                lic = self._reference_match(section)
+                if lic is not None:
+                    results[i] = BlobResult(lic.key, "reference", 90.0)
+
+    @staticmethod
+    def _reference_match(section: str):
+        """The Reference matcher over one extracted section
+        (matchers/reference.rb:7-11): first license whose title/source
+        regex hits.  Regexes are compiled once per License and the pool is
+        process-global, so a 50M-readme scan pays zero recompilation."""
+        from licensee_tpu.corpus.license import License
+
+        for lic in License.all(hidden=True, pseudo=False):
+            if lic.reference_regex.search(section):
+                return lic
+        return None
 
 

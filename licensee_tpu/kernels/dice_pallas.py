@@ -31,6 +31,43 @@ pipeline gives the same overlap a gridded emission would.
 
 On non-TPU backends the kernel runs in interpreter mode (what the CPU
 test suite exercises); numerics are identical either way.
+
+ADR — why the default scoring path is XLA, not these kernels
+------------------------------------------------------------
+Measured on a real v5e-1 (2026-07-30, B=262144, batch.py shapes; full
+table reproduced by `python bench.py`):
+
+    method        T=47 (vendored)   T=608 (full SPDX width)
+    xla popcount      35.5 M/s            3.9 M/s
+    xla matmul        34.5 M/s            8.6 M/s   <- winner at width
+    pallas (SWAR)     23.6 M/s            1.6 M/s
+    pallas-mxu         9.0 M/s            3.6 M/s
+
+* The SWAR kernel is VPU-bound: ~20 vector ops per (8, TILE_B, W)
+  block scale linearly with T, so it falls furthest behind exactly
+  where the corpus grows.  Its DMA pipeline is sound — it just races
+  a systolic array with an ALU.
+* The MXU variant fuses the int8 unpack into VMEM (the XLA matmul
+  path round-trips a ~2 GiB unpacked LHS through HBM), but the
+  in-kernel unpack pays a u32->int8 relayout per slice (32-bit (8,128)
+  tiling to 8-bit (32,128) tiling) that dominates at small T, and the
+  Mosaic int8 dot lowers well below the MXU's int8 peak (~65 TOPS
+  observed incl. unpack vs ~394 peak), so fusion never recovers what
+  the dot loses.  T-scaling is right (fixed unpack + linear dot); the
+  constant is not.
+* XLA's own unpack+dot_general pipelines the same MXU at higher
+  utilization, and its popcount path vectorizes the whole B×T×W
+  intersection better than the hand-tiled loop at small T.
+
+Decision: `BatchClassifier(method="auto")` picks popcount for T<=128
+and matmul above; both pallas kernels stay as bit-identical,
+fully-tested alternates (`--method pallas|pallas-mxu`) and as the
+in-tree reference for manual DMA pipelining and fused MXU feeding on
+this toolchain.  Revisit if Mosaic's int8 dot reaches native rate —
+the MXU variant's VMEM arithmetic then beats the HBM round-trip by
+construction.  The device is >99% idle against the host featurizer
+either way (see bench.py end_to_end), so the end-to-end number does
+not move with this choice.
 """
 
 from __future__ import annotations
@@ -194,6 +231,228 @@ def _make_kernel(n_templates: int, tile_b: int, n_tiles: int):
 
 def _should_interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
+
+
+# ---------------------------------------------------------------------------
+# MXU variant: fused unpack + int8 systolic contraction
+# ---------------------------------------------------------------------------
+#
+# The XLA matmul path (`dice_xla._overlap_matmul`) unpacks the whole
+# uint32[B, W] slab to int8[B, 32W] before the dot — at B=256k, W=256
+# that is a ~2 GiB HBM intermediate written and re-read around the MXU.
+# This kernel keeps the blow-up in VMEM: each (TILE_B, W) tile is DMA'd
+# in packed, unpacked to int8[TILE_B, 32W] on the VPU (32 unrolled
+# shift-and-mask ops), and contracted against the VMEM-resident unpacked
+# template matrix on the MXU — so HBM only ever carries the 32×-smaller
+# packed bits plus the (B, T) overlap result.
+#
+# Bit layout is BIT-MAJOR (column i*W + w holds bit i of lane w), not the
+# w*32+i order of `dice_xla._unpack_bits`: bit-major lets the in-kernel
+# unpack write 32 contiguous lane-aligned (TILE_B, W) slices instead of a
+# stride-32 scatter.  The template matrix is unpacked once on host in the
+# same order (`_unpack_bits_bitmajor`), and the dot contracts the shared
+# V axis, so the order never escapes the kernel.
+
+
+def _unpack_bits_bitmajor(packed: np.ndarray) -> np.ndarray:
+    """uint32[N, W] -> int8[N, 32*W], column i*W + w = bit i of lane w."""
+    N, W = packed.shape
+    expanded = (
+        packed[:, None, :] >> np.arange(32, dtype=np.uint32)[None, :, None]
+    ) & np.uint32(1)
+    return expanded.astype(np.int8).reshape(N, 32 * W)
+
+
+def _make_mxu_kernel(n_templates: int, tile_b: int, n_tiles: int, w: int):
+    def kernel(tpl_ref, file_hbm, out_hbm, tile_buf, unpacked_buf,
+               out_buf, copy_sems, out_sems):
+        i0, i1_ = jnp.int32(0), jnp.int32(1)
+        nb = jnp.int32(N_BUFFERS)
+
+        def in_dma(slot, tile):
+            return pltpu.make_async_copy(
+                file_hbm.at[pl.ds(tile * tile_b, tile_b), :],
+                tile_buf.at[slot],
+                copy_sems.at[slot],
+            )
+
+        def out_dma(slot, tile):
+            return pltpu.make_async_copy(
+                out_buf.at[slot],
+                out_hbm.at[pl.ds(tile * tile_b, tile_b), :],
+                out_sems.at[slot],
+            )
+
+        in_dma(jnp.int32(0), jnp.int32(0)).start()
+
+        def tile_body(tile, carry):
+            slot = lax.rem(tile, nb)
+            next_slot = lax.rem(tile + i1_, nb)
+
+            @pl.when(tile + i1_ < jnp.int32(n_tiles))
+            def _():
+                in_dma(next_slot, tile + i1_).start()
+
+            in_dma(slot, tile).wait()
+
+            @pl.when(tile >= nb)
+            def _():
+                out_dma(slot, tile - nb).wait()
+
+            packed = tile_buf[slot]                      # (TILE_B, W) u32
+            # VPU unpack: 32 contiguous (TILE_B, W) int8 slices
+            for i in range(32):
+                bit = (packed >> jnp.uint32(i)) & jnp.uint32(1)
+                unpacked_buf[:, i * w : (i + 1) * w] = bit.astype(jnp.int8)
+
+            # the 128×128 systolic contraction over V = 32W; templates are
+            # stored (V, T) so the MXU reads both operands in layout —
+            # a (T, V) rhs would cost a VMEM transpose copy (and the VMEM
+            # headroom for one: 5 MiB at T=640)
+            out_buf[slot] = lax.dot_general(
+                unpacked_buf[:, :],
+                tpl_ref[:, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+
+            out_dma(slot, tile).start()
+            return carry
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(n_tiles), tile_body,
+                      jnp.int32(0))
+        for k in range(min(N_BUFFERS, n_tiles)):
+            tile = jnp.int32(n_tiles - 1 - k)
+            out_dma(lax.rem(tile, nb), tile).wait()
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("tile_b", "interpret"))
+def _overlap_mxu_padded(tpl_unpacked, file_bits, tile_b: int,
+                        interpret: bool):
+    """overlap int32[B, T] with B % tile_b == 0, W % LANE == 0, and
+    T % MXU_TPL_ALIGN == 0; `tpl_unpacked` is int8[32W, T]."""
+    B, W = file_bits.shape
+    T = tpl_unpacked.shape[1]
+    n_tiles = B // tile_b
+
+    return pl.pallas_call(
+        _make_mxu_kernel(T, tile_b, n_tiles, W),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # unpacked templates
+            pl.BlockSpec(memory_space=pl.ANY),       # packed file slab
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((B, T), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((N_BUFFERS, tile_b, W), jnp.uint32),
+            pltpu.VMEM((tile_b, 32 * W), jnp.int8),
+            pltpu.VMEM((N_BUFFERS, tile_b, T), jnp.int32),
+            pltpu.SemaphoreType.DMA((N_BUFFERS,)),
+            pltpu.SemaphoreType.DMA((N_BUFFERS,)),
+        ],
+        interpret=interpret,
+    )(tpl_unpacked, file_bits)
+
+
+# T is the lane dimension of both the dot result and the out_buf DMA
+# slices, so it must be padded to full lanes (int8 sublane tiling would
+# allow 32, but Mosaic memref slicing requires 128 on the minor dim)
+MXU_TPL_ALIGN = 128
+
+_MXU_CACHE: dict[int, tuple] = {}
+
+
+def _mxu_corpus_cached(corpus: CorpusArrays):
+    """Unpacked bit-major template matrix + padded T, cached like
+    `_packed_corpus_cached` (weakref-guarded id keying)."""
+    import weakref
+
+    key = id(corpus)
+    hit = _MXU_CACHE.get(key)
+    if hit is not None and hit[0]() is corpus:
+        return hit[1:]
+    for k in [k for k, v in _MXU_CACHE.items() if v[0]() is None]:
+        del _MXU_CACHE[k]
+    bits = np.asarray(corpus.bits)
+    T, W = bits.shape
+    T_pad = _round_up(max(T, MXU_TPL_ALIGN), MXU_TPL_ALIGN)
+    W_pad = _round_up(max(W, LANE), LANE)
+    padded = np.zeros((T_pad, W_pad), dtype=np.uint32)
+    padded[:T, :W] = bits
+    tpl = jnp.asarray(
+        np.ascontiguousarray(_unpack_bits_bitmajor(padded).T)
+    )  # (V, T): contraction-major for the in-kernel dot
+    entry = (tpl, T)
+    _MXU_CACHE[key] = (weakref.ref(corpus), *entry)
+    return entry
+
+
+def overlap_pairs_mxu(corpus: CorpusArrays, file_bits,
+                      tile_b: int = DEFAULT_TILE_B,
+                      interpret: bool | None = None):
+    """int32[B, T] intersection sizes via the fused-unpack MXU kernel —
+    drop-in for `dice_xla.overlap_pairs` (bit-identical)."""
+    if interpret is None:
+        interpret = _should_interpret()
+    tpl, T = _mxu_corpus_cached(corpus)
+    fb, _, B, tile_b = pack_features(
+        tpl.shape[0] // 32, file_bits,
+        np.zeros(np.asarray(file_bits).shape[0], np.int32),
+        np.zeros(np.asarray(file_bits).shape[0], np.int32),
+        np.zeros(np.asarray(file_bits).shape[0], bool), tile_b)
+    overlap = _overlap_mxu_padded(
+        tpl, jnp.asarray(fb), tile_b=tile_b, interpret=interpret
+    )
+    return overlap[:B, :T]
+
+
+def make_best_match_fn_pallas_mxu(corpus: CorpusArrays,
+                                  tile_b: int = DEFAULT_TILE_B,
+                                  interpret: bool | None = None):
+    """Drop-in for `dice_xla.make_best_match_fn`, method='pallas-mxu':
+    pallas MXU overlap + the shared exact algebra/ranking epilogue."""
+    prepare, scorer = make_padded_best_match_fn_mxu(
+        corpus, tile_b=tile_b, interpret=interpret
+    )
+
+    def fn(file_bits, n_words, lengths, cc_fp):
+        B = np.asarray(file_bits).shape[0]
+        idx, num, den = scorer(*prepare(file_bits, n_words, lengths, cc_fp))
+        return idx[:B], num[:B], den[:B]
+
+    return fn
+
+
+def make_padded_best_match_fn_mxu(corpus: CorpusArrays,
+                                  tile_b: int = DEFAULT_TILE_B,
+                                  interpret: bool | None = None):
+    """Steady-state (prepare, fn) pair for the MXU kernel; `fn` runs
+    kernel + `finish_scores` + `_argmax_exact` as one jitted dispatch."""
+    from licensee_tpu.kernels.dice_xla import finish_scores
+
+    if interpret is None:
+        interpret = _should_interpret()
+    tpl, T = _mxu_corpus_cached(corpus)
+    W = tpl.shape[0] // 32
+
+    def prepare(file_bits, n_words, lengths, cc_fp):
+        fb, cols, _, _ = pack_features(
+            W, file_bits, n_words, lengths, cc_fp, tile_b)
+        return jnp.asarray(fb), jnp.asarray(cols)
+
+    @jax.jit
+    def fn(fb, cols):
+        tb = max(LANE, _round_up(min(tile_b, fb.shape[0]), LANE))
+        overlap = _overlap_mxu_padded(tpl, fb, tile_b=tb,
+                                      interpret=interpret)[:, :T]
+        num, den = finish_scores(
+            corpus, overlap, cols[0], cols[1], cols[2].astype(bool)
+        )
+        return _argmax_exact(num, den)
+
+    return prepare, fn
 
 
 @functools.partial(jax.jit, static_argnames=("tile_b", "interpret"))

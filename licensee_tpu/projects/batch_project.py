@@ -39,6 +39,8 @@ class BatchStats:
     prefiltered_copyright: int = 0
     prefiltered_exact: int = 0
     dice_matched: int = 0
+    reference_matched: int = 0
+    package_matched: int = 0
     unmatched: int = 0
     read_errors: int = 0
     featurize_errors: int = 0
@@ -69,7 +71,7 @@ class BatchProject:
         self,
         manifest_paths: list[str],
         corpus=None,
-        method: str = "popcount",
+        method: str = "auto",
         batch_size: int = 4096,
         threshold: float | None = None,
         workers: int | None = None,
@@ -78,6 +80,7 @@ class BatchProject:
         classifier=None,
         process_index: int | None = None,
         process_count: int | None = None,
+        mode: str = "license",
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -112,7 +115,11 @@ class BatchProject:
         # a caller-supplied classifier (pad_batch_to must equal batch_size)
         # reuses its compiled scorer across runs — e.g. a warmed-up one
         self.classifier = classifier or BatchClassifier(
-            corpus=corpus, method=method, pad_batch_to=batch_size, mesh=mesh
+            corpus=corpus,
+            method=method,
+            pad_batch_to=batch_size,
+            mesh=mesh,
+            mode=mode,
         )
         if self.classifier.pad_batch_to != batch_size:
             raise ValueError(
@@ -179,20 +186,16 @@ class BatchProject:
 
     def _dispatch(self, prepared):
         """Main-thread stage: launch device scoring (asynchronous)."""
-        results, bits, n_words, lengths, cc_fp, todo = prepared
-        if not todo:
+        if not prepared.todo:
             return None
-        return self.classifier.dispatch_chunks(
-            bits, n_words, lengths, cc_fp, todo
-        )
+        return self.classifier.dispatch_chunks(prepared)
 
     def _finish(self, prepared, device_out) -> list:
-        results, bits, n_words, lengths, cc_fp, todo = prepared
         if device_out is not None:
             self.classifier.finish_chunks(
-                results, todo, device_out, self.threshold
+                prepared, device_out, self.threshold
             )
-        return results
+        return prepared.results
 
     def run(self, output: str, resume: bool = True) -> BatchStats:
         if self.process_count > 1:
@@ -261,8 +264,14 @@ class BatchProject:
         self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats
 
-    def classify_contents(self, contents: list[bytes | str]) -> list:
-        results = self.classifier.classify_blobs(contents, threshold=self.threshold)
+    def classify_contents(
+        self,
+        contents: list[bytes | str],
+        filenames: list[str | None] | None = None,
+    ) -> list:
+        results = self.classifier.classify_blobs(
+            contents, threshold=self.threshold, filenames=filenames
+        )
         for result in results:
             if result.error:
                 self.stats.featurize_errors += 1
@@ -278,5 +287,11 @@ class BatchProject:
             self.stats.prefiltered_exact += 1
         elif result.matcher == "dice":
             self.stats.dice_matched += 1
+        elif result.matcher == "reference":
+            self.stats.reference_matched += 1
+        elif result.matcher is not None:
+            # package mode: gemspec/npmbower/cabal/cargo/cran/distzilla/
+            # nuget/spdx filename-dispatched matchers
+            self.stats.package_matched += 1
         else:
             self.stats.unmatched += 1

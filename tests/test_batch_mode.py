@@ -1,0 +1,176 @@
+"""batch-detect --mode readme|package: the ReadmeFile / PackageManagerFile
+chains at batch scale (north-star config 5: 50M mixed files).
+
+Parity targets: `readme_file.rb` (section extraction + Reference fallback,
+exercised by spec/licensee/project_files/readme_file_spec.rb) and
+`package_manager_file.rb` (filename-dispatched package matchers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from licensee_tpu.kernels.batch import BatchClassifier
+from licensee_tpu.projects.batch_project import BatchProject
+from tests.conftest import fixture_path
+
+
+def fixture_bytes(name: str) -> bytes:
+    with open(fixture_path(name), "rb") as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def readme_clf():
+    return BatchClassifier(pad_batch_to=16, mesh=None, mode="readme")
+
+
+@pytest.fixture(scope="module")
+def package_clf():
+    return BatchClassifier(mode="package")
+
+
+# -- readme mode --
+
+
+def test_readme_full_text_section_dices(readme_clf):
+    # full MIT text under "## License" -> extracted, then Exact fires
+    # first in the chain (license_file.rb order: Copyright, Exact, Dice)
+    results = readme_clf.classify_blobs([fixture_bytes("readme/README.md")])
+    assert results[0].key == "mit"
+    assert results[0].matcher == "exact"
+    assert results[0].confidence >= 98
+
+
+def test_readme_reference_fallback(readme_clf):
+    # a title-only mention matches via the Reference matcher at 90
+    # (readme_file.rb:32-34; matchers/reference.rb)
+    results = readme_clf.classify_blobs(
+        [fixture_bytes("license-with-readme-reference/README")]
+    )
+    assert results[0].key == "mit"
+    assert results[0].matcher == "reference"
+    assert results[0].confidence == 90.0
+
+
+def test_readme_without_license_section_is_unmatched(readme_clf):
+    results = readme_clf.classify_blobs(
+        [b"# Project\n\nJust a readme, no license header.\n"]
+    )
+    assert results[0].key is None
+    assert results[0].matcher is None
+
+
+def test_readme_mode_agrees_with_scalar_chain(readme_clf):
+    """Every README fixture through the batch readme chain must equal the
+    scalar ReadmeFile chain (the project wiring of project.rb:74-80)."""
+    from licensee_tpu.project_files.project_file import sanitize_content
+    from licensee_tpu.project_files.readme_file import ReadmeFile
+
+    names = [
+        "readme/README.md",
+        "mit/README.md",
+        "license-with-readme-reference/README",
+        "apache-with-readme-notice/README.md",
+        "readme-invalid-encoding/README.md",
+        "license-folder/README.md",
+    ]
+    contents = [fixture_bytes(n) for n in names]
+    batch = readme_clf.classify_blobs(contents)
+    for name, raw, got in zip(names, contents, batch):
+        section = ReadmeFile.license_content(sanitize_content(raw))
+        if not section:
+            want_key, want_matcher = None, None
+        else:
+            file = ReadmeFile(section, os.path.basename(name))
+            matcher = file.matcher
+            want_key = file.license.key if file.license else None
+            want_matcher = matcher.name if matcher else None
+        assert got.key == want_key, name
+        assert got.matcher == want_matcher, name
+
+
+# -- package mode --
+
+
+def test_package_gemspec(package_clf):
+    results = package_clf.classify_blobs(
+        [fixture_bytes("gemspec/project._gemspec")],
+        filenames=["project.gemspec"],
+    )
+    assert results[0].key == "mit"
+    assert results[0].matcher == "gemspec"
+    assert results[0].confidence == 90.0
+
+
+def test_package_mixed_filenames(package_clf):
+    contents = [
+        b'{\n  "license": "MIT"\n}\n',
+        b'[package]\nname = "x"\nlicense = "Apache-2.0"\n',
+        b"Package: xyz\nLicense: MIT + file LICENSE\n",
+        b'{\n  "license": "NotARealLicense"\n}\n',
+        b"no matcher claims this filename",
+    ]
+    filenames = [
+        "package.json",
+        "Cargo.toml",
+        "DESCRIPTION",
+        "package.json",
+        "README.md",
+    ]
+    results = package_clf.classify_blobs(contents, filenames=filenames)
+    assert [(r.key, r.matcher) for r in results] == [
+        ("mit", "npmbower"),
+        ("apache-2.0", "cargo"),
+        ("mit", "cran"),
+        ("other", "npmbower"),  # declared-but-unknown -> other (package.rb)
+        (None, None),
+    ]
+
+
+def test_package_mode_needs_no_device(package_clf):
+    # the device scorer is never built: package matching is host regexes
+    assert package_clf._fn is None
+    assert package_clf.arrays is None
+
+
+# -- BatchProject pipeline + CLI --
+
+
+def test_batch_project_readme_pipeline(tmp_path):
+    import shutil
+
+    paths = []
+    for i, name in enumerate(
+        ["readme/README.md", "license-with-readme-reference/README"]
+    ):
+        dst = tmp_path / f"README_{i}.md"
+        shutil.copy(fixture_path(name), dst)
+        paths.append(str(dst))
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(paths, batch_size=4, mesh=None, mode="readme")
+    stats = project.run(str(out), resume=False)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["key"] for r in rows] == ["mit", "mit"]
+    assert [r["matcher"] for r in rows] == ["exact", "reference"]
+    assert stats.prefiltered_exact == 1
+    assert stats.reference_matched == 1
+
+
+def test_cli_batch_detect_package_mode(tmp_path, capsys):
+    from licensee_tpu.cli.main import main
+
+    pkg = tmp_path / "package.json"
+    pkg.write_text('{"license": "MIT"}\n')
+    manifest = tmp_path / "manifest.txt"
+    manifest.write_text(f"{pkg}\n")
+    assert main(["batch-detect", str(manifest), "--mode", "package"]) == 0
+    rows = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert rows[0]["key"] == "mit"
+    assert rows[0]["matcher"] == "npmbower"

@@ -140,3 +140,59 @@ def test_batch_classifier_pallas_agrees_with_default(corpus):
     for d, p in zip(default, pallas):
         assert (d.key, d.matcher) == (p.key, p.matcher)
         assert d.confidence == p.confidence
+
+
+# -- the MXU (fused-unpack int8 dot) variant --
+
+
+@pytest.mark.parametrize("B", [1, 7, 129, 300])
+def test_mxu_overlap_matches_xla(corpus, arrays, B):
+    from licensee_tpu.kernels.dice_xla import overlap_pairs
+    from licensee_tpu.kernels.dice_pallas import overlap_pairs_mxu
+
+    bits = random_features(corpus, B, seed=B)[0]
+    ref = np.asarray(overlap_pairs(arrays, bits, "popcount"))
+    mxu = np.asarray(overlap_pairs_mxu(arrays, bits))
+    np.testing.assert_array_equal(ref, mxu)
+
+
+def test_mxu_best_match_matches_xla(corpus, arrays):
+    from licensee_tpu.kernels.dice_pallas import make_best_match_fn_pallas_mxu
+
+    feats = random_features(corpus, 200, seed=11)
+    ref = make_best_match_fn(arrays)(*feats)
+    mxu = make_best_match_fn_pallas_mxu(arrays)(*feats)
+    for a, b in zip(ref, mxu):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_batch_classifier_pallas_mxu_agrees_with_default(corpus):
+    import re
+
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    contents = []
+    for lic in License.all(hidden=True, pseudo=False)[:8]:
+        text = re.sub(r"\[(\w+)\]", "example", lic.content or "")
+        contents.append(text + "\nwith a little trailing noise")
+
+    default = BatchClassifier(pad_batch_to=64).classify_blobs(contents)
+    mxu = BatchClassifier(method="pallas-mxu", pad_batch_to=64).classify_blobs(
+        contents
+    )
+    for d, p in zip(default, mxu):
+        assert (d.key, d.matcher, d.confidence) == (p.key, p.matcher, p.confidence)
+
+
+def test_auto_method_resolution(tmp_path):
+    """method='auto' picks the measured winner by corpus width (the ADR
+    table in dice_pallas.py): popcount <=128 templates, matmul above."""
+    from licensee_tpu.corpus.spdx import spdx_corpus
+    from licensee_tpu.corpus.spdx_synth import synth_spdx_dir
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    assert BatchClassifier(pad_batch_to=16).method == "popcount"
+
+    wide = spdx_corpus(synth_spdx_dir(str(tmp_path / "w"), 130))
+    assert BatchClassifier(corpus=wide, pad_batch_to=16).method == "matmul"

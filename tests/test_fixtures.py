@@ -15,10 +15,14 @@ from tests.conftest import FIXTURES_DIR, fixture_path
 with open(fixture_path("fixtures.yml"), encoding="utf-8") as f:
     FIXTURE_LICENSES = yaml.safe_load(f)
 
+# data-only fixture dirs (not project trees mirrored from spec/fixtures)
+_NON_PROJECT = {"spdx-adversarial"}
+
 FIXTURES = sorted(
     name
     for name in os.listdir(FIXTURES_DIR)
     if os.path.isdir(os.path.join(FIXTURES_DIR, name))
+    and name not in _NON_PROJECT
 )
 
 
