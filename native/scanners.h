@@ -17,6 +17,10 @@
 #include <string>
 #include <vector>
 
+#if defined(__SSE2__)
+#include <emmintrin.h>
+#endif
+
 namespace licensee_scanners {
 
 // byte class tables: one L1 load per byte beats chained comparisons in
@@ -39,6 +43,127 @@ inline constexpr ByteTables kBT{};
 
 inline bool is_space(unsigned char c) { return kBT.space[c]; }
 inline bool is_word(unsigned char c) { return kBT.word[c]; }
+
+// ---------------------------------------------------------------------------
+// Vectorized byte finders (SSE2 is the x86-64 baseline; every helper has
+// the scalar tail/fallback, so non-x86 builds just take the slow path).
+// These are what make the scanners span-oriented: the hot loops jump from
+// candidate to candidate at ~16 B/cycle instead of testing every byte.
+
+#if defined(__SSE2__)
+// 16-lane word-class mask: [A-Za-z0-9_]
+inline int word_mask16(const char *p) {
+  const __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+  const __m128i lower = _mm_or_si128(v, _mm_set1_epi8(0x20));
+  const __m128i ge_a = _mm_cmpeq_epi8(_mm_max_epu8(lower, _mm_set1_epi8('a')), lower);
+  const __m128i le_z = _mm_cmpeq_epi8(_mm_min_epu8(lower, _mm_set1_epi8('z')), lower);
+  const __m128i ge_0 = _mm_cmpeq_epi8(_mm_max_epu8(v, _mm_set1_epi8('0')), v);
+  const __m128i le_9 = _mm_cmpeq_epi8(_mm_min_epu8(v, _mm_set1_epi8('9')), v);
+  const __m128i word = _mm_or_si128(
+      _mm_or_si128(_mm_and_si128(ge_a, le_z), _mm_and_si128(ge_0, le_9)),
+      _mm_cmpeq_epi8(v, _mm_set1_epi8('_')));
+  return _mm_movemask_epi8(word);
+}
+#endif
+
+// first word-class byte
+inline const char *find_wordbyte(const char *p, const char *end) {
+#if defined(__SSE2__)
+  while (end - p >= 16) {
+    int mask = word_mask16(p);
+    if (mask) return p + __builtin_ctz(static_cast<unsigned>(mask));
+    p += 16;
+  }
+#endif
+  while (p < end && !kBT.word[static_cast<unsigned char>(*p)]) ++p;
+  return p;
+}
+
+// first NON-word byte
+inline const char *find_nonword(const char *p, const char *end) {
+#if defined(__SSE2__)
+  while (end - p >= 16) {
+    int mask = word_mask16(p) ^ 0xFFFF;
+    if (mask) return p + __builtin_ctz(static_cast<unsigned>(mask));
+    p += 16;
+  }
+#endif
+  while (p < end && kBT.word[static_cast<unsigned char>(*p)]) ++p;
+  return p;
+}
+
+#if defined(__SSE2__)
+// 16-lane wordset-token-class mask: [A-Za-z0-9_/-]
+inline int tok_mask16(const char *p) {
+  const __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+  return word_mask16(p) |
+         _mm_movemask_epi8(
+             _mm_or_si128(_mm_cmpeq_epi8(v, _mm_set1_epi8('/')),
+                          _mm_cmpeq_epi8(v, _mm_set1_epi8('-'))));
+}
+#endif
+
+// first token-class byte
+inline const char *find_tokbyte(const char *p, const char *end) {
+#if defined(__SSE2__)
+  while (end - p >= 16) {
+    int mask = tok_mask16(p);
+    if (mask) return p + __builtin_ctz(static_cast<unsigned>(mask));
+    p += 16;
+  }
+#endif
+  while (p < end && !kBT.tok[static_cast<unsigned char>(*p)]) ++p;
+  return p;
+}
+
+// first NON-token-class byte
+inline const char *find_nontok(const char *p, const char *end) {
+#if defined(__SSE2__)
+  while (end - p >= 16) {
+    int mask = tok_mask16(p) ^ 0xFFFF;
+    if (mask) return p + __builtin_ctz(static_cast<unsigned>(mask));
+    p += 16;
+  }
+#endif
+  while (p < end && kBT.tok[static_cast<unsigned char>(*p)]) ++p;
+  return p;
+}
+
+// first byte equal to a or b
+inline const char *find_byte2(const char *p, const char *end, char a, char b) {
+#if defined(__SSE2__)
+  const __m128i va = _mm_set1_epi8(a), vb = _mm_set1_epi8(b);
+  while (end - p >= 16) {
+    __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+    int mask = _mm_movemask_epi8(
+        _mm_or_si128(_mm_cmpeq_epi8(v, va), _mm_cmpeq_epi8(v, vb)));
+    if (mask) return p + __builtin_ctz(static_cast<unsigned>(mask));
+    p += 16;
+  }
+#endif
+  while (p < end && *p != a && *p != b) ++p;
+  return p;
+}
+
+// first byte equal to any of {a, b, c, d}
+inline const char *find_byte4(const char *p, const char *end, char a, char b,
+                              char c, char d) {
+#if defined(__SSE2__)
+  const __m128i va = _mm_set1_epi8(a), vb = _mm_set1_epi8(b),
+                vc = _mm_set1_epi8(c), vd = _mm_set1_epi8(d);
+  while (end - p >= 16) {
+    __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+    __m128i m = _mm_or_si128(
+        _mm_or_si128(_mm_cmpeq_epi8(v, va), _mm_cmpeq_epi8(v, vb)),
+        _mm_or_si128(_mm_cmpeq_epi8(v, vc), _mm_cmpeq_epi8(v, vd)));
+    int mask = _mm_movemask_epi8(m);
+    if (mask) return p + __builtin_ctz(static_cast<unsigned>(mask));
+    p += 16;
+  }
+#endif
+  while (p < end && *p != a && *p != b && *p != c && *p != d) ++p;
+  return p;
+}
 
 // length of the dash token at p (end exclusive), 0 if none.
 // tokens: '-' (1 byte), U+2013 "\xe2\x80\x93", U+2014 "\xe2\x80\x94"
@@ -111,16 +236,67 @@ inline std::string strip_whitespace(const char *data, size_t len) {
   out.resize(len);
   char *base = &out[0];
   char *dst = base;
-  size_t i = 0;
-  while (i < len) {
-    char ch = data[i++];
+  const char *p = data;
+  const char *end = data + len;
+#if defined(__SSE2__)
+  // Vector plan per 16-byte block: normalize every space-class byte to
+  // ' ' with a blend and store all 16; bytes that are the 2nd+ of a
+  // space run ("run bits") must additionally be DROPPED — absent run
+  // bits (the common case: single spaces between words) the block is
+  // done in 5 vector ops; with them, the block falls back to the scalar
+  // walk.  `carry` threads run detection across block boundaries.
+  const __m128i sp = _mm_set1_epi8(' ');
+  const __m128i nine = _mm_set1_epi8(9);
+  const __m128i four = _mm_set1_epi8(4);
+  unsigned carry = 0;  // 1 if the previous byte was space-class
+  while (end - p >= 16) {
+    __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+    // space class {9,a,b,c,d,20}: v==' ' or (v-9) unsigned <= 4
+    __m128i t = _mm_sub_epi8(v, nine);
+    __m128i m = _mm_or_si128(_mm_cmpeq_epi8(v, sp),
+                             _mm_cmpeq_epi8(_mm_min_epu8(t, four), t));
+    unsigned mask = static_cast<unsigned>(_mm_movemask_epi8(m));
+    __m128i blended =
+        _mm_or_si128(_mm_andnot_si128(m, v), _mm_and_si128(m, sp));
+    unsigned run = mask & ((mask << 1) | carry);
+    _mm_storeu_si128(reinterpret_cast<__m128i *>(dst), blended);
+    if (run == 0) {
+      dst += 16;
+    } else {
+      // rewrite the block scalar-wise, dropping run bytes
+      char *w = dst;
+      for (int k = 0; k < 16; ++k) {
+        if (run & (1u << k)) continue;
+        *w++ = (mask & (1u << k)) ? ' ' : p[k];
+      }
+      dst = w;
+    }
+    carry = (mask >> 15) & 1u;
+    p += 16;
+  }
+  // scalar tail (plus non-SSE2 fallback below shares this loop shape)
+  while (p < end) {
+    char ch = *p++;
     if (kBT.space[static_cast<unsigned char>(ch)]) {
-      while (i < len && kBT.space[static_cast<unsigned char>(data[i])]) ++i;
+      if (carry) continue;
+      carry = 1;
+      *dst++ = ' ';
+    } else {
+      carry = 0;
+      *dst++ = ch;
+    }
+  }
+#else
+  while (p < end) {
+    char ch = *p++;
+    if (kBT.space[static_cast<unsigned char>(ch)]) {
+      while (p < end && kBT.space[static_cast<unsigned char>(*p)]) ++p;
       *dst++ = ' ';  // squeeze makes the double-space case moot
     } else {
       *dst++ = ch;
     }
   }
+#endif
   const char *a = base, *b = dst;
   while (a < b && is_strippable(*a)) ++a;
   while (b > a && is_strippable(b[-1])) --b;
@@ -142,11 +318,7 @@ inline std::string dashes(const char *data, size_t len) {
     // span copy up to the next dash candidate ('-' or the 0xe2 lead byte
     // of the en/em dashes)
     const char *start = p;
-    while (p < end) {
-      unsigned char c = static_cast<unsigned char>(*p);
-      if (c == '-' || c == 0xe2) break;
-      ++p;
-    }
+    p = find_byte2(p, end, '-', static_cast<char>(0xe2));
     out.append(start, p - start);
     if (p >= end) break;
     size_t t = dash_token(p, end);
@@ -204,11 +376,18 @@ inline std::string quotes(const char *data, size_t len) {
   const char *end = data + len;
   const char *p = data;
   while (p < end) {
+    // span-copy to the next quote candidate
+    const char *q = find_byte4(p, end, '`', '\'', '"',
+                               static_cast<char>(0xe2));
+    std::memcpy(dst, p, q - p);
+    dst += q - p;
+    p = q;
+    if (p >= end) break;
     unsigned char c = static_cast<unsigned char>(*p);
     if (c == '`' || c == '\'' || c == '"') {
       *dst++ = '\'';
       ++p;
-    } else if (c == 0xe2) {
+    } else {  // 0xe2: curly quote or some other three-byte sequence
       size_t t = quote_token(p, end);
       if (t) {
         *dst++ = '\'';
@@ -216,8 +395,6 @@ inline std::string quotes(const char *data, size_t len) {
       } else {
         *dst++ = *p++;
       }
-    } else {
-      *dst++ = *p++;
     }
   }
   out.resize(dst - base);
@@ -290,6 +467,18 @@ struct Spelling {
   // (= alternation order).
   std::vector<std::pair<uint16_t, uint16_t>> pair_cands;  // sorted by key
   uint64_t pair_bits[1024] = {};
+  // second gate: 2048-bit bloom over the first THREE bytes.  The variant
+  // prefixes' two-byte keys (in/re/co/pr/of/...) are the commonest word
+  // starts in English, so the pair gate alone passes ~40% of words; the
+  // third byte drops survivors to the few real candidates (+ ~2% bloom
+  // collisions at 45 entries / 2048 bits).
+  uint64_t tri_bits[32] = {};
+  bool tri_enabled = true;  // off if any variant is ever < 3 bytes
+
+  static uint32_t tri_hash(unsigned char a, unsigned char b,
+                           unsigned char c) {
+    return ((a * 33u + b) * 33u + c) & 2047u;
+  }
 
   void load(const char *table, size_t table_len) {
     size_t i = 0;
@@ -309,6 +498,14 @@ struct Spelling {
           static_cast<unsigned char>(from[k][1]));
       pair_cands.emplace_back(key, static_cast<uint16_t>(k));
       pair_bits[key >> 6] |= 1ull << (key & 63);
+      if (from[k].size() < 3) {
+        tri_enabled = false;
+      } else {
+        uint32_t t = tri_hash(static_cast<unsigned char>(from[k][0]),
+                              static_cast<unsigned char>(from[k][1]),
+                              static_cast<unsigned char>(from[k][2]));
+        tri_bits[t >> 6] |= 1ull << (t & 63);
+      }
     }
     std::stable_sort(pair_cands.begin(), pair_cands.end(),
                      [](const auto &a, const auto &b) {
@@ -316,52 +513,89 @@ struct Spelling {
                      });
   }
 
-  std::string run(const char *data, size_t len) const {
-    // A match can only begin at a word boundary followed by a word char,
-    // so walk word starts and bulk-copy everything else.
-    std::string out;
-    size_t i = 0;
-    size_t emitted = 0;  // everything before this input index is in `out`
-    while (i < len) {
-      // skip the gap to the next word start
-      while (i < len && !is_word(data[i])) ++i;
-      if (i >= len) break;
-      bool replaced = false;
-      if (i + 1 < len) {
-        uint16_t key = static_cast<uint16_t>(
-            (static_cast<unsigned char>(data[i]) << 8) |
-            static_cast<unsigned char>(data[i + 1]));
-        if (!(pair_bits[key >> 6] & (1ull << (key & 63)))) {
-          while (i < len && is_word(data[i])) ++i;
-          continue;
-        }
-        auto it = std::lower_bound(
-            pair_cands.begin(), pair_cands.end(), key,
-            [](const auto &a, uint16_t k) { return a.first < k; });
-        for (; it != pair_cands.end() && it->first == key; ++it) {
-          uint32_t k = it->second;
-          const std::string &f = from[k];
-          if (i + f.size() <= len &&
-              std::memcmp(data + i, f.data(), f.size()) == 0) {
-            // \b after: end of input or non-word char next (every variant
-            // ends with a word char)
-            if (i + f.size() == len || !is_word(data[i + f.size()])) {
-              if (out.empty() && emitted == 0) out.reserve(len + 16);
-              out.append(data + emitted, i - emitted);
-              out.append(to[k]);
-              i += f.size();
-              emitted = i;
-              replaced = true;
-              break;
-            }
-          }
+  // try to match a variant whose word starts at `w`; on success append
+  // the replacement and return the index just past the matched variant
+  // (a word boundary by the \b-after check), else return SIZE_MAX.
+  size_t try_match(const char *data, size_t len, size_t w, size_t &emitted,
+                   std::string &out) const {
+    if (w + 1 >= len) return SIZE_MAX;
+    uint16_t key = static_cast<uint16_t>(
+        (static_cast<unsigned char>(data[w]) << 8) |
+        static_cast<unsigned char>(data[w + 1]));
+    if (!(pair_bits[key >> 6] & (1ull << (key & 63)))) return SIZE_MAX;
+    if (tri_enabled && w + 2 < len) {  // every variant is >= 3 bytes
+      uint32_t t = tri_hash(static_cast<unsigned char>(data[w]),
+                            static_cast<unsigned char>(data[w + 1]),
+                            static_cast<unsigned char>(data[w + 2]));
+      if (!(tri_bits[t >> 6] & (1ull << (t & 63)))) return SIZE_MAX;
+    }
+    auto it = std::lower_bound(
+        pair_cands.begin(), pair_cands.end(), key,
+        [](const auto &a, uint16_t k) { return a.first < k; });
+    for (; it != pair_cands.end() && it->first == key; ++it) {
+      uint32_t k = it->second;
+      const std::string &f = from[k];
+      if (w + f.size() <= len &&
+          std::memcmp(data + w, f.data(), f.size()) == 0) {
+        // \b after: end of input or non-word char next (every variant
+        // ends with a word char)
+        if (w + f.size() == len || !is_word(data[w + f.size()])) {
+          if (out.empty() && emitted == 0) out.reserve(len + 16);
+          out.append(data + emitted, w - emitted);
+          out.append(to[k]);
+          emitted = w + f.size();
+          return emitted;
         }
       }
-      // after a replacement the scan is mid-word (variants end in a word
-      // char); either way skip to the end of the current word — the next
-      // match needs a fresh word boundary
-      while (i < len && is_word(data[i])) ++i;
-      (void)replaced;
+    }
+    return SIZE_MAX;
+  }
+
+  std::string run(const char *data, size_t len) const {
+    // A match can only begin at a word boundary followed by a word char.
+    // The block scan computes one 16-lane word mask per block and pulls
+    // word-START positions out of it with bit ops — word starts bits are
+    // wm & ~(wm << 1) — so the common block (no candidate) costs a
+    // handful of instructions instead of a byte walk.  Gate misses need
+    // NO skip-to-word-end: other start bits are already boundaries.
+    std::string out;
+    size_t emitted = 0;  // everything before this input index is in `out`
+    size_t i = 0;
+#if defined(__SSE2__)
+    unsigned carry = 0;  // 1 if data[i-1] is word-class
+    while (i + 16 <= len) {
+      unsigned wm = static_cast<unsigned>(word_mask16(data + i));
+      unsigned starts = wm & ~((wm << 1) | carry);
+      carry = (wm >> 15) & 1u;
+      bool jumped = false;
+      while (starts) {
+        int k = __builtin_ctz(starts);
+        starts &= starts - 1;
+        size_t next = try_match(data, len, i + k, emitted, out);
+        if (next != SIZE_MAX) {
+          // the match may span separators ("sub license"): later start
+          // bits inside it are consumed, so realign the block scan just
+          // past the match (data[next] is non-word or EOS; the previous
+          // byte is a word char, so carry = 1)
+          i = next;
+          carry = 1;
+          jumped = true;
+          break;
+        }
+      }
+      if (!jumped) i += 16;
+    }
+    if (carry && i < len)  // mid-word at the tail boundary: finish it
+      i = find_nonword(data + i, data + len) - data;
+#endif
+    while (i < len) {
+      i = find_wordbyte(data + i, data + len) - data;
+      if (i >= len) break;
+      size_t next = try_match(data, len, i, emitted, out);
+      i = (next != SIZE_MAX)
+              ? next
+              : static_cast<size_t>(find_nonword(data + i, data + len) -
+                                    data);
     }
     if (emitted == 0) return std::string(data, len);
     out.append(data + emitted, len - emitted);
@@ -410,9 +644,6 @@ struct Slice {
 inline std::vector<Slice> wordset_unique(const char *data, size_t len,
                                          std::vector<uint64_t> *hashes_out =
                                              nullptr) {
-  auto is_tok = [](unsigned char c) {
-    return is_word(c) || c == '/' || c == '-';
-  };
   std::vector<Slice> uniques;
   // compact flat open-addressing scratch (12B entries, cache-friendly),
   // thread_local so worker threads in the ingestion pipeline never
@@ -450,28 +681,31 @@ inline std::vector<Slice> wordset_unique(const char *data, size_t len,
   };
   size_t i = 0;
   while (i < len) {
-    if (!is_tok(data[i])) {
-      ++i;
-      continue;
-    }
+    // token spans are runs of token-class bytes, possibly glued by an
+    // apostrophe suffix ("'s" after any unit, bare "'" after an 's');
+    // the vectorized finders jump run-to-run instead of per byte.  An
+    // apostrophe is only consumable right after a unit char, i.e. when
+    // this iteration's run is non-empty (j > entry) — that guard keeps
+    // "s's'" from eating the second quote, matching the unit-loop regex.
+    i = find_tokbyte(data + i, data + len) - data;
+    if (i >= len) break;
     size_t start = i;
-    while (i < len) {
-      if (is_tok(data[i])) {
-        char c = data[i];
-        ++i;
-        // optional apostrophe suffix after this unit char
-        if (i < len && data[i] == '\'') {
-          if (i + 1 < len && data[i + 1] == 's') {
-            // "'s" — the regex consumes "'s" whenever present after a
-            // unit char
-            i += 2;
-          } else if (c == 's') {
-            i += 1;  // (?<=s)'
-          }
+    for (;;) {
+      size_t entry = i;
+      size_t j = static_cast<size_t>(find_nontok(data + i, data + len) -
+                                     data);
+      i = j;
+      if (j > entry && j < len && data[j] == '\'') {
+        if (j + 1 < len && data[j + 1] == 's') {
+          i = j + 2;  // "'s" — consumed whenever present after a unit
+          continue;
         }
-      } else {
-        break;
+        if (data[j - 1] == 's') {
+          i = j + 1;  // (?<=s)'
+          continue;
+        }
       }
+      break;
     }
     size_t n = i - start;
     uint64_t h = token_hash(data + start, n);

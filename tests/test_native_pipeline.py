@@ -54,6 +54,13 @@ ADVERSARIAL = [
     b"http://example.com & http://other.example\n\n- item one\n\n- item two",
     b"== Title ==\n*emphasis* [link](http://x) `code`\n> quoted\n\nEnd of terms and conditions",
     b"word-\ncontinued hyphen-\n  ated licence favour organisation",
+    # a stage-2 substitution (span_markup) leaves a double space before
+    # the cc-dedication contains-gate: the gate must see SQUEEZED text
+    # (plain_strip repairs whitespace even on no-match; a literal gate
+    # that skips the pass must preserve that side effect)
+    b"the text of the creative * commons* public domain dedication.\n"
+    b"permission is hereby granted, free of charge.\n",
+    b"s's' apostrophe *x  y* edge's cases'",
 ]
 
 
