@@ -340,8 +340,69 @@ class BatchClassifier:
 
         from licensee_tpu.native.pipeline import NativeResourceError
 
+        # Whole-batch native fast path: every non-HTML blob goes through
+        # ONE ctypes crossing (newline fix + strip + featurize in C++,
+        # GIL dropped for the whole batch).  Rows the native side can't
+        # take (non-ASCII, PCRE2 resource limits) come back with a
+        # non-zero status and fall through to the per-blob paths below.
+        done = bytearray(B)
+        if self._nat is not None:
+            fast: list[int] = []
+            fast_bytes: list[bytes] = []
+            for i in range(B):
+                if results[i] is not None:
+                    continue
+                if self._is_html(filenames[i] if filenames else None):
+                    continue
+                raw = contents[i]
+                if isinstance(raw, str):
+                    # errors="ignore" drops lone surrogates exactly like
+                    # sanitize_content's round-trip (project_file.py:16)
+                    raw = raw.encode("utf-8", errors="ignore")
+                elif not isinstance(raw, bytes):
+                    continue
+                fast.append(i)
+                fast_bytes.append(raw)
+            if fast:
+                whole = len(fast) == B
+                sub_bits = (
+                    bits if whole else np.zeros((len(fast), W), np.uint32)
+                )
+                meta = np.zeros((len(fast), 3), dtype=np.int32)
+                hashes = np.zeros((len(fast), 16), dtype=np.uint8)
+                try:
+                    status = self._nat.featurize_batch(
+                        self._nat_vocab, fast_bytes, sub_bits, meta, hashes
+                    )
+                except Exception:  # noqa: BLE001 — whole-batch containment
+                    # the per-blob loop below re-does every row with its
+                    # own per-blob error containment
+                    status = np.full(len(fast), 3, dtype=np.int8)
+                    if whole:
+                        bits[:] = 0
+                for j, i in enumerate(fast):
+                    if status[j] != 0:
+                        continue  # per-blob fallback below
+                    done[i] = 1
+                    flags = int(meta[j, 2])
+                    if prefilter and flags & 1:
+                        results[i] = BlobResult("no-license", "copyright", 100.0)
+                        continue
+                    if not whole:
+                        bits[i] = sub_bits[j]
+                    if prefilter:
+                        h = hashes[j].tobytes()
+                        if h in self._exact_hashes:
+                            key = self._confirm_exact(h, bits[i], int(meta[j, 0]))
+                            if key is not None:
+                                results[i] = BlobResult(key, "exact", 100.0)
+                                continue
+                    n_words[i] = meta[j, 0]
+                    lengths[i] = meta[j, 1]
+                    cc_fp[i] = bool(flags & 2)
+
         for i, raw in enumerate(contents):
-            if results[i] is not None:
+            if results[i] is not None or done[i]:
                 continue
             filename = filenames[i] if filenames else None
             try:

@@ -164,6 +164,14 @@ class NativePipeline:
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_uint8),
         ]
+        lib.pipe_featurize_batch.restype = None
+        lib.pipe_featurize_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int8),
+        ]
 
         config = _build_config()
         self._handle = lib.pipe_new(config, len(config))
@@ -278,6 +286,52 @@ class NativePipeline:
             int(scalars[2]),
             bytes(hash16),
         )
+
+    def featurize_batch(
+        self,
+        vocab: VocabHandle,
+        contents: list[bytes],
+        bits_out: np.ndarray,
+        meta_out: np.ndarray,
+        hash_out: np.ndarray,
+    ) -> np.ndarray:
+        """One ctypes crossing for a whole batch of RAW byte blobs.
+
+        The native side also performs the per-blob preamble the scalar
+        path does in Python — universal newlines (sanitize_content) and
+        Ruby String#strip — so callers hand over file bytes untouched.
+        Writes row i of ``bits_out`` (n, n_lanes) uint32, ``meta_out``
+        (n, 3) int32 [|wordset|, length, prefilter flags], ``hash_out``
+        (n, 16) uint8.  Returns a status array: 0 ok, 2 non-ASCII, 3
+        PCRE2 resource limit — non-zero rows must be redone on the
+        Unicode-safe Python path.  The GIL is dropped for the whole
+        batch, so featurization worker threads scale across cores."""
+        n = len(contents)
+        status = np.zeros(n, dtype=np.int8)
+        if n == 0:
+            return status
+        # the native side writes through raw row-strided pointers — make
+        # the layout contract explicit instead of corrupting memory
+        assert bits_out.dtype == np.uint32 and bits_out.flags.c_contiguous
+        assert bits_out.shape == (n, vocab.n_lanes)
+        assert meta_out.dtype == np.int32 and meta_out.flags.c_contiguous
+        assert meta_out.shape == (n, 3)
+        assert hash_out.dtype == np.uint8 and hash_out.flags.c_contiguous
+        assert hash_out.shape == (n, 16)
+        datas = (ctypes.c_char_p * n)(*contents)
+        lens = (ctypes.c_int64 * n)(*[len(c) for c in contents])
+        self._lib.pipe_featurize_batch(
+            self._handle,
+            vocab._handle,
+            datas,
+            lens,
+            n,
+            bits_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            meta_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            hash_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        )
+        return status
 
     def exact_hash(self, wordset) -> bytes:
         """The 16-byte hash pipe_featurize computes, for a Python-side

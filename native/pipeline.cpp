@@ -616,14 +616,11 @@ int pipe_featurize(void *handle, void *vocab_handle, const char *data,
 // on success; 2 when the text contains non-ASCII bytes — the caller must
 // fall back to the two-crossing path where Python does the full-Unicode
 // downcase.  out: [0]=|wordset| [1]=char length [2]=prefilter flags.
-int pipe_featurize_raw(void *handle, void *vocab_handle, const char *data,
-                       size_t len, uint32_t *bits_out, int32_t *out,
-                       uint8_t *hash_out) {
-  auto *pl = static_cast<Pipeline *>(handle);
-  auto *vocab = static_cast<Vocab *>(vocab_handle);
-  for (size_t i = 0; i < len; ++i)
-    if (static_cast<unsigned char>(data[i]) >= 0x80) return 2;
-  Scratch scr;
+// The ASCII fast-path core: data must be pure-ASCII and ruby-stripped.
+// Writes bits/scalars/hash for one blob; 0 ok, 3 PCRE2 resource failure.
+static int featurize_ascii_core(Pipeline *pl, Vocab *vocab, const char *data,
+                                size_t len, Scratch &scr, uint32_t *bits_out,
+                                int32_t *out, uint8_t *hash_out) {
   std::string in(data, len);
   int32_t flags = 0;
   if (search(*pl->pat("copyright_full"), in, scr)) flags |= 1;
@@ -649,6 +646,80 @@ int pipe_featurize_raw(void *handle, void *vocab_handle, const char *data,
   out[1] = static_cast<int32_t>(c.size());  // pure ASCII: bytes == chars
   wordset_hash(hashes, hash_out);
   return 0;
+}
+
+static bool all_ascii(const char *data, size_t len) {
+  size_t i = 0;
+  for (; i + 8 <= len; i += 8) {
+    uint64_t chunk;
+    std::memcpy(&chunk, data + i, 8);
+    if (chunk & 0x8080808080808080ull) return false;
+  }
+  for (; i < len; ++i)
+    if (static_cast<unsigned char>(data[i]) >= 0x80) return false;
+  return true;
+}
+
+int pipe_featurize_raw(void *handle, void *vocab_handle, const char *data,
+                       size_t len, uint32_t *bits_out, int32_t *out,
+                       uint8_t *hash_out) {
+  auto *pl = static_cast<Pipeline *>(handle);
+  auto *vocab = static_cast<Vocab *>(vocab_handle);
+  if (!all_ascii(data, len)) return 2;
+  Scratch scr;
+  return featurize_ascii_core(pl, vocab, data, len, scr, bits_out, out,
+                              hash_out);
+}
+
+// Whole-BATCH fast path: one GIL-dropping crossing for N raw byte blobs.
+// Per blob this performs the Python-side preamble too — universal-newline
+// conversion (sanitize_content's replace("\r\n","\n").replace("\r","\n"),
+// project_file.rb:37-45) and Ruby String#strip — then the ASCII core.
+// status_out[i]: 0 ok, 2 non-ASCII (caller redoes that blob via the
+// Unicode-safe Python path), 3 PCRE2 resource failure (ditto).
+// Outputs are row-strided: bits n x n_lanes, meta n x 3, hash n x 16.
+void pipe_featurize_batch(void *handle, void *vocab_handle,
+                          const char *const *datas, const int64_t *lens,
+                          int32_t n, uint32_t *bits_out, int32_t *meta_out,
+                          uint8_t *hash_out, int8_t *status_out) {
+  auto *pl = static_cast<Pipeline *>(handle);
+  auto *vocab = static_cast<Vocab *>(vocab_handle);
+  const size_t W = vocab->n_lanes;
+  Scratch scr;  // reused: one match-data allocation for the whole batch
+  std::string conv;
+  for (int32_t i = 0; i < n; ++i) {
+    const char *b = datas[i];
+    size_t l = static_cast<size_t>(lens[i]);
+    if (!all_ascii(b, l)) {
+      status_out[i] = 2;
+      continue;
+    }
+    if (std::memchr(b, '\r', l) != nullptr) {
+      conv.clear();
+      conv.reserve(l);
+      for (size_t k = 0; k < l; ++k) {
+        if (b[k] == '\r') {
+          conv.push_back('\n');
+          if (k + 1 < l && b[k + 1] == '\n') ++k;
+        } else {
+          conv.push_back(b[k]);
+        }
+      }
+      b = conv.data();
+      l = conv.size();
+    }
+    // Ruby String#strip: [\0\t\n\v\f\r ] off both ends
+    while (l && sc::is_strippable(static_cast<unsigned char>(b[0]))) {
+      ++b;
+      --l;
+    }
+    while (l && sc::is_strippable(static_cast<unsigned char>(b[l - 1]))) --l;
+    scr.err = 0;
+    status_out[i] = static_cast<int8_t>(featurize_ascii_core(
+        pl, vocab, b, l, scr, bits_out + static_cast<size_t>(i) * W,
+        meta_out + static_cast<size_t>(i) * 3,
+        hash_out + static_cast<size_t>(i) * 16));
+  }
 }
 
 // Hash a '\0'-joined unique-token blob (Python-side template wordsets, any

@@ -126,6 +126,22 @@ def test_poisoned_blob_is_contained(tmp_path, monkeypatch):
         return real_sanitize(raw)
 
     monkeypatch.setattr(batch_mod, "sanitize_content", exploding_sanitize)
+    # the whole-batch native crossing bypasses sanitize_content; poison
+    # it too so BOTH containment layers are exercised: the batch call's
+    # exception demotes every row to the per-blob loop, whose sanitize
+    # raises on the poison blob only
+    from licensee_tpu.native import pipeline as npipe
+
+    nat = npipe.load()
+    if nat is not None:
+        real_batch = nat.featurize_batch
+
+        def exploding_batch(vocab, contents, *args, **kwargs):
+            if any(b"POISON" in c for c in contents):
+                raise RuntimeError("synthetic batch featurizer edge case")
+            return real_batch(vocab, contents, *args, **kwargs)
+
+        monkeypatch.setattr(nat, "featurize_batch", exploding_batch)
 
     paths = []
     mit = open(fixture_path("mit/LICENSE.txt"), "rb").read()

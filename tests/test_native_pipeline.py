@@ -185,19 +185,28 @@ def test_resource_limit_fails_over_to_python(monkeypatch):
     )
     text = re.sub(r"\[(\w+)\]", "example", mit.content or "").encode()
 
-    # first blob: native path pretends to hit MATCHLIMIT; second: normal
+    # the BATCH crossing reports a resource failure (status 3) for blob 0
+    # -> the per-blob native path retries it, pretends to hit MATCHLIMIT
+    # again -> the pure-Python path classifies it; blob 1 stays native
+    real_batch = clf._nat.featurize_batch
+
+    def flaky_batch(vocab, contents, *args, **kwargs):
+        status = real_batch(vocab, contents, *args, **kwargs)
+        if len(status):
+            status[0] = 3
+        return status
+
+    monkeypatch.setattr(clf._nat, "featurize_batch", flaky_batch)
+
     calls = {"n": 0}
-    real = clf._prepare_one_native
 
-    def flaky(raw, *args, **kwargs):
+    def flaky_one(raw, *args, **kwargs):
         calls["n"] += 1
-        if calls["n"] == 1:
-            raise NativeResourceError("pipe_featurize_raw: PCRE2 resource limit")
-        return real(raw, *args, **kwargs)
+        raise NativeResourceError("pipe_featurize_raw: PCRE2 resource limit")
 
-    monkeypatch.setattr(clf, "_prepare_one_native", flaky)
+    monkeypatch.setattr(clf, "_prepare_one_native", flaky_one)
     results = clf.classify_blobs([text, text])
-    assert calls["n"] == 2
+    assert calls["n"] == 1  # only the status-3 blob reaches the scalar path
     for r in results:
         assert r.error is None
         assert (r.key, r.matcher) == ("mit", "exact")
