@@ -320,6 +320,7 @@ def cmd_batch_detect(args) -> int:
             workers=args.workers,
             mesh=mesh,
             mode=args.mode,
+            dedupe=not args.no_dedupe,
             **kwargs,
         )
     except ValueError as exc:
@@ -458,6 +459,14 @@ def build_parser() -> argparse.ArgumentParser:
             "Device mesh for the scorer: DATA chips shard the blob batch, "
             "MODEL chips shard the template matrix vocab-wise (default: "
             "all visible devices data-parallel; 'none' forces one device)"
+        ),
+    )
+    batch.add_argument(
+        "--no-dedupe", action="store_true",
+        help=(
+            "Disable the (filename, content-hash) result cache that "
+            "short-circuits repeated blobs (real license corpora are "
+            "dominated by verbatim copies)"
         ),
     )
     batch.add_argument("--batch-size", type=int, default=4096)

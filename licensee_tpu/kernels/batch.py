@@ -267,6 +267,7 @@ class BatchClassifier:
         contents: list[str | bytes],
         prefilter: bool = True,
         filenames: list[str | None] | None = None,
+        preset: list | None = None,
     ):
         """Sanitize, prefilter and featurize a batch of raw blobs.
 
@@ -294,25 +295,34 @@ class BatchClassifier:
         with no such section matches nothing.  The extracted sections are
         kept on the returned batch for the Reference fallback.
 
+        ``preset`` (optional, parallel to ``contents``) pre-assigns
+        result rows — the dedupe cache's hits (BatchProject) — so those
+        blobs skip featurization and the device entirely.
+
         A blob whose featurization raises is contained: it gets an
         ``error`` result row and the rest of the batch proceeds (a single
         poisoned blob must not wedge a 10M-file run)."""
         if self.mode == "package":
-            return self._prepare_package_batch(contents, filenames)
+            return self._prepare_package_batch(contents, filenames, preset)
         B = len(contents)
         W = self.corpus.n_lanes
         bits = np.zeros((B, W), dtype=np.uint32)
         n_words = np.zeros(B, dtype=np.int32)
         lengths = np.zeros(B, dtype=np.int32)
         cc_fp = np.zeros(B, dtype=bool)
-        results: list[BlobResult | None] = [None] * B
+        results: list[BlobResult | None] = (
+            list(preset) if preset is not None else [None] * B
+        )
         sections: list | None = None
         if self.mode == "readme":
             from licensee_tpu.project_files.readme_file import ReadmeFile
 
             sections = [None] * B
-            extracted = []
-            for raw in contents:
+            extracted: list = []
+            for i, raw in enumerate(contents):
+                if results[i] is not None:  # preset (dedupe) rows skip
+                    extracted.append(None)
+                    continue
                 try:
                     content = (
                         sanitize_content(raw) if raw is not None else ""
@@ -325,6 +335,8 @@ class BatchClassifier:
                         )
                     )
             for i, section in enumerate(extracted):
+                if results[i] is not None:
+                    continue
                 if isinstance(section, BlobResult):
                     results[i] = section
                 elif section is None:
@@ -439,7 +451,9 @@ class BatchClassifier:
             results, bits, n_words, lengths, cc_fp, todo, sections
         )
 
-    def _prepare_package_batch(self, contents, filenames) -> PreparedBatch:
+    def _prepare_package_batch(
+        self, contents, filenames, preset=None
+    ) -> PreparedBatch:
         """Package-manifest mode: the whole chain is host regexes.
 
         Each blob runs the filename-dispatched matcher table of
@@ -452,8 +466,12 @@ class BatchClassifier:
         )
 
         B = len(contents)
-        results: list[BlobResult | None] = [None] * B
+        results: list[BlobResult | None] = (
+            list(preset) if preset is not None else [None] * B
+        )
         for i, raw in enumerate(contents):
+            if results[i] is not None:
+                continue
             filename = filenames[i] if filenames else None
             try:
                 pf = PackageManagerFile(raw, filename)

@@ -310,14 +310,23 @@ class NativePipeline:
         status = np.zeros(n, dtype=np.int8)
         if n == 0:
             return status
-        # the native side writes through raw row-strided pointers — make
-        # the layout contract explicit instead of corrupting memory
-        assert bits_out.dtype == np.uint32 and bits_out.flags.c_contiguous
-        assert bits_out.shape == (n, vocab.n_lanes)
-        assert meta_out.dtype == np.int32 and meta_out.flags.c_contiguous
-        assert meta_out.shape == (n, 3)
-        assert hash_out.dtype == np.uint8 and hash_out.flags.c_contiguous
-        assert hash_out.shape == (n, 16)
+        # the native side writes through raw row-strided pointers — the
+        # layout contract must hold even under python -O, so raise, don't
+        # assert
+        for name, arr, dtype, shape in (
+            ("bits_out", bits_out, np.uint32, (n, vocab.n_lanes)),
+            ("meta_out", meta_out, np.int32, (n, 3)),
+            ("hash_out", hash_out, np.uint8, (n, 16)),
+        ):
+            if (
+                arr.dtype != dtype
+                or not arr.flags.c_contiguous
+                or arr.shape != shape
+            ):
+                raise ValueError(
+                    f"{name}: need C-contiguous {np.dtype(dtype).name}"
+                    f"{shape}, got {arr.dtype}{arr.shape}"
+                )
         datas = (ctypes.c_char_p * n)(*contents)
         lens = (ctypes.c_int64 * n)(*[len(c) for c in contents])
         self._lib.pipe_featurize_batch(

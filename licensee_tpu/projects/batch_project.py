@@ -31,6 +31,13 @@ from dataclasses import dataclass, field
 import numpy as np
 
 import licensee_tpu
+from licensee_tpu.kernels.batch import BlobResult
+
+# placeholder for a row that duplicates an earlier row of the SAME batch:
+# prepare_batch skips it like any preset row, and run() replaces it with
+# the original's finished result before anything reads it.  The error
+# marker makes an accidental leak visible instead of silent.
+_IN_BATCH_DUP = BlobResult(None, None, 0.0, error="in_batch_dup_unresolved")
 
 
 @dataclass
@@ -44,6 +51,7 @@ class BatchStats:
     unmatched: int = 0
     read_errors: int = 0
     featurize_errors: int = 0
+    dedupe_hits: int = 0
     # per-stage wall-clock seconds (the observability surface of
     # SURVEY.md §5; read+featurize accumulate across worker threads, so
     # they can exceed elapsed on multi-core hosts)
@@ -81,6 +89,8 @@ class BatchProject:
         process_index: int | None = None,
         process_count: int | None = None,
         mode: str = "license",
+        dedupe: bool = True,
+        dedupe_cap: int = 1 << 20,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -133,6 +143,19 @@ class BatchProject:
         self.workers = workers or min(32, (os.cpu_count() or 1))
         self.inflight = max(1, inflight)
         self.stats = BatchStats()
+        # Content-dedupe: real license corpora are dominated by verbatim
+        # copies of a few hundred texts, so a content-hash -> result
+        # cache short-circuits featurization AND device scoring for every
+        # repeat.  Classification is a pure function of the content plus
+        # the filename-dependent dispatch — the HTML gate in license/
+        # readme mode, the matcher table in package mode — so the key
+        # carries exactly that dispatch and a hit is exact, not
+        # approximate.  FIFO-bounded; workers only read (GIL-atomic dict
+        # ops), the writer thread inserts after device finish.
+        self.dedupe = dedupe
+        self.dedupe_cap = dedupe_cap
+        self._dedupe_cache: dict = {}
+        self.mode = self.classifier.mode
 
     @classmethod
     def from_manifest_file(cls, manifest_file: str, **kwargs) -> "BatchProject":
@@ -171,18 +194,54 @@ class BatchProject:
     # -- the pipeline stages --
 
     def _produce(self, start: int):
-        """Worker-thread stage: read + prefilter + featurize one batch."""
+        """Worker-thread stage: read + dedupe + prefilter + featurize."""
+        import hashlib
+
         chunk = self.paths[start : start + self.batch_size]
         t0 = time.perf_counter()
         contents = [self._read(p) for p in chunk]
         t1 = time.perf_counter()
+        filenames = [os.path.basename(p) for p in chunk]
+        keys: list = [None] * len(chunk)
+        preset: list = [None] * len(chunk)
+        dup_of: dict[int, int] = {}
+        if self.dedupe:
+            from licensee_tpu.kernels.batch import BatchClassifier
+
+            cache = self._dedupe_cache
+            package = self.mode == "package"
+            first_seen: dict = {}
+            for i, c in enumerate(contents):
+                if c is None:
+                    continue
+                # license/readme: only the HTML gate reads the filename;
+                # package: the whole matcher table does
+                dispatch = (
+                    filenames[i]
+                    if package
+                    else BatchClassifier._is_html(filenames[i])
+                )
+                keys[i] = (dispatch, hashlib.sha1(c).digest())
+                preset[i] = cache.get(keys[i])
+                if preset[i] is None:
+                    # in-batch dedupe: repeats of a key first seen in THIS
+                    # batch are featurized/scored once and copied after
+                    # finish (no cross-batch pipeline lag)
+                    j = first_seen.setdefault(keys[i], i)
+                    if j != i:
+                        dup_of[i] = j
+                        preset[i] = _IN_BATCH_DUP
         prepared = self.classifier.prepare_batch(
             [c if c is not None else b"" for c in contents],
-            filenames=[os.path.basename(p) for p in chunk],
+            filenames=filenames,
+            preset=preset,
         )
         t2 = time.perf_counter()
         read_errs = [c is None for c in contents]
-        return chunk, read_errs, prepared, (t1 - t0, t2 - t1)
+        return (
+            chunk, read_errs, keys, preset, dup_of, prepared,
+            (t1 - t0, t2 - t1),
+        )
 
     def _dispatch(self, prepared):
         """Main-thread stage: launch device scoring (asynchronous)."""
@@ -228,22 +287,33 @@ class BatchProject:
             while futures or pending:
                 # keep up to 2 device batches in flight before draining
                 while futures and len(pending) < 2:
-                    chunk, read_errs, prepared, (t_read, t_feat) = (
-                        futures.popleft().result()
-                    )
+                    chunk, read_errs, keys, preset, dup_of, prepared, (
+                        t_read,
+                        t_feat,
+                    ) = futures.popleft().result()
                     submit_next()
                     self.stats.add_stage("read", t_read)
                     self.stats.add_stage("featurize", t_feat)
                     t0 = time.perf_counter()
                     device_out = self._dispatch(prepared)
                     self.stats.add_stage("dispatch", time.perf_counter() - t0)
-                    pending.append((chunk, read_errs, prepared, device_out))
+                    pending.append(
+                        (chunk, read_errs, keys, preset, dup_of, prepared,
+                         device_out)
+                    )
 
-                chunk, read_errs, prepared, device_out = pending.popleft()
+                chunk, read_errs, keys, preset, dup_of, prepared, device_out = (
+                    pending.popleft()
+                )
                 t0 = time.perf_counter()
                 results = self._finish(prepared, device_out)
+                for i, j in dup_of.items():
+                    results[i] = results[j]
                 t1 = time.perf_counter()
-                for path, is_err, result in zip(chunk, read_errs, results):
+                cache = self._dedupe_cache
+                for k, (path, is_err, result) in enumerate(
+                    zip(chunk, read_errs, results)
+                ):
                     row = {"path": path, **result.as_dict()}
                     if is_err:
                         # distinguish "could not read" from "no license"
@@ -255,6 +325,12 @@ class BatchProject:
                         self.stats.featurize_errors += 1
                     else:
                         self._count(result)
+                        if preset[k] is not None:
+                            self.stats.dedupe_hits += 1
+                        elif self.dedupe and keys[k] is not None:
+                            if len(cache) >= self.dedupe_cap:
+                                cache.pop(next(iter(cache)))  # FIFO bound
+                            cache[keys[k]] = result
                     self.stats.total += 1
                     out.write(json.dumps(row) + "\n")
                 out.flush()

@@ -203,3 +203,72 @@ def test_pipelined_run_matches_serial_classify(tmp_path):
     # stage timers recorded (the observability surface)
     for stage in ("read", "featurize", "dispatch", "score", "write", "elapsed"):
         assert stage in stats.stage_seconds
+
+
+def test_dedupe_short_circuits_repeats(tmp_path):
+    """Identical (basename, content) pairs classify once; repeats come
+    from the cache with identical rows (classification is a pure function
+    of content + filename, so hits are exact).  The cache fills at
+    finish time, so hits start a few batches behind the first copy —
+    enough copies must span enough batches."""
+    mit = open(fixture_path("mit/LICENSE.txt"), "rb").read()
+    paths = []
+    for i in range(8):
+        d = tmp_path / f"repo{i}"
+        d.mkdir()
+        p = d / "LICENSE"
+        p.write_bytes(mit)
+        paths.append(str(p))
+    paths.append(str(tmp_path / "other.txt"))
+    (tmp_path / "other.txt").write_bytes(b"no license text at all here")
+
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(paths, batch_size=1, workers=1, inflight=1)
+    stats = project.run(str(out), resume=False)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["key"] for r in rows] == ["mit"] * 8 + [None]
+    assert stats.dedupe_hits >= 1
+    body = {k: v for k, v in rows[0].items() if k != "path"}
+    assert all(
+        {k: v for k, v in r.items() if k != "path"} == body for r in rows[:8]
+    )
+
+    # the same run without dedupe produces identical rows
+    out2 = tmp_path / "out2.jsonl"
+    project2 = BatchProject(paths, batch_size=1, dedupe=False)
+    stats2 = project2.run(str(out2), resume=False)
+    rows2 = [json.loads(line) for line in out2.read_text().splitlines()]
+    assert [
+        {k: v for k, v in r.items() if k != "path"} for r in rows
+    ] == [{k: v for k, v in r.items() if k != "path"} for r in rows2]
+    assert stats2.dedupe_hits == 0
+
+
+def test_dedupe_key_carries_filename_dispatch(tmp_path):
+    """The cache key carries the filename-dependent dispatch (the HTML
+    gate in license mode), so HTML-converted semantics never leak onto a
+    same-content non-HTML file — while plain files with DIFFERENT names
+    (LICENSE vs COPYING) do share hits."""
+    html = b"<html><body><h1>MIT License</h1></body></html>"
+    p1 = tmp_path / "LICENSE.html"
+    p2 = tmp_path / "LICENSE"
+    p1.write_bytes(html)
+    p2.write_bytes(html)
+    project = BatchProject([str(p1), str(p2)], batch_size=2)
+    out = tmp_path / "out.jsonl"
+    project.run(str(out), resume=False)
+    assert project.stats.dedupe_hits == 0  # html vs non-html: no hit
+
+    mit = open(fixture_path("mit/LICENSE.txt"), "rb").read()
+    paths = []
+    for i, name in enumerate(
+        ["LICENSE", "COPYING", "LICENSE.txt", "LICENSE.md"] * 2
+    ):
+        d = tmp_path / f"r{i}"
+        d.mkdir()
+        p = d / name
+        p.write_bytes(mit)
+        paths.append(str(p))
+    project2 = BatchProject(paths, batch_size=1, workers=1, inflight=1)
+    project2.run(str(tmp_path / "out2.jsonl"), resume=False)
+    assert project2.stats.dedupe_hits >= 1  # names differ, dispatch same
