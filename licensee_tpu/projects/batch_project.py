@@ -21,6 +21,7 @@ before they are packed for HBM, mirroring the first-match-wins chain
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -38,6 +39,31 @@ from licensee_tpu.kernels.batch import BlobResult
 # the original's finished result before anything reads it.  The error
 # marker makes an accidental leak visible instead of silent.
 _IN_BATCH_DUP = BlobResult(None, None, 0.0, error="in_batch_dup_unresolved")
+
+
+@functools.lru_cache(maxsize=4096)
+def _json_str(s: str | None) -> str:
+    """json.dumps memoized per distinct value: keys and matcher names
+    come from a small fixed pool, so the 10M-row writer pays the real
+    escaping logic once per unique string instead of per row."""
+    return "null" if s is None else json.dumps(s)
+
+
+def _jsonl_row(path: str, result, error: str | None) -> str:
+    """One output row as JSON, ~4x faster than json.dumps(dict).
+
+    json.dumps in the 10M-row writer loop is a real serial cost (~9 us a
+    row); the confidence is a float whose repr IS its JSON form, and the
+    key/matcher strings are escape-memoized, so only the path (and the
+    rare error) pays a real dumps."""
+    row = (
+        f'{{"path": {json.dumps(path)}, "key": {_json_str(result.key)}, '
+        f'"matcher": {_json_str(result.matcher)}, '
+        f'"confidence": {result.confidence!r}'
+    )
+    if error is not None:
+        row += f', "error": {json.dumps(error)}'
+    return row + "}"
 
 
 @dataclass
@@ -221,7 +247,12 @@ class BatchProject:
                     if package
                     else BatchClassifier._is_html(filenames[i])
                 )
-                keys[i] = (dispatch, hashlib.sha1(c).digest())
+                # usedforsecurity=False: a cache key, not crypto — and
+                # FIPS-mode OpenSSL would otherwise refuse sha1 entirely
+                keys[i] = (
+                    dispatch,
+                    hashlib.sha1(c, usedforsecurity=False).digest(),
+                )
                 preset[i] = cache.get(keys[i])
                 if preset[i] is None:
                     # in-batch dedupe: repeats of a key first seen in THIS
@@ -311,17 +342,18 @@ class BatchProject:
                     results[i] = results[j]
                 t1 = time.perf_counter()
                 cache = self._dedupe_cache
+                lines: list[str] = []
                 for k, (path, is_err, result) in enumerate(
                     zip(chunk, read_errs, results)
                 ):
-                    row = {"path": path, **result.as_dict()}
+                    error = None
                     if is_err:
                         # distinguish "could not read" from "no license"
-                        row["error"] = "read_error"
+                        error = "read_error"
                         self.stats.read_errors += 1
                     elif result.error:
                         # poisoned blob: contained per-row, run continues
-                        row["error"] = result.error
+                        error = result.error
                         self.stats.featurize_errors += 1
                     else:
                         self._count(result)
@@ -332,7 +364,9 @@ class BatchProject:
                                 cache.pop(next(iter(cache)))  # FIFO bound
                             cache[keys[k]] = result
                     self.stats.total += 1
-                    out.write(json.dumps(row) + "\n")
+                    lines.append(_jsonl_row(path, result, error))
+                lines.append("")
+                out.write("\n".join(lines))
                 out.flush()
                 t2 = time.perf_counter()
                 self.stats.add_stage("score", t1 - t0)
