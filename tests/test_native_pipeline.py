@@ -210,3 +210,66 @@ def test_resource_limit_fails_over_to_python(monkeypatch):
     for r in results:
         assert r.error is None
         assert (r.key, r.matcher) == ("mit", "exact")
+
+
+def test_differential_fuzz_native_vs_python():
+    """Seeded random documents mixing everything the normalization
+    pipeline reacts to (markdown, bullets, quotes/dashes, varietal
+    words, copyright lines, CRLF, unicode, apostrophes): the native and
+    pure-Python pipelines must agree bit-for-bit on every one."""
+    import random
+
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    rng = random.Random(1234)
+    vocab_words = [
+        "software", "permission", "copyright", "licence", "organisation",
+        "merge", "publish", "distribute", "sublicense", "warranty",
+        "noninfringement", "s's'", "don't", "e-mail", "sub-license",
+        "per cent", "favour", "whilst", "copyright owner",
+    ]
+    decorations = [
+        "## License\n", "== Title ==\n", "* ", "- ", "1. ", "a) ",
+        "> quoted\n", "*emphasis* ", "_under_ ", "`code` ",
+        "[link](http://x.invalid) ", "http://example.invalid/x\n",
+        "---\n", "“curly” ‘quotes’ ", "— em – en - dash ",
+        "Copyright (c) 2024 Example\n", "All rights reserved.\n",
+        "\r\n", "﻿", "   ", "\t", "licença ática ",
+        "END OF TERMS AND CONDITIONS\n",
+    ]
+
+    def random_doc() -> str:
+        parts = []
+        for _ in range(rng.randrange(5, 60)):
+            if rng.random() < 0.35:
+                parts.append(rng.choice(decorations))
+            else:
+                parts.append(rng.choice(vocab_words) + " ")
+            if rng.random() < 0.15:
+                parts.append("\n\n")
+        return "".join(parts)
+
+    docs = [random_doc().encode("utf-8") for _ in range(100)]
+
+    native_clf = BatchClassifier(pad_batch_to=128, mesh=None)
+    if native_clf._nat is None:
+        pytest.skip("native pipeline unavailable")
+    py_clf = BatchClassifier(pad_batch_to=128, mesh=None)
+    py_clf._nat = None  # force the pure-Python pipeline
+
+    a = native_clf.classify_blobs(docs)
+    b = py_clf.classify_blobs(docs)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert (x.key, x.matcher, x.confidence) == (
+            y.key,
+            y.matcher,
+            y.confidence,
+        ), (i, docs[i][:120])
+
+    # feature-level agreement too (bits/wordset/length drive everything)
+    pa = native_clf.prepare_batch(docs)
+    pb = py_clf.prepare_batch(docs)
+    np.testing.assert_array_equal(pa.bits, pb.bits)
+    np.testing.assert_array_equal(pa.n_words, pb.n_words)
+    np.testing.assert_array_equal(pa.lengths, pb.lengths)
+    np.testing.assert_array_equal(pa.cc_fp, pb.cc_fp)
