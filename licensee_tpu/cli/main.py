@@ -321,6 +321,8 @@ def cmd_batch_detect(args) -> int:
             mesh=mesh,
             mode=args.mode,
             dedupe=not args.no_dedupe,
+            threshold=args.confidence,
+            closest=args.closest,
             **kwargs,
         )
     except ValueError as exc:
@@ -459,6 +461,22 @@ def build_parser() -> argparse.ArgumentParser:
             "Device mesh for the scorer: DATA chips shard the blob batch, "
             "MODEL chips shard the template matrix vocab-wise (default: "
             "all visible devices data-parallel; 'none' forces one device)"
+        ),
+    )
+    batch.add_argument(
+        "--closest", type=int, default=0, metavar="K",
+        help=(
+            "Attach the top-K closest candidate licenses (key + "
+            "confidence) to rows that reach the Dice scorer, like "
+            "detect's closest-licenses view (prefiltered exact/"
+            "copyright rows skip it; single-device scoring path)"
+        ),
+    )
+    batch.add_argument(
+        "--confidence", type=float, default=None, metavar="N",
+        help=(
+            "Minimum Dice confidence for a match (default: the global "
+            f"threshold, {licensee_tpu.CONFIDENCE_THRESHOLD})"
         ),
     )
     batch.add_argument(

@@ -39,13 +39,19 @@ class BlobResult:
     score_num: int = 0
     score_den: int = 0
     error: str | None = None
+    # top-k candidate list [(key, confidence), ...] when the classifier
+    # runs with closest=K (the CLI's closest-licenses view, batched)
+    closest: list | None = None
 
     def as_dict(self) -> dict:
-        return {
+        d = {
             "key": self.key,
             "matcher": self.matcher,
             "confidence": self.confidence,
         }
+        if self.closest is not None:
+            d["closest"] = [[k, c] for k, c in self.closest]
+        return d
 
 
 @dataclass
@@ -84,12 +90,16 @@ class BatchClassifier:
         pad_batch_to: int = 1024,
         mesh="auto",
         mode: str = "license",
+        closest: int = 0,
     ):
         from licensee_tpu.kernels.dice_xla import CorpusArrays, make_best_match_fn
 
         if mode not in ("license", "readme", "package"):
             raise ValueError(f"unknown mode {mode!r}")
         self.mode = mode
+        self.closest = int(closest)
+        if self.closest < 0:
+            raise ValueError("closest must be >= 0")
         if mode == "package":
             # package manifests are matched by filename-dispatched lenient
             # regexes alone (package_manager_file.rb matcher table) — the
@@ -99,6 +109,11 @@ class BatchClassifier:
             if mesh is not None and mesh != "auto":
                 raise ValueError(
                     "package mode runs host-only; pass mesh=None"
+                )
+            if self.closest:
+                raise ValueError(
+                    "closest needs the Dice scorer; package mode never "
+                    "runs it"
                 )
             self.corpus = corpus
             self.method = method
@@ -125,6 +140,28 @@ class BatchClassifier:
         # ('data', 'model') mesh so the blob batch shards across chips.
         # mesh may be a jax Mesh, an (n_data, n_model) tuple, "auto"
         # (all devices, data-parallel), or None (single device).
+        if self.closest:
+            # the top-k list rides the single-device jit path; the k
+            # columns change the output shapes the sharded/pallas
+            # scorers were built for.  An explicit mesh is a caller
+            # error, not a silently-ignored option (same convention as
+            # package mode above)
+            if method.startswith("pallas"):
+                raise ValueError(
+                    "closest is not supported with the pallas methods"
+                )
+            if mesh is not None and mesh != "auto":
+                raise ValueError(
+                    "closest scores single-device; pass mesh=None"
+                )
+            from licensee_tpu.kernels.dice_xla import make_topk_fn
+
+            self.mesh = None
+            k = min(self.closest + 1, self.corpus.n_templates)
+            self._fn = make_topk_fn(self.arrays, k, method=method)
+            self._exact_map = self.corpus.exact_sets
+            self._init_native()
+            return
         self.mesh = self._resolve_mesh(mesh, method, pad_batch_to)
         if self.mesh is not None:
             from licensee_tpu.parallel.mesh import make_sharded_scorer
@@ -152,9 +189,13 @@ class BatchClassifier:
         # keys License.find doesn't know, and their rendering differs)
         self._exact_map = self.corpus.exact_sets
 
-        # whole-pipeline native path: sanitize -> featurize in 1-2 ctypes
-        # crossings per blob (native/pipeline.cpp); falls back to the
-        # Python pipeline when the toolchain/libpcre2 is unavailable
+        self._init_native()
+
+    def _init_native(self) -> None:
+        """Load the whole-pipeline native path: sanitize -> featurize in
+        1-2 ctypes crossings per blob (native/pipeline.cpp); falls back
+        to the Python pipeline when the toolchain/libpcre2 is
+        unavailable."""
         from licensee_tpu.native import pipeline as native_pipeline
 
         self._nat = native_pipeline.load()
@@ -633,10 +674,19 @@ class BatchClassifier:
         readme_file.rb:32-34): a license named by title or source URL in
         the extracted section matches at confidence 90."""
         results = prepared.results
-        for chunk, (best_idx, best_num, best_den) in outs:
-            best_idx = np.asarray(best_idx)[: len(chunk)]
-            best_num = np.asarray(best_num)[: len(chunk)]
-            best_den = np.asarray(best_den)[: len(chunk)]
+        for chunk, out in outs:
+            best_idx, best_num, best_den = (
+                np.asarray(a)[: len(chunk)] for a in out[:3]
+            )
+            k_rows: list | None = None
+            if len(out) == 6:  # closest=K: top-k candidate columns
+                k_idx, k_num, k_den = (
+                    np.asarray(a)[: len(chunk)] for a in out[3:]
+                )
+                k_scores = np.where(
+                    (k_num >= 0) & (k_den > 0), (k_num * 200.0) / k_den, -1.0
+                )
+                k_rows = (k_idx, k_scores)
             scores = np.where(best_den > 0, (best_num * 200.0) / best_den, 0.0)
             for j, i in enumerate(chunk):
                 if best_num[j] >= 0 and scores[j] >= threshold:
@@ -649,6 +699,10 @@ class BatchClassifier:
                     )
                 else:
                     results[i] = BlobResult(None, None, 0.0)
+                if k_rows is not None:
+                    results[i].closest = self._closest_list(
+                        k_rows[0][j], k_rows[1][j], results[i].key
+                    )
         if self.mode == "readme" and prepared.sections is not None:
             for i, section in enumerate(prepared.sections):
                 r = results[i]
@@ -656,7 +710,21 @@ class BatchClassifier:
                     continue
                 lic = self._reference_match(section)
                 if lic is not None:
-                    results[i] = BlobResult(lic.key, "reference", 90.0)
+                    results[i] = BlobResult(
+                        lic.key, "reference", 90.0, closest=r.closest
+                    )
+
+    def _closest_list(self, idx_row, score_row, matched_key):
+        """The top-k candidates as [(key, confidence), ...], float64-
+        sorted, excluding the matched key and masked (score<0) rows —
+        the batch analog of the CLI's closest-licenses list."""
+        rows = [
+            (self.corpus.keys[int(t)], float(s))
+            for t, s in zip(idx_row, score_row)
+            if s >= 0 and self.corpus.keys[int(t)] != matched_key
+        ]
+        rows.sort(key=lambda r: -r[1])
+        return rows[: self.closest]
 
     @staticmethod
     def _reference_match(section: str):

@@ -224,3 +224,30 @@ def make_best_match_fn(corpus: CorpusArrays, method: str = "popcount"):
         return best_match(corpus, file_bits, n_words, lengths, cc_fp, method)
 
     return fn
+
+
+def make_topk_fn(corpus: CorpusArrays, k: int, method: str = "popcount"):
+    """Jitted scorer returning the EXACT top-1 plus a top-k candidate
+    list per blob (the batch analog of the CLI's closest-licenses view,
+    commands/detect.rb:44-63).
+
+    The top-1 triple uses the exact int64 tournament (bit-identical to
+    `make_best_match_fn`); the k-list is ranked by float32 score, whose
+    only inexactness is the ORDER of candidates whose scores collide in
+    float32 — the returned (num, den) pairs are exact, so the host
+    re-sorts the k rows in float64 and only the inclusion boundary at
+    rank k is approximate."""
+
+    @jax.jit
+    def fn(file_bits, n_words, lengths, cc_fp):
+        num, den = score_pairs(
+            corpus, file_bits, n_words, lengths, cc_fp, method
+        )
+        best = _argmax_exact(num, den)
+        scores = num.astype(jnp.float32) / den.astype(jnp.float32)
+        _, k_idx = lax.top_k(scores, k)
+        k_num = jnp.take_along_axis(num, k_idx, axis=1)
+        k_den = jnp.take_along_axis(den, k_idx, axis=1)
+        return (*best, k_idx.astype(jnp.int32), k_num, k_den)
+
+    return fn

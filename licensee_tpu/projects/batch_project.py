@@ -61,6 +61,11 @@ def _jsonl_row(path: str, result, error: str | None) -> str:
         f'"matcher": {_json_str(result.matcher)}, '
         f'"confidence": {result.confidence!r}'
     )
+    if result.closest is not None:
+        inner = ", ".join(
+            f"[{_json_str(k)}, {c!r}]" for k, c in result.closest
+        )
+        row += f', "closest": [{inner}]'
     if error is not None:
         row += f', "error": {json.dumps(error)}'
     return row + "}"
@@ -117,6 +122,7 @@ class BatchProject:
         mode: str = "license",
         dedupe: bool = True,
         dedupe_cap: int = 1 << 20,
+        closest: int = 0,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -156,6 +162,7 @@ class BatchProject:
             pad_batch_to=batch_size,
             mesh=mesh,
             mode=mode,
+            closest=closest,
         )
         if self.classifier.pad_batch_to != batch_size:
             raise ValueError(

@@ -628,8 +628,7 @@ static int featurize_ascii_core(Pipeline *pl, Vocab *vocab, const char *data,
   out[2] = flags;
 
   std::string c = pl->stage1(std::move(in), scr);
-  for (char &ch : c)
-    if (ch >= 'A' && ch <= 'Z') ch += 'a' - 'A';
+  sc::downcase_ascii(c.data(), c.size());  // pure ASCII by precondition
   c = pl->stage2(std::move(c), scr);
   if (scr.err) return 3;  // resource failure: caller falls back to Python
 

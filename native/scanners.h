@@ -129,6 +129,26 @@ inline const char *find_nontok(const char *p, const char *end) {
   return p;
 }
 
+// ASCII downcase in place: [A-Z] |= 0x20, everything else untouched
+inline void downcase_ascii(char *p, size_t len) {
+  char *end = p + len;
+#if defined(__SSE2__)
+  const __m128i A = _mm_set1_epi8('A');
+  const __m128i Z = _mm_set1_epi8('Z');
+  const __m128i bit = _mm_set1_epi8(0x20);
+  while (end - p >= 16) {
+    __m128i v = _mm_loadu_si128(reinterpret_cast<__m128i *>(p));
+    __m128i ge = _mm_cmpeq_epi8(_mm_max_epu8(v, A), v);
+    __m128i le = _mm_cmpeq_epi8(_mm_min_epu8(v, Z), v);
+    __m128i m = _mm_and_si128(_mm_and_si128(ge, le), bit);
+    _mm_storeu_si128(reinterpret_cast<__m128i *>(p), _mm_or_si128(v, m));
+    p += 16;
+  }
+#endif
+  for (; p < end; ++p)
+    if (*p >= 'A' && *p <= 'Z') *p += 'a' - 'A';
+}
+
 // first byte equal to a or b
 inline const char *find_byte2(const char *p, const char *end, char a, char b) {
 #if defined(__SSE2__)

@@ -1,0 +1,104 @@
+"""batch-detect --closest K: per-row top-K candidate lists (the batch
+analog of the CLI's closest-licenses view, commands/detect.rb:44-63)."""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from licensee_tpu.corpus.license import License
+from licensee_tpu.kernels.batch import BatchClassifier
+from licensee_tpu.matchers.dice import Dice
+from licensee_tpu.project_files.license_file import LicenseFile
+
+
+def rendered(key: str) -> str:
+    lic = next(l for l in License.all(hidden=True, pseudo=False) if l.key == key)
+    return re.sub(r"\[(\w+)\]", "example", lic.content or "")
+
+
+@pytest.fixture(scope="module")
+def clf():
+    return BatchClassifier(pad_batch_to=16, closest=3)
+
+
+def test_closest_rows_match_scalar_ranking(clf):
+    """The top-3 list must contain the same candidates, same float64
+    confidences, as the scalar Dice matcher's full ranking.  (A verbatim
+    rendering would stop at the Exact prefilter — closest candidates
+    come from the Dice stage, mirroring the reference chain.)"""
+    # PREpended: GPL-3.0's "END OF TERMS" truncation would eat appended
+    # text and the blob would be exact again.  The noise drops GPL to
+    # ~97.6 (below the 98 threshold), so the row is unmatched and the
+    # closest list IS the answer — exactly the CLI's no-match view.
+    content = "nudged off the exact prefilter\n\n" + rendered("gpl-3.0")
+    results = clf.classify_blobs([content])
+    r = results[0]
+    assert r.key is None
+    assert r.closest is not None and len(r.closest) == 3
+    assert r.closest[0][0] == "gpl-3.0"
+    # scalar ranking over all licenses (dice.rb licenses_by_similarity)
+    file = LicenseFile(content, "LICENSE")
+    matcher = Dice(file)
+    ranked = [
+        (lic.key, score)
+        for lic, score in matcher.licenses_by_similarity
+        if lic.key != r.key
+    ][:3]
+    assert [k for k, _ in r.closest] == [k for k, _ in ranked]
+    for (_, got), (_, want) in zip(r.closest, ranked):
+        assert got == want  # float64-exact
+
+
+def test_closest_on_unmatched_blob(clf):
+    """An unmatched blob still reports its nearest candidates."""
+    # heavily noised AGPL body: below threshold but AGPL-adjacent
+    body = rendered("agpl-3.0")
+    words = body.split()
+    noised = " ".join(
+        w if i % 7 else f"zz{i}" for i, w in enumerate(words)
+    )
+    results = clf.classify_blobs([noised])
+    r = results[0]
+    assert r.key is None
+    assert r.closest and r.closest[0][0] in ("agpl-3.0", "gpl-3.0")
+    assert all(c >= 0 for _, c in r.closest)
+    # sorted descending
+    confs = [c for _, c in r.closest]
+    assert confs == sorted(confs, reverse=True)
+
+
+def test_closest_excludes_matched_key(clf):
+    results = clf.classify_blobs([rendered("mit") + "\noneextraword"])
+    r = results[0]
+    assert r.key == "mit"
+    assert all(k != "mit" for k, _ in r.closest)
+
+
+def test_closest_absent_without_option():
+    plain = BatchClassifier(pad_batch_to=16, mesh=None)
+    r = plain.classify_blobs([rendered("mit")])[0]
+    assert r.closest is None
+    assert "closest" not in r.as_dict()
+
+
+def test_closest_row_serialization(tmp_path):
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    p = tmp_path / "LICENSE"
+    p.write_text(rendered("isc") + "\nextra trailing words")
+    out = tmp_path / "out.jsonl"
+    project = BatchProject([str(p)], batch_size=4, closest=2)
+    project.run(str(out), resume=False)
+    row = json.loads(out.read_text().splitlines()[0])
+    assert len(row["closest"]) == 2
+    for key, conf in row["closest"]:
+        assert isinstance(key, str) and isinstance(conf, float)
+
+
+def test_closest_rejects_pallas():
+    with pytest.raises(ValueError):
+        BatchClassifier(pad_batch_to=16, method="pallas", closest=2)
