@@ -469,7 +469,7 @@ def build_parser() -> argparse.ArgumentParser:
             "Attach the top-K closest candidate licenses (key + "
             "confidence) to rows that reach the Dice scorer, like "
             "detect's closest-licenses view (prefiltered exact/"
-            "copyright rows skip it; single-device scoring path)"
+            "copyright rows skip it)"
         ),
     )
     batch.add_argument(

@@ -140,35 +140,31 @@ class BatchClassifier:
         # ('data', 'model') mesh so the blob batch shards across chips.
         # mesh may be a jax Mesh, an (n_data, n_model) tuple, "auto"
         # (all devices, data-parallel), or None (single device).
-        if self.closest:
-            # the top-k list rides the single-device jit path; the k
-            # columns change the output shapes the sharded/pallas
-            # scorers were built for.  An explicit mesh is a caller
-            # error, not a silently-ignored option (same convention as
-            # package mode above)
-            if method.startswith("pallas"):
-                raise ValueError(
-                    "closest is not supported with the pallas methods"
-                )
-            if mesh is not None and mesh != "auto":
-                raise ValueError(
-                    "closest scores single-device; pass mesh=None"
-                )
-            from licensee_tpu.kernels.dice_xla import make_topk_fn
-
-            self.mesh = None
-            k = min(self.closest + 1, self.corpus.n_templates)
-            self._fn = make_topk_fn(self.arrays, k, method=method)
-            self._exact_map = self.corpus.exact_sets
-            self._init_native()
-            return
+        if self.closest and method.startswith("pallas"):
+            # the k output columns change the shapes the hand-scheduled
+            # pallas kernels were built for
+            raise ValueError(
+                "closest is not supported with the pallas methods"
+            )
         self.mesh = self._resolve_mesh(mesh, method, pad_batch_to)
+        # top-1 stays exact with or without closest; the k candidate
+        # columns are a per-row reduction, so they ride both the
+        # single-device jit and the sharded scorer unchanged
+        k = (
+            min(self.closest + 1, self.corpus.n_templates)
+            if self.closest
+            else 0
+        )
         if self.mesh is not None:
             from licensee_tpu.parallel.mesh import make_sharded_scorer
 
             self._fn = make_sharded_scorer(
-                self.arrays, self.mesh, method=method
+                self.arrays, self.mesh, method=method, topk=k
             )
+        elif k:
+            from licensee_tpu.kernels.dice_xla import make_topk_fn
+
+            self._fn = make_topk_fn(self.arrays, k, method=method)
         elif method == "pallas":
             from licensee_tpu.kernels.dice_pallas import (
                 make_best_match_fn_pallas,
@@ -710,8 +706,15 @@ class BatchClassifier:
                     continue
                 lic = self._reference_match(section)
                 if lic is not None:
+                    # the kept candidate list was built with no matched
+                    # key (the Dice pass left the row unmatched); now
+                    # that Reference names one, hold the documented
+                    # invariant: closest excludes the matched key
+                    kept = r.closest
+                    if kept is not None:
+                        kept = [(kk, c) for kk, c in kept if kk != lic.key]
                     results[i] = BlobResult(
-                        lic.key, "reference", 90.0, closest=r.closest
+                        lic.key, "reference", 90.0, closest=kept
                     )
 
     def _closest_list(self, idx_row, score_row, matched_key):

@@ -226,17 +226,26 @@ def make_best_match_fn(corpus: CorpusArrays, method: str = "popcount"):
     return fn
 
 
+def topk_candidates(num: jnp.ndarray, den: jnp.ndarray, k: int):
+    """Top-k (index, num, den) columns ranked by float32 score.
+
+    The only inexactness is the ORDER of candidates whose scores collide
+    in float32 — the returned (num, den) pairs are exact, so the host
+    re-sorts the k rows in float64 and only the inclusion boundary at
+    rank k is approximate."""
+    scores = num.astype(jnp.float32) / den.astype(jnp.float32)
+    _, k_idx = lax.top_k(scores, k)
+    k_num = jnp.take_along_axis(num, k_idx, axis=1)
+    k_den = jnp.take_along_axis(den, k_idx, axis=1)
+    return k_idx.astype(jnp.int32), k_num, k_den
+
+
 def make_topk_fn(corpus: CorpusArrays, k: int, method: str = "popcount"):
     """Jitted scorer returning the EXACT top-1 plus a top-k candidate
     list per blob (the batch analog of the CLI's closest-licenses view,
-    commands/detect.rb:44-63).
-
-    The top-1 triple uses the exact int64 tournament (bit-identical to
-    `make_best_match_fn`); the k-list is ranked by float32 score, whose
-    only inexactness is the ORDER of candidates whose scores collide in
-    float32 — the returned (num, den) pairs are exact, so the host
-    re-sorts the k rows in float64 and only the inclusion boundary at
-    rank k is approximate."""
+    commands/detect.rb:44-63).  The top-1 triple uses the exact int64
+    tournament (bit-identical to `make_best_match_fn`); see
+    `topk_candidates` for the k-list's float32 ranking caveat."""
 
     @jax.jit
     def fn(file_bits, n_words, lengths, cc_fp):
@@ -244,10 +253,6 @@ def make_topk_fn(corpus: CorpusArrays, k: int, method: str = "popcount"):
             corpus, file_bits, n_words, lengths, cc_fp, method
         )
         best = _argmax_exact(num, den)
-        scores = num.astype(jnp.float32) / den.astype(jnp.float32)
-        _, k_idx = lax.top_k(scores, k)
-        k_num = jnp.take_along_axis(num, k_idx, axis=1)
-        k_den = jnp.take_along_axis(den, k_idx, axis=1)
-        return (*best, k_idx.astype(jnp.int32), k_num, k_den)
+        return (*best, *topk_candidates(num, den, k))
 
     return fn

@@ -37,6 +37,7 @@ from licensee_tpu.kernels.dice_xla import (
     finish_scores,
     overlap_pairs,
     score_pairs,
+    topk_candidates,
 )
 
 
@@ -63,24 +64,35 @@ def shard_batch(mesh: Mesh, *arrays):
 
 
 def make_sharded_scorer(
-    corpus: CorpusArrays, mesh: Mesh, method: str = "popcount"
+    corpus: CorpusArrays, mesh: Mesh, method: str = "popcount",
+    topk: int = 0,
 ):
     """A scorer jitted over the mesh.
 
     Blob features come in sharded over 'data'.  The template matrix is
     sharded over 'model' along the packed-lane axis; partial overlaps are
     psum-reduced.  With n_model == 1 the psum is the identity and XLA
-    compiles a pure data-parallel program."""
+    compiles a pure data-parallel program.
+
+    ``topk > 0`` appends per-blob top-k candidate columns (the
+    closest-licenses view): a purely per-row reduction, so it needs no
+    extra collectives on either axis."""
 
     n_model = mesh.shape["model"]
     if method not in ("popcount", "matmul"):
         raise ValueError(f"unknown scoring method: {method!r}")
 
+    def _finish_best(num, den):
+        best = _argmax_exact(num, den)
+        if not topk:
+            return best
+        return (*best, *topk_candidates(num, den, topk))
+
     def _score(corpus_arrays, file_bits, n_words, lengths, cc_fp):
         num, den = score_pairs(
             corpus_arrays, file_bits, n_words, lengths, cc_fp, method=method
         )
-        return _argmax_exact(num, den)
+        return _finish_best(num, den)
 
     if n_model == 1:
         # Pure DP: replicate the corpus, shard the batch; XLA partitions
@@ -94,10 +106,16 @@ def make_sharded_scorer(
             NamedSharding(mesh, P("data")),
             NamedSharding(mesh, P("data")),
         )
+        out_shardings = NamedSharding(mesh, P("data"))
+        if topk:
+            out_shardings = tuple(
+                [NamedSharding(mesh, P("data"))] * 3
+                + [NamedSharding(mesh, P("data", None))] * 3
+            )
         fn = jax.jit(
             _score,
             in_shardings=(corpus_sharding, *data_shardings),
-            out_shardings=NamedSharding(mesh, P("data")),
+            out_shardings=out_shardings,
         )
         corpus_on_mesh = jax.device_put(
             corpus, jax.tree.map(lambda _a: NamedSharding(mesh, P()), corpus)
@@ -118,7 +136,7 @@ def make_sharded_scorer(
         num, den = finish_scores(
             corpus_arrays, overlap, n_words, lengths, cc_fp
         )
-        return _argmax_exact(num, den)
+        return _finish_best(num, den)
 
     # lanes of the bit-matrix sharded over the model axis; scalars replicated
     spec_fields = {
@@ -132,6 +150,9 @@ def make_sharded_scorer(
         "valid": P(),
     }
     corpus_specs = CorpusArrays(**spec_fields)
+    out_specs = (P("data"),) * 3
+    if topk:
+        out_specs = out_specs + (P("data", None),) * 3
     fn = shard_map(
         _tp_score,
         mesh=mesh,
@@ -142,7 +163,7 @@ def make_sharded_scorer(
             P("data"),
             P("data"),
         ),
-        out_specs=(P("data"), P("data"), P("data")),
+        out_specs=out_specs,
     )
     jitted = jax.jit(fn)
 

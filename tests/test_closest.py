@@ -102,3 +102,25 @@ def test_closest_row_serialization(tmp_path):
 def test_closest_rejects_pallas():
     with pytest.raises(ValueError):
         BatchClassifier(pad_batch_to=16, method="pallas", closest=2)
+
+
+def test_closest_on_device_mesh():
+    """closest rides the sharded scorer: DP and DPxTP meshes produce the
+    same rows (top-1 AND candidate lists) as the single-device path."""
+    single = BatchClassifier(pad_batch_to=16, mesh=None, closest=3)
+    contents = [
+        "nudged off the exact prefilter\n\n" + rendered("gpl-3.0"),
+        rendered("mit") + "\noneextraword",
+        "totally unrelated prose about nothing in particular",
+    ]
+    want = single.classify_blobs(contents)
+    for mesh in ((4, 1), (4, 2)):
+        clf = BatchClassifier(pad_batch_to=16, mesh=mesh, closest=3)
+        got = clf.classify_blobs(contents)
+        for g, w in zip(got, want):
+            assert (g.key, g.matcher, g.confidence) == (
+                w.key,
+                w.matcher,
+                w.confidence,
+            )
+            assert g.closest == w.closest, mesh
