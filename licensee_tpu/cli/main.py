@@ -277,11 +277,11 @@ def cmd_batch_detect(args) -> int:
                   file=sys.stderr)
             return 1
         kwargs["corpus"] = corpus
-    try:
-        with open(args.manifest, encoding="utf-8") as f:
-            paths = [line.strip() for line in f if line.strip()]
-    except OSError as exc:
-        print(f"error: cannot read manifest: {exc}", file=sys.stderr)
+    if not os.path.exists(args.manifest):
+        print(
+            f"error: cannot read manifest: {args.manifest!r} not found",
+            file=sys.stderr,
+        )
         return 1
 
     mesh = "auto"
@@ -313,8 +313,11 @@ def cmd_batch_detect(args) -> int:
     from licensee_tpu.projects.batch_project import BatchProject
 
     try:
-        project = BatchProject(
-            paths,
+        # from_manifest_file materializes only this host's stripe of the
+        # manifest — at 50M lines that is the difference between ~1/N
+        # and the whole path list in RAM per host
+        project = BatchProject.from_manifest_file(
+            args.manifest,
             method=args.method,
             batch_size=args.batch_size,
             workers=args.workers,
@@ -325,9 +328,13 @@ def cmd_batch_detect(args) -> int:
             closest=args.closest,
             **kwargs,
         )
+    except OSError as exc:
+        print(f"error: cannot read manifest: {exc}", file=sys.stderr)
+        return 1
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
+    paths = project.paths
 
     profiler = None
     if args.profile:

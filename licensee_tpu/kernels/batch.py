@@ -709,25 +709,36 @@ class BatchClassifier:
                     # the kept candidate list was built with no matched
                     # key (the Dice pass left the row unmatched); now
                     # that Reference names one, hold the documented
-                    # invariant: closest excludes the matched key
+                    # invariant: closest excludes the matched key (the
+                    # list is still untrimmed, so the row keeps K
+                    # entries after the cut below)
                     kept = r.closest
                     if kept is not None:
                         kept = [(kk, c) for kk, c in kept if kk != lic.key]
                     results[i] = BlobResult(
                         lic.key, "reference", 90.0, closest=kept
                     )
+        if self.closest:
+            for r in results:
+                if r is not None and r.closest is not None:
+                    r.closest = r.closest[: self.closest]
 
     def _closest_list(self, idx_row, score_row, matched_key):
         """The top-k candidates as [(key, confidence), ...], float64-
         sorted, excluding the matched key and masked (score<0) rows —
-        the batch analog of the CLI's closest-licenses list."""
+        the batch analog of the CLI's closest-licenses list.
+
+        Returns the UNtrimmed list (up to k entries): finish_chunks cuts
+        it to ``closest`` only after the readme Reference fallback has
+        had its chance to exclude a late-matched key, so reference rows
+        keep a full K entries too."""
         rows = [
             (self.corpus.keys[int(t)], float(s))
             for t, s in zip(idx_row, score_row)
             if s >= 0 and self.corpus.keys[int(t)] != matched_key
         ]
         rows.sort(key=lambda r: -r[1])
-        return rows[: self.closest]
+        return rows
 
     @staticmethod
     def _reference_match(section: str):

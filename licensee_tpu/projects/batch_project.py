@@ -123,6 +123,7 @@ class BatchProject:
         dedupe: bool = True,
         dedupe_cap: int = 1 << 20,
         closest: int = 0,
+        already_striped: bool = False,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -146,7 +147,7 @@ class BatchProject:
         self.process_index = process_index
         self.process_count = process_count
         paths = list(manifest_paths)
-        if self.process_count > 1:
+        if self.process_count > 1 and not already_striped:
             from licensee_tpu.parallel.distributed import manifest_stripe
 
             lo, hi = manifest_stripe(
@@ -192,6 +193,53 @@ class BatchProject:
 
     @classmethod
     def from_manifest_file(cls, manifest_file: str, **kwargs) -> "BatchProject":
+        """Build a project from a one-path-per-line manifest.
+
+        In a multi-host world this materializes ONLY this process's
+        stripe: a 50M-line manifest (BASELINE.md config 5) costs each of
+        N hosts ~1/N of the path memory instead of the whole list — the
+        first pass counts lines, the second collects the [lo, hi) span.
+        """
+        process_count = kwargs.get("process_count")
+        process_index = kwargs.get("process_index")
+        if (process_index is None) != (process_count is None):
+            # same contract as the constructor: both or neither
+            raise ValueError(
+                "process_index and process_count must be given together"
+            )
+        if process_count is None:
+            try:
+                import jax
+
+                process_count = jax.process_count()
+                process_index = jax.process_index()
+            except Exception:
+                process_count, process_index = 1, 0
+        if process_count > 1:
+            from licensee_tpu.parallel.distributed import manifest_stripe
+
+            n = 0
+            with open(manifest_file, encoding="utf-8") as f:
+                for line in f:
+                    if line.strip():
+                        n += 1
+            lo, hi = manifest_stripe(n, process_index, process_count)
+            paths = []
+            k = 0
+            with open(manifest_file, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if k >= hi:
+                        break
+                    if k >= lo:
+                        paths.append(line)
+                    k += 1
+            kwargs["process_index"] = process_index
+            kwargs["process_count"] = process_count
+            kwargs["already_striped"] = True
+            return cls(paths, **kwargs)
         with open(manifest_file, encoding="utf-8") as f:
             paths = [line.strip() for line in f if line.strip()]
         return cls(paths, **kwargs)

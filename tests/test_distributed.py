@@ -163,3 +163,25 @@ def test_two_process_cluster_classifies_split_manifest(tmp_path):
     assert by_rank[1]["total"] == 1  # only the torn row was re-classified
     rows1b = [json.loads(l) for l in open(shard1, encoding="utf-8")]
     assert rows1b == rows1
+
+
+def test_from_manifest_file_materializes_only_the_stripe(tmp_path):
+    """Each host loads only its own span of the manifest (the 50M-line
+    config must not cost every host the whole path list)."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(
+        "\n".join(f"/nope/L_{i}" for i in range(10)) + "\n\n"
+    )
+    p0 = BatchProject.from_manifest_file(
+        str(manifest), process_index=0, process_count=2, mesh=None
+    )
+    p1 = BatchProject.from_manifest_file(
+        str(manifest), process_index=1, process_count=2, mesh=None
+    )
+    assert p0.paths == [f"/nope/L_{i}" for i in range(5)]
+    assert p1.paths == [f"/nope/L_{i}" for i in range(5, 10)]
+
+    single = BatchProject.from_manifest_file(str(manifest), mesh=None)
+    assert single.paths == p0.paths + p1.paths
