@@ -344,7 +344,13 @@ def cmd_batch_detect(args) -> int:
         profiler = args.profile
     try:
         if args.output:
-            stats = project.run(args.output, resume=not args.no_resume)
+            try:
+                stats = project.run(args.output, resume=not args.no_resume)
+            except OSError as exc:
+                # unwritable/missing output dir: a clean error, not a
+                # traceback
+                print(f"error: cannot write output: {exc}", file=sys.stderr)
+                return 1
         else:
             contents = [project._read(p) for p in paths]
             results = project.classifier.classify_blobs(

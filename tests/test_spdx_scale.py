@@ -169,3 +169,35 @@ def test_scale_noisy_blobs_still_separate(scale):
         k = list(corpus.keys).index(t.key)
         assert lengths[k] * 0.05 < 90, (t.key, int(lengths[k]))
     assert len(declined) <= len(sample) // 20
+
+
+def test_ingester_survives_xml_garbage(tmp_path):
+    """Random XML-ish garbage in a corpus dir must never crash the
+    ingester — broken entries are skipped, valid ones load (the 600-file
+    license-list zoo includes deprecated/malformed strays)."""
+    import random
+
+    rng = random.Random(7)
+    frags = [
+        "<", ">", "/", "&", "&amp;", "&#x0;", "<license", "licenseId=",
+        '"x"', "<text>", "</text>", "<optional>", "</optional>",
+        "<alt match='['>", "<!--", "-->", "<![CDATA[", "]]>", "\x00",
+        "\xff", "<?xml", "?>", "<SPDXLicenseCollection>", "</license>",
+        "utter garbage", "<p>", "</p>", "\n",
+    ]
+    d = tmp_path / "zoo"
+    d.mkdir()
+    for i in range(40):
+        blob = "".join(rng.choice(frags) for _ in range(rng.randrange(2, 60)))
+        (d / f"G{i}.xml").write_text(blob, encoding="utf-8", errors="ignore")
+    # plant one valid file among the garbage
+    import shutil
+
+    from licensee_tpu import vendor_paths
+
+    shutil.copy(
+        os.path.join(vendor_paths.SPDX_DIR, "MIT.xml"), d / "MIT.xml"
+    )
+    templates = load_spdx_dir(str(d))
+    keys = [t.key for t in templates]
+    assert "mit" in keys  # the valid entry survives the zoo
