@@ -344,12 +344,23 @@ def cmd_batch_detect(args) -> int:
         profiler = args.profile
     try:
         if args.output:
+            # preflight the one misconfiguration we can name precisely;
+            # everything else surfaces as a neutral I/O failure (run()
+            # touches much more than the output file — resume reads,
+            # JAX caches — so the message must not overclaim)
+            out_dir = os.path.dirname(os.path.abspath(args.output))
+            if not os.path.isdir(out_dir):
+                print(
+                    f"error: output directory does not exist: {out_dir}",
+                    file=sys.stderr,
+                )
+                return 1
             try:
                 stats = project.run(args.output, resume=not args.no_resume)
             except OSError as exc:
-                # unwritable/missing output dir: a clean error, not a
-                # traceback
-                print(f"error: cannot write output: {exc}", file=sys.stderr)
+                print(
+                    f"error: batch run I/O failure: {exc}", file=sys.stderr
+                )
                 return 1
         else:
             contents = [project._read(p) for p in paths]

@@ -1,0 +1,122 @@
+"""Fields / rules / meta model parity — ports of the reference's
+`license_field_spec.rb`, `rule_spec.rb`, and `license_meta_spec.rb`
+behavior pins that the fixture/golden suites don't already cover."""
+
+from __future__ import annotations
+
+from licensee_tpu.corpus.fields import LicenseField
+from licensee_tpu.corpus.license import License
+from licensee_tpu.corpus.meta import LicenseMeta
+from licensee_tpu.corpus.rules import LicenseRules, Rule
+
+# -- LicenseField (license_field_spec.rb) --
+
+
+def test_field_all_and_keys():
+    assert len(LicenseField.all()) == 7
+    assert isinstance(LicenseField.all()[0], LicenseField)
+    keys = LicenseField.keys()
+    assert len(keys) == 7
+    assert keys[0] == "fullname"
+
+
+def test_field_find():
+    assert LicenseField.find("year").description == "The current year"
+
+
+def test_field_from_array():
+    fields = LicenseField.from_array(["year", "fullname"])
+    assert [f.name for f in fields] == ["year", "fullname"]
+
+
+def test_field_from_content_pulls_known_fields_in_order():
+    fields = LicenseField.from_content("Foo [year] bar [baz] [fullname]")
+    assert [f.key for f in fields] == ["year", "fullname"]
+
+
+def test_field_labels():
+    assert LicenseField("foo", "bar").label == "Foo"
+    assert str(LicenseField("foo", "bar")) == "Foo"
+    # fullname converts to two words (license_field.rb label special case)
+    assert LicenseField("fullname", "x").label == "Full name"
+
+
+def test_field_raw_text():
+    assert LicenseField("fullname").raw_text == "[fullname]"
+
+
+def test_no_fields_for_bodyless_license():
+    assert License.find("other").fields == []
+
+
+# -- Rule (rule_spec.rb) --
+
+
+def test_rule_groups_and_raw_rules():
+    groups = ["permissions", "conditions", "limitations"]
+    assert Rule.groups() == groups
+    for g in groups:
+        assert g in Rule.raw_rules()
+
+
+def test_rule_all_count_and_order():
+    rules = Rule.all()
+    assert len(rules) == 17
+    assert rules[0].tag == "commercial-use"
+
+
+def test_rule_find_by_tag_and_group_disambiguates():
+    # patent-use exists in BOTH limitations and permissions with
+    # different descriptions (rule_spec.rb:44-53)
+    lim = Rule.find_by_tag_and_group("patent-use", "limitations")
+    assert "does NOT grant" in lim.description
+    per = Rule.find_by_tag_and_group("patent-use", "permissions")
+    assert "an express grant of patent rights" in per.description
+
+
+def test_rule_to_h():
+    h = Rule.all()[0].to_h()
+    assert h == {
+        "tag": "commercial-use",
+        "label": "Commercial use",
+        "description": (
+            "The licensed material and derivatives may be used for "
+            "commercial purposes."
+        ),
+    }
+
+
+# -- LicenseMeta (license_meta_spec.rb) --
+
+
+def test_meta_defaults():
+    meta = LicenseMeta.from_hash({})
+    assert meta["featured"] is False
+    assert meta["hidden"] is True
+
+
+def test_meta_from_hash_sets_values():
+    meta = LicenseMeta.from_hash(
+        {"title": "Test license", "description": "A test license"}
+    )
+    assert meta.title == "Test license"
+    assert meta.description == "A test license"
+
+
+def test_meta_hash_and_predicate_access():
+    meta = License.find("mit").meta
+    assert meta["spdx-id"] == "MIT"
+    assert meta.hidden_q is False
+    assert meta.featured_q in (True, False)
+
+
+# -- LicenseRules resolution (license_rules_spec.rb) --
+
+
+def test_license_rules_from_meta_resolves_groups():
+    rules = LicenseRules.from_license(License.find("mit"))
+    assert [r.tag for r in rules["permissions"]]
+    assert all(isinstance(r, Rule) for r in rules.flatten())
+    # key_q mirrors Ruby's respond_to handling for rule groups
+    assert rules.key_q("permissions")
+    assert not rules.key_q("nonsense")
