@@ -350,8 +350,13 @@ def cmd_batch_detect(args) -> int:
             # JAX caches — so the message must not overclaim)
             out_dir = os.path.dirname(os.path.abspath(args.output))
             if not os.path.isdir(out_dir):
+                problem = (
+                    "is not a directory"
+                    if os.path.exists(out_dir)
+                    else "does not exist"
+                )
                 print(
-                    f"error: output directory does not exist: {out_dir}",
+                    f"error: output directory {problem}: {out_dir}",
                     file=sys.stderr,
                 )
                 return 1

@@ -1,0 +1,240 @@
+"""Package-manager matcher edge cases — ports of the reference's
+per-matcher specs (spec/licensee/matchers/*_matcher_spec.rb): quote and
+whitespace variants, unknown-license -> `other`, license expressions ->
+`other`, UNLICENSED -> `no-license`, and the format conversions
+(CRAN GPL (>=2), DistZilla Mozilla_2_0, Cabal GPL-3, NuGet URLs)."""
+
+from __future__ import annotations
+
+import pytest
+
+from licensee_tpu import matchers
+from licensee_tpu.corpus.license import License
+from licensee_tpu.project_files.license_file import LicenseFile
+
+
+def match_key(matcher_cls, content, filename="LICENSE.txt"):
+    m = matcher_cls(LicenseFile(content, filename))
+    lic = m.match
+    return lic.key if lic is not None else None
+
+
+# -- NpmBower (npm_bower_matcher_spec.rb) --
+
+@pytest.mark.parametrize("content", [
+    '"license": "mit"',
+    "'license': 'mit'",
+    "'license': \"mit\"",
+    "'license' : 'mit'",
+    "'license':'mit'",
+    " 'license':'mit'",
+])
+def test_npm_quote_variants(content):
+    assert match_key(matchers.NpmBower, content) == "mit"
+
+
+def test_npm_no_field_unknown_expression_unlicensed():
+    assert match_key(matchers.NpmBower, "foo: bar") is None
+    assert match_key(matchers.NpmBower, "'license': 'foo'") == "other"
+    assert (
+        match_key(
+            matchers.NpmBower, "'license': '(MIT OR Apache-2.0 OR AGPL-3.0+)'"
+        )
+        == "other"
+    )
+    assert (
+        match_key(matchers.NpmBower, "'license': 'UNLICENSED'")
+        == "no-license"
+    )
+
+
+def test_npm_confidence():
+    m = matchers.NpmBower(LicenseFile('"license": "mit"', "package.json"))
+    assert m.confidence == 90
+
+
+# -- Gemspec (gemspec_matcher_spec.rb) --
+
+@pytest.mark.parametrize("content", [
+    "s.license = 'mit'",
+    "spec.license = 'mit'",
+    's.license = "mit"',
+    "s.license='mit'",
+    "s.license = 'MIT'",
+    "s.licenses = ['mit']",
+    "s.license = 'mit'.freeze",
+])
+def test_gemspec_declaration_variants(content):
+    assert match_key(matchers.Gemspec, content, "project.gemspec") == "mit"
+
+
+def test_gemspec_edge_cases():
+    assert match_key(matchers.Gemspec, "s.foo = 'bar'") is None
+    assert match_key(matchers.Gemspec, "s.license = 'foo'") == "other"
+    # multiple licenses in the array form -> other
+    assert (
+        match_key(matchers.Gemspec, "s.licenses = ['mit', 'bsd-3-clause']")
+        == "other"
+    )
+
+
+# -- Cran (cran_matcher_spec.rb) --
+
+@pytest.mark.parametrize("declaration,key", [
+    ("MIT", "mit"),
+    ("MIT + file LICENSE", "mit"),
+    ("GPL (>=2)", "gpl-2.0"),
+    ("GPL( >= 2 )", "gpl-2.0"),
+    ("GPL (>=2) + file LICENSE", "gpl-2.0"),
+    ("GPL (>=3)", "gpl-3.0"),
+    ("GPL-2", "gpl-2.0"),
+    ("GPL-3", "gpl-3.0"),
+    ("Foo", "other"),
+])
+def test_cran_declarations(declaration, key):
+    content = f"Package: test\nLicense: {declaration}"
+    assert match_key(matchers.Cran, content, "DESCRIPTION") == key
+
+
+def test_cran_no_field():
+    assert match_key(matchers.Cran, "Package: test", "DESCRIPTION") is None
+
+
+# -- Cargo (cargo_matcher_spec.rb) --
+
+@pytest.mark.parametrize("content,key", [
+    ('license = "MIT"', "mit"),
+    ("license = 'mit'", "mit"),
+    ("'license' = 'mit'", "mit"),
+    ('"license"="mit"', "mit"),
+    ("license='mit'", "mit"),
+    (" license = 'mit'", "mit"),
+    ('license = "Foo"', "other"),
+    ('license = "Apache-2.0/MIT"', "other"),
+    ('license = "Apache-2.0 OR MIT"', "other"),
+    ('license = "(Apache-2.0 OR MIT)"', "other"),
+])
+def test_cargo_declarations(content, key):
+    assert match_key(matchers.Cargo, content, "Cargo.toml") == key
+
+
+def test_cargo_no_field():
+    assert match_key(matchers.Cargo, 'foo = "bar"', "Cargo.toml") is None
+
+
+# -- DistZilla (dist_zilla_matcher_spec.rb) --
+
+@pytest.mark.parametrize("content,key", [
+    ("license = MIT", "mit"),
+    ("license = Mozilla_2_0", "mpl-2.0"),
+    ("license = Foo", "other"),
+])
+def test_distzilla_declarations(content, key):
+    assert match_key(matchers.DistZilla, content, "dist.ini") == key
+
+
+def test_distzilla_no_field():
+    assert match_key(matchers.DistZilla, "foo = bar", "dist.ini") is None
+
+
+# -- Spdx (spdx_matcher_spec.rb) --
+
+def test_spdx_declarations():
+    assert (
+        match_key(matchers.Spdx, "PackageLicenseDeclared: MIT") == "mit"
+    )
+    assert match_key(matchers.Spdx, "foo: bar") is None
+    assert (
+        match_key(matchers.Spdx, "PackageLicenseDeclared: xyz") == "other"
+    )
+    assert (
+        match_key(matchers.Spdx, "PackageLicenseDeclared: (MIT OR Apache-2.0)")
+        == "other"
+    )
+
+
+# -- Cabal (cabal_matcher_spec.rb) --
+
+@pytest.mark.parametrize("content", [
+    "license: mit",
+    "license : mit",
+    "license:mit",
+    " license:mit",
+])
+def test_cabal_declaration_variants(content):
+    assert match_key(matchers.Cabal, content) == "mit"
+
+
+@pytest.mark.parametrize("declared,key", [
+    ("GPL-3", "gpl-3.0"),
+    ("GPL-2", "gpl-2.0"),
+    ("LGPL-2.1", "lgpl-2.1"),
+    ("LGPL-3", "lgpl-3.0"),
+    ("AGPL-3", "agpl-3.0"),
+    ("BSD2", "bsd-2-clause"),
+    ("BSD3", "bsd-3-clause"),
+])
+def test_cabal_conversions(declared, key):
+    assert match_key(matchers.Cabal, f"license: {declared}") == key
+
+
+# -- NuGet (nu_get_matcher_spec.rb) --
+
+@pytest.mark.parametrize("content", [
+    '<license type="expression">mit</license>',
+    "<license type='expression'>mit</license>",
+    '<license  type = "expression" >mit</license >',
+    ' <license type="expression">mit</license>',
+])
+def test_nuget_expression_variants(content):
+    assert match_key(matchers.NuGet, content, "foo.nuspec") == "mit"
+
+
+def test_nuget_edge_cases():
+    assert (
+        match_key(matchers.NuGet, "<file>wrongelement</file>", "foo.nuspec")
+        is None
+    )
+    assert (
+        match_key(
+            matchers.NuGet,
+            '<license type="expression">foo</license>',
+            "foo.nuspec",
+        )
+        == "other"
+    )
+    assert (
+        match_key(
+            matchers.NuGet,
+            '<license type="expression">BSD-2-Clause OR MIT</license>',
+            "foo.nuspec",
+        )
+        == "other"
+    )
+
+
+@pytest.mark.parametrize("content", [
+    "<licenseUrl>https://licenses.nuget.org/Apache-2.0</licenseUrl>",
+    "<licenseUrl>http://licenses.nuget.org/Apache-2.0</licenseUrl>",
+    "<licenseUrl>https://opensource.org/licenses/Apache-2.0</licenseUrl>",
+    "<licenseUrl>http://www.opensource.org/licenses/Apache-2.0</licenseUrl>",
+    "<licenseUrl>https://spdx.org/licenses/Apache-2.0</licenseUrl>",
+    "<licenseUrl>http://www.spdx.org/licenses/Apache-2.0</licenseUrl>",
+    "<licenseUrl>https://spdx.org/licenses/Apache-2.0.html</licenseUrl>",
+    "<licenseUrl>https://spdx.org/licenses/Apache-2.0.txt</licenseUrl>",
+    "<licenseUrl>https://apache.org/licenses/LICENSE-2.0</licenseUrl>",
+    "<licenseUrl>http://www.apache.org/licenses/LICENSE-2.0</licenseUrl>",
+    "<licenseUrl>https://apache.org/licenses/LICENSE-2.0.txt</licenseUrl>",
+])
+def test_nuget_license_urls(content):
+    assert match_key(matchers.NuGet, content, "foo.nuspec") == "apache-2.0"
+
+
+# -- base matcher contract (matcher_spec.rb) --
+
+def test_matcher_name_and_potential_matches():
+    m = matchers.NpmBower(LicenseFile('"license": "mit"', "package.json"))
+    assert m.name == "npmbower"
+    pool = m.potential_matches
+    assert License.find("mit") in pool
+    assert all(not lic.pseudo_license for lic in pool)
