@@ -129,3 +129,22 @@ def test_confidence_flag(capsys):
     )
     assert rc == 0
     licensee_tpu.set_confidence_threshold(licensee_tpu.CONFIDENCE_THRESHOLD)
+
+
+def test_batch_detect_output_preflight(tmp_path, capsys):
+    """The --output preflight names the actual problem: a missing parent
+    directory vs an existing path component that is not a directory."""
+    lic = tmp_path / "LICENSE"
+    lic.write_text("not a license")
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{lic}\n")
+
+    missing = tmp_path / "nope" / "out.jsonl"
+    assert main(["batch-detect", str(manifest), "--output", str(missing)]) == 1
+    assert "does not exist" in capsys.readouterr().err
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    inside = blocker / "out.jsonl"
+    assert main(["batch-detect", str(manifest), "--output", str(inside)]) == 1
+    assert "is not a directory" in capsys.readouterr().err

@@ -1,0 +1,187 @@
+"""Filename-scoring and content-extraction tables — ports of the
+reference's `license_file_spec.rb`, `readme_file_spec.rb`, and
+`package_manager_file_spec.rb` parametrized pins."""
+
+from __future__ import annotations
+
+import pytest
+
+from licensee_tpu import matchers
+from licensee_tpu.project_files.license_file import LicenseFile
+from licensee_tpu.project_files.package_manager_file import PackageManagerFile
+from licensee_tpu.project_files.readme_file import ReadmeFile
+
+# license_file_spec.rb "filename scoring": the full 32-entry table
+LICENSE_SCORES = {
+    "license": 1.00,
+    "LICENCE": 1.00,
+    "unLICENSE": 1.00,
+    "unlicence": 1.00,
+    "license.md": 0.95,
+    "LICENSE.md": 0.95,
+    "license.txt": 0.95,
+    "COPYING": 0.90,
+    "copyRIGHT": 0.35,
+    "COPYRIGHT.txt": 0.30,
+    "copying.txt": 0.85,
+    "LICENSE.MPL-2.0": 0.80,
+    "LICENSE.php": 0.80,
+    "LICENCE.docs": 0.80,
+    "license.xml": 0.80,
+    "copying.image": 0.75,
+    "LICENSE-MIT": 0.70,
+    "LICENSE_1_0.txt": 0.70,
+    "COPYING-GPL": 0.65,
+    "COPYRIGHT-BSD": 0.20,
+    "MIT-LICENSE.txt": 0.60,
+    "mit-license-foo.md": 0.60,
+    "OFL.md": 0.50,
+    "ofl.textile": 0.45,
+    "ofl": 0.40,
+    "not-the-ofl": 0.00,
+    "README.txt": 0.00,
+    ".pip-license-ignore": 0.00,
+    "license-checks.xml": 0.00,
+    "license_test.go": 0.00,
+    "licensee.gemspec": 0.00,
+    "LICENSE.spdx": 0.00,
+}
+
+
+@pytest.mark.parametrize(
+    "filename,score", LICENSE_SCORES.items(), ids=list(LICENSE_SCORES)
+)
+def test_license_filename_score(filename, score):
+    assert LicenseFile.name_score(filename) == score
+
+
+@pytest.mark.parametrize("filename,score", [
+    ("COPYING.lesser", 1),
+    ("copying.lesser", 1),
+    ("license.lesser", 0),
+    ("LICENSE.md", 0),
+    ("FOO.md", 0),
+])
+def test_lesser_gpl_score(filename, score):
+    assert LicenseFile.lesser_gpl_score(filename) == score
+
+
+# readme_file_spec.rb name scoring + license_content extraction
+
+@pytest.mark.parametrize("filename,score", [
+    ("readme", 1.0),
+    ("README", 1.0),
+    ("readme.md", 0.9),
+    ("README.md", 0.9),
+    ("readme.txt", 0.9),
+    ("readme.mdown", 0.9),
+    ("readme.rdoc", 0.9),
+    ("readme.rst", 0.9),
+    ("LICENSE", 0.0),
+])
+def test_readme_name_score(filename, score):
+    assert ReadmeFile.name_score(filename) == score
+
+
+EXTRACTIONS = {
+    "no license": ("There is no License in this README", None),
+    "after an H1": ("# License\n\nhello world", "hello world"),
+    "after an H2": ("## License\n\nhello world", "hello world"),
+    "underlined header": ("License\n-------\n\nhello world", "hello world"),
+    "strange case": ("## LICENSE\n\nhello world", "hello world"),
+    "british spelling": ("## Licence\n\nhello world", "hello world"),
+    "trailing content": (
+        "## License\n\nhello world\n\n# Contributing",
+        "hello world",
+    ),
+    "trailing underlined": (
+        "# License\n\nhello world\n\nContributing\n====",
+        "hello world",
+    ),
+    "trailing colon": ("## License:\n\nhello world", "hello world"),
+    "trailing hashes": ("## License ##\n\nhello world", "hello world"),
+    "rdoc": ("== License:\n\nhello world", "hello world"),
+}
+
+
+@pytest.mark.parametrize(
+    "content,expected", EXTRACTIONS.values(), ids=list(EXTRACTIONS)
+)
+def test_readme_license_content(content, expected):
+    assert ReadmeFile.license_content(content) == expected
+
+
+def test_readme_reference_match():
+    file = ReadmeFile("The MIT License", "README.md")
+    assert file.license is not None and file.license.key == "mit"
+
+
+# package_manager_file_spec.rb
+
+@pytest.mark.parametrize("filename,score", [
+    ("licensee.gemspec", 1.0),
+    ("test.cabal", 1.0),
+    ("package.json", 1.0),
+    ("Cargo.toml", 1.0),
+    ("DESCRIPTION", 0.9),
+    ("dist.ini", 0.8),
+    ("bower.json", 0.75),
+    ("elm-package.json", 0.70),
+    ("README.md", 0.0),
+])
+def test_package_manager_name_score(filename, score):
+    assert PackageManagerFile.name_score(filename) == score
+
+
+@pytest.mark.parametrize("filename,expected", [
+    ("project.gemspec", [matchers.Gemspec]),
+    ("test.cabal", [matchers.Cabal]),
+    ("package.json", [matchers.NpmBower]),
+    ("Cargo.toml", [matchers.Cargo]),
+    ("DESCRIPTION", [matchers.Cran]),
+    ("dist.ini", [matchers.DistZilla]),
+    ("LICENSE.spdx", [matchers.Spdx]),
+    ("foo.nuspec", [matchers.NuGet]),
+    ("README.md", []),
+])
+def test_package_manager_matcher_dispatch(filename, expected):
+    pf = PackageManagerFile("", filename)
+    assert pf.possible_matchers == expected
+
+
+# license_file_spec.rb attribution + CC-false-positive behaviors
+
+def test_attribution_cases():
+    from tests.conftest import sub_copyright_info
+    from licensee_tpu.corpus.license import License
+
+    mit = License.find("mit")
+    file = LicenseFile(sub_copyright_info(mit), "LICENSE.txt")
+    assert file.attribution == "Copyright (c) 2018 Ben Balter"
+
+    # a random mid-file copyright-like line doesn't count
+    assert (
+        LicenseFile("Foo\nCopyright 2016 Ben Balter\nBar", "LICENSE.txt")
+        .attribution
+        is None
+    )
+    # a non-templated license has no attribution
+    gpl = License.find("gpl-3.0")
+    assert LicenseFile(sub_copyright_info(gpl), "LICENSE.txt").attribution is None
+    # a COPYRIGHT file whose whole content is the notice
+    f = LicenseFile("Copyright (C) 2015 Ben Balter", "COPYRIGHT")
+    assert f.attribution == "Copyright (C) 2015 Ben Balter"
+
+
+def test_cc_false_positive_regex():
+    from tests.conftest import sub_copyright_info
+    from licensee_tpu.corpus.license import License
+
+    mit_file = LicenseFile(
+        sub_copyright_info(License.find("mit")), "LICENSE.txt"
+    )
+    assert not mit_file.potential_false_positive
+    cc = LicenseFile(
+        "Creative Commons Attribution-NonCommercial 4.0", "LICENSE.txt"
+    )
+    assert cc.potential_false_positive
