@@ -92,3 +92,57 @@ def test_vanished_file_raises_not_found():
     project = VanishingGitHubProject("https://github.com/user/repo")
     with pytest.raises(RepoNotFound, match="Could not load"):
         project.license_file
+
+
+def test_local_folder_raises():
+    with pytest.raises(ValueError):
+        GitHubProject(fixture_path("mit"))
+
+
+def test_matched_and_license_file_accessors():
+    project = StubbedGitHubProject("https://github.com/benbalter/licensee")
+    assert project.license == License.find("mit")
+    assert project.matched_file is not None
+    assert project.matched_file.filename == "LICENSE.txt"
+    assert project.license_file is project.matched_file
+
+
+def test_readme_and_package_detection_off_by_default():
+    project = StubbedGitHubProject("https://github.com/benbalter/licensee")
+    assert project.readme_file is None
+    assert project.package_file is None
+
+
+def test_readme_detection_over_the_api():
+    project = StubbedGitHubProject(
+        "https://github.com/benbalter/licensee",
+        fixture="readme",
+        detect_readme=True,
+    )
+    assert project.readme_file is not None
+    assert project.readme_file.filename == "README.md"
+    assert project.license == License.find("mit")
+
+
+def test_ref_is_stored_and_sent_as_query(monkeypatch):
+    project = StubbedGitHubProject(
+        "https://github.com/benbalter/licensee", ref="dev-branch"
+    )
+    assert project.ref == "dev-branch"
+
+    # the REAL request layer carries the ref as an escaped query param
+    import urllib.request
+
+    sent = []
+
+    def fake_urlopen(req, *a, **kw):
+        sent.append(req.full_url)
+        raise AssertionError("network stop")
+
+    p2 = GitHubProject.__new__(GitHubProject)
+    p2.repo = "o/r"
+    p2.ref = "dev branch"
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    with pytest.raises(AssertionError):
+        GitHubProject._request(p2, "LICENSE")
+    assert sent and "ref=dev%20branch" in sent[0]

@@ -145,3 +145,20 @@ def test_search_root(tmp_path):
     (nested / "code.py").write_text("pass")
     project = FSProject(str(nested), search_root=str(tmp_path))
     assert project.license == mit
+
+
+def test_commitless_repo_raises_invalid_repository(tmp_path):
+    """`git init` with no commits is not a usable GitProject — parity
+    with git_project_spec.rb's 'new git repo' context (the facade falls
+    back to FSProject there; the class itself must raise).  Lives here,
+    not in the native-gated module: the subprocess fallback backend must
+    honor it too."""
+    import subprocess
+
+    from licensee_tpu.projects.git_project import GitProject, InvalidRepository
+
+    d = tmp_path / "fresh"
+    d.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=d, check=True)
+    with pytest.raises(InvalidRepository):
+        GitProject(str(d))
