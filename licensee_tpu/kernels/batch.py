@@ -350,6 +350,13 @@ class BatchClassifier:
         results: list[BlobResult | None] = (
             list(preset) if preset is not None else [None] * B
         )
+        # per-row HTML gate: cleared for readme rows below once the
+        # pre-extraction conversion has happened, so the featurize paths
+        # never convert the same blob twice
+        html = [
+            self._is_html(filenames[i] if filenames else None)
+            for i in range(B)
+        ]
         sections: list | None = None
         if self.mode == "readme":
             from licensee_tpu.project_files.readme_file import ReadmeFile
@@ -364,6 +371,18 @@ class BatchClassifier:
                     content = (
                         sanitize_content(raw) if raw is not None else ""
                     )
+                    if html[i]:
+                        # an HTML README must be markdown BEFORE the
+                        # header-shaped CONTENT_REGEX scan, not after —
+                        # the section it extracts is markdown from here
+                        # on, so the later featurize stages see it as
+                        # plain text (no second conversion)
+                        from licensee_tpu.normalize.html2md import (
+                            html_to_markdown,
+                        )
+
+                        content = html_to_markdown(content)
+                        html[i] = False
                     extracted.append(ReadmeFile.license_content(content))
                 except Exception as exc:  # noqa: BLE001 — per-blob containment
                     extracted.append(
@@ -401,7 +420,7 @@ class BatchClassifier:
             for i in range(B):
                 if results[i] is not None:
                     continue
-                if self._is_html(filenames[i] if filenames else None):
+                if html[i]:
                     continue
                 raw = contents[i]
                 if isinstance(raw, str):
@@ -453,13 +472,12 @@ class BatchClassifier:
         for i, raw in enumerate(contents):
             if results[i] is not None or done[i]:
                 continue
-            filename = filenames[i] if filenames else None
             try:
                 if self._nat is not None:
                     try:
                         self._prepare_one_native(
                             raw, results, bits, n_words, lengths, cc_fp, i,
-                            prefilter=prefilter, filename=filename,
+                            prefilter=prefilter, html=html[i],
                         )
                     except NativeResourceError:
                         # PCRE2 hit a match/depth limit on this blob;
@@ -468,12 +486,12 @@ class BatchClassifier:
                         # slower) instead of emitting a false error row
                         self._prepare_one_python(
                             raw, results, bits, n_words, lengths, cc_fp, i,
-                            prefilter=prefilter, filename=filename,
+                            prefilter=prefilter, html=html[i],
                         )
                 else:
                     self._prepare_one_python(
                         raw, results, bits, n_words, lengths, cc_fp, i,
-                        prefilter=prefilter, filename=filename,
+                        prefilter=prefilter, html=html[i],
                     )
             except Exception as exc:  # noqa: BLE001 — per-blob containment
                 results[i] = BlobResult(
@@ -532,11 +550,16 @@ class BatchClassifier:
 
     def _prepare_one_python(
         self, raw, results, bits, n_words, lengths, cc_fp, i, prefilter=True,
-        filename=None,
+        html=False,
     ) -> None:
         """The pure-Python twin of _prepare_one_native — the fallback when
-        the native library is absent or failed this blob over."""
-        blob = NormalizedBlob(raw, filename=filename)
+        the native library is absent or failed this blob over.
+
+        ``html`` is the per-row gate prepare_batch resolved (and possibly
+        already consumed, for readme sections) — the helpers never
+        re-derive it from a filename.  The sentinel name below only
+        re-arms NormalizedContent's own stage-ordered _strip_html."""
+        blob = NormalizedBlob(raw, filename="x.html" if html else None)
         results[i] = self._prefilter(blob) if prefilter else None
         if results[i] is None:
             bits[i], n_words[i], lengths[i] = self.corpus.file_features(blob)
@@ -550,10 +573,10 @@ class BatchClassifier:
 
     def _prepare_one_native(
         self, raw, results, bits, n_words, lengths, cc_fp, i, prefilter=True,
-        filename=None,
+        html=False,
     ) -> None:
         content = sanitize_content(raw) if raw is not None else ""
-        if self._is_html(filename):
+        if html:
             # the native PCRE2 pipeline has no HTML parser; convert here so
             # the stages see markdown, exactly like the scalar path
             from licensee_tpu.normalize.html2md import html_to_markdown
@@ -719,9 +742,16 @@ class BatchClassifier:
                         lic.key, "reference", 90.0, closest=kept
                     )
         if self.closest:
-            for r in results:
-                if r is not None and r.closest is not None:
-                    r.closest = r.closest[: self.closest]
+            # trim ONLY the rows this call built (the device-scored todo
+            # chunks — the readme fallback rows above are a subset): a
+            # preset row from the dedupe cache was trimmed by the batch
+            # that created it, and a finished result must never be
+            # mutated again (cached objects alias many output rows)
+            for chunk, _ in outs:
+                for i in chunk:
+                    r = results[i]
+                    if r is not None and r.closest is not None:
+                        r.closest = r.closest[: self.closest]
 
     def _closest_list(self, idx_row, score_row, matched_key):
         """The top-k candidates as [(key, confidence), ...], float64-

@@ -27,7 +27,7 @@ import os
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 import numpy as np
 
@@ -417,7 +417,19 @@ class BatchProject:
                         elif self.dedupe and keys[k] is not None:
                             if len(cache) >= self.dedupe_cap:
                                 cache.pop(next(iter(cache)))  # FIFO bound
-                            cache[keys[k]] = result
+                            # snapshot, not alias: the cached result will
+                            # be handed out as a preset row many times —
+                            # a copy with a tuple closest list means no
+                            # later batch-finishing (or future per-row
+                            # annotation) can reach back and corrupt it
+                            cache[keys[k]] = replace(
+                                result,
+                                closest=(
+                                    tuple(result.closest)
+                                    if result.closest is not None
+                                    else None
+                                ),
+                            )
                     self.stats.total += 1
                     lines.append(_jsonl_row(path, result, error))
                 lines.append("")

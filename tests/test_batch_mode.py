@@ -93,6 +93,29 @@ def test_readme_mode_agrees_with_scalar_chain(readme_clf):
         assert got.matcher == want_matcher, name
 
 
+def test_readme_html_converted_before_extraction(readme_clf):
+    """An HTML readme is markdown-converted BEFORE the CONTENT_REGEX scan
+    (the header regex understands markdown, not <h2> tags), and the
+    extracted section is not converted a second time.  The reference
+    never scores .html as a README (readme_file.rb:6-12), so this corner
+    is ours to define: convert-then-extract is the consistent order."""
+    html = (
+        b"<html><body><h1>Project</h1><p>stuff</p>"
+        b"<h2>License</h2>"
+        b"<p>Licensed under the MIT License.</p>"
+        b"</body></html>"
+    )
+    results = readme_clf.classify_blobs([html], filenames=["README.html"])
+    assert results[0].key == "mit"
+    assert results[0].matcher == "reference"
+
+    # same content under a non-HTML name: raw angle brackets, no
+    # markdown header -> no section -> unmatched (order-consistency
+    # check: the HTML path must come from the conversion, not luck)
+    results = readme_clf.classify_blobs([html], filenames=["README.md"])
+    assert results[0].key is None
+
+
 # -- package mode --
 
 

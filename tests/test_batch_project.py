@@ -244,6 +244,41 @@ def test_dedupe_short_circuits_repeats(tmp_path):
     assert stats2.dedupe_hits == 0
 
 
+def test_dedupe_cache_holds_immutable_snapshots(tmp_path):
+    """The dedupe cache stores a snapshot (tuple closest), never the live
+    BlobResult a batch is still finishing: cached objects alias many
+    output rows, so any in-place mutation after insertion would corrupt
+    unrelated rows.  finish_chunks also trims only rows it built, so a
+    preset row's (already-trimmed) list is never re-sliced."""
+    mit = open(fixture_path("mit/LICENSE.txt"), "rb").read()
+    # perturb so the Exact prefilter misses and the Dice scorer (the
+    # closest-list producer) runs
+    blob = mit + b"\nextra trailing words beyond the template text\n"
+    paths = []
+    for i in range(6):
+        d = tmp_path / f"r{i}"
+        d.mkdir()
+        p = d / "LICENSE"
+        p.write_bytes(blob)
+        paths.append(str(p))
+    project = BatchProject(
+        paths, batch_size=1, workers=1, inflight=1, closest=2, threshold=90
+    )
+    out = tmp_path / "out.jsonl"
+    stats = project.run(str(out), resume=False)
+    assert stats.dedupe_hits >= 1
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert all(r["key"] == "mit" for r in rows)
+    # every duplicate row carries the identical trimmed closest list
+    assert all(len(r["closest"]) == 2 for r in rows)
+    assert all(r["closest"] == rows[0]["closest"] for r in rows)
+    # and the cache's own copies are frozen (tuple, trimmed)
+    for cached in project._dedupe_cache.values():
+        assert cached.closest is None or (
+            isinstance(cached.closest, tuple) and len(cached.closest) <= 2
+        )
+
+
 def test_dedupe_key_carries_filename_dispatch(tmp_path):
     """The cache key carries the filename-dependent dispatch (the HTML
     gate in license mode), so HTML-converted semantics never leak onto a
