@@ -227,25 +227,43 @@ def make_best_match_fn(corpus: CorpusArrays, method: str = "popcount"):
 
 
 def topk_candidates(num: jnp.ndarray, den: jnp.ndarray, k: int):
-    """Top-k (index, num, den) columns ranked by float32 score.
+    """Top-k (index, num, den) columns in EXACT score order.
 
-    The only inexactness is the ORDER of candidates whose scores collide
-    in float32 — the returned (num, den) pairs are exact, so the host
-    re-sorts the k rows in float64 and only the inclusion boundary at
-    rank k is approximate."""
-    scores = num.astype(jnp.float32) / den.astype(jnp.float32)
-    _, k_idx = lax.top_k(scores, k)
-    k_num = jnp.take_along_axis(num, k_idx, axis=1)
-    k_den = jnp.take_along_axis(den, k_idx, axis=1)
-    return k_idx.astype(jnp.int32), k_num, k_den
+    k rounds of the same int64 cross-multiplication tournament the top-1
+    path uses, masking each round's winner to the excluded (-1, 1)
+    sentinel: the inclusion boundary at rank k is exact, and ties break
+    toward the lower template index at every rank — identical semantics
+    to running the sequential first-max scan k times.  k is small (the
+    CLI's --closest K, plus one), so the unrolled k·log2(T) folds are
+    noise next to the B×T×V overlap compute."""
+    T = num.shape[1]
+    col = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None, :], num.shape
+    )
+    k_idx, k_num, k_den = [], [], []
+    n, d = num, den
+    for _ in range(k):
+        idx, nn, dd = _argmax_exact(n, d)
+        k_idx.append(idx)
+        k_num.append(nn)
+        k_den.append(dd)
+        won = col == idx[:, None]
+        n = jnp.where(won, -1, n)
+        d = jnp.where(won, 1, d)
+    return (
+        jnp.stack(k_idx, axis=1),
+        jnp.stack(k_num, axis=1),
+        jnp.stack(k_den, axis=1),
+    )
 
 
 def make_topk_fn(corpus: CorpusArrays, k: int, method: str = "popcount"):
     """Jitted scorer returning the EXACT top-1 plus a top-k candidate
     list per blob (the batch analog of the CLI's closest-licenses view,
     commands/detect.rb:44-63).  The top-1 triple uses the exact int64
-    tournament (bit-identical to `make_best_match_fn`); see
-    `topk_candidates` for the k-list's float32 ranking caveat."""
+    tournament (bit-identical to `make_best_match_fn`); the k columns
+    use the same exact comparison (`topk_candidates`), so the whole
+    candidate list is exact, boundary included."""
 
     @jax.jit
     def fn(file_bits, n_words, lengths, cc_fp):

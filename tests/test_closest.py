@@ -99,6 +99,41 @@ def test_closest_row_serialization(tmp_path):
         assert isinstance(key, str) and isinstance(conf, float)
 
 
+def test_topk_exact_at_f32_colliding_boundary():
+    """Adversarial rank-k boundary: candidate scores that COLLIDE in
+    float32 must still come back in exact fraction order (int64
+    cross-multiplication, ties to the lower index) — the k-th slot
+    admits exactly the right candidate."""
+    from fractions import Fraction
+
+    import jax.numpy as jnp
+
+    from licensee_tpu.kernels.dice_xla import topk_candidates
+
+    # (d-1)//2 / d = 1/2 - 1/(2d): adjacent pairs differ by ~1e-10,
+    # far below f32's ~6e-8 spacing at 0.5.  Shuffled so index order
+    # and score order disagree; one exact tie pair (indexes 3 and 6)
+    # checks the lower-index break.
+    dens = [99991, 99961, 99989, 100000, 99979, 99971, 50000, 99959]
+    nums = [(d - 1) // 2 for d in dens]
+    nums[3], dens[3] = 50000, 100000  # == 25000/50000 at index 6
+    nums[6], dens[6] = 25000, 50000
+    f32 = np.asarray(nums, np.float32) / np.asarray(dens, np.float32)
+    assert len(set(f32.tolist())) < len(dens)  # the premise: f32 collides
+
+    order = sorted(
+        range(len(dens)),
+        key=lambda i: (-Fraction(nums[i], dens[i]), i),
+    )
+    for k in (1, 4, len(dens)):
+        k_idx, k_num, k_den = topk_candidates(
+            jnp.asarray([nums], jnp.int32), jnp.asarray([dens], jnp.int32), k
+        )
+        assert list(np.asarray(k_idx)[0]) == order[:k], k
+        assert list(np.asarray(k_num)[0]) == [nums[i] for i in order[:k]]
+        assert list(np.asarray(k_den)[0]) == [dens[i] for i in order[:k]]
+
+
 def test_closest_rejects_pallas():
     with pytest.raises(ValueError):
         BatchClassifier(pad_batch_to=16, method="pallas", closest=2)
