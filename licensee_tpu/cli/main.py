@@ -368,11 +368,27 @@ def cmd_batch_detect(args) -> int:
                 )
                 return 1
         else:
-            contents = [project._read(p) for p in paths]
+            from licensee_tpu.kernels.batch import BatchClassifier
+
+            filenames = [os.path.basename(p) for p in paths]
+            routes = None
+            if project.mode == "auto":
+                # same pre-read routing as the pipelined path: entries no
+                # table scores are never opened
+                routes = [BatchClassifier.route_for(f) for f in filenames]
+                for r in routes:
+                    project.stats.add_route(r)
+            contents = [
+                project._read(p)
+                if routes is None or routes[i] is not None
+                else b""
+                for i, p in enumerate(paths)
+            ]
             results = project.classifier.classify_blobs(
                 [c if c is not None else b"" for c in contents],
                 threshold=project.threshold,
-                filenames=[os.path.basename(p) for p in paths],
+                filenames=filenames,
+                routes=routes,
             )
             for path, content, result in zip(paths, contents, results):
                 row = {"path": path, **result.as_dict()}
@@ -476,12 +492,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     batch.add_argument(
         "--mode", default="license",
-        choices=["license", "readme", "package"],
+        choices=["license", "readme", "package", "auto"],
         help=(
             "Which project-file chain to run per blob: 'license' "
             "(Copyright/Exact/Dice), 'readme' (extract the License "
-            "section, then the license chain + Reference fallback), or "
-            "'package' (filename-dispatched package-manifest matchers)"
+            "section, then the license chain + Reference fallback), "
+            "'package' (filename-dispatched package-manifest matchers), "
+            "or 'auto' (route EACH file by its filename through the "
+            "reference's score tables — LICENSE-likes to the license "
+            "chain, READMEs to the readme chain, package manifests to "
+            "their matchers, everything else skipped unread — for "
+            "mixed manifests)"
         ),
     )
     batch.add_argument(

@@ -94,7 +94,7 @@ class BatchClassifier:
     ):
         from licensee_tpu.kernels.dice_xla import CorpusArrays, make_best_match_fn
 
-        if mode not in ("license", "readme", "package"):
+        if mode not in ("license", "readme", "package", "auto"):
             raise ValueError(f"unknown mode {mode!r}")
         self.mode = mode
         self.closest = int(closest)
@@ -305,6 +305,7 @@ class BatchClassifier:
         prefilter: bool = True,
         filenames: list[str | None] | None = None,
         preset: list | None = None,
+        routes: list | None = None,
     ):
         """Sanitize, prefilter and featurize a batch of raw blobs.
 
@@ -336,6 +337,14 @@ class BatchClassifier:
         result rows — the dedupe cache's hits (BatchProject) — so those
         blobs skip featurization and the device entirely.
 
+        In auto mode each row runs the chain its FILENAME dispatches to
+        (``route_for``): license rows the Copyright/Exact/Dice chain,
+        readme rows the extraction + chain + Reference fallback, package
+        rows the host matcher table, and unrecognized filenames match
+        nothing.  ``routes`` (parallel to ``contents``) lets the caller
+        pass precomputed routes (BatchProject resolves them before even
+        reading the files); otherwise they are derived here.
+
         A blob whose featurization raises is contained: it gets an
         ``error`` result row and the rest of the batch proceeds (a single
         poisoned blob must not wedge a 10M-file run)."""
@@ -357,14 +366,37 @@ class BatchClassifier:
             self._is_html(filenames[i] if filenames else None)
             for i in range(B)
         ]
-        sections: list | None = None
+        readme_sel: list[bool] | None = None
         if self.mode == "readme":
+            readme_sel = [True] * B
+        elif self.mode == "auto":
+            if routes is None:
+                routes = [
+                    self.route_for(filenames[i] if filenames else None)
+                    for i in range(B)
+                ]
+            readme_sel = [r == "readme" for r in routes]
+            for i, route in enumerate(routes):
+                if results[i] is not None:
+                    continue
+                if route == "package":
+                    results[i] = self._package_match_one(
+                        contents[i], filenames[i] if filenames else None
+                    )
+                elif route is None:
+                    # no table scores this filename: the reference never
+                    # constructs a project file for it (find_files drops
+                    # score-0 entries, project.rb:111-117)
+                    results[i] = BlobResult(None, None, 0.0)
+        sections: list | None = None
+        if readme_sel is not None and any(readme_sel):
             from licensee_tpu.project_files.readme_file import ReadmeFile
 
             sections = [None] * B
             extracted: list = []
             for i, raw in enumerate(contents):
-                if results[i] is not None:  # preset (dedupe) rows skip
+                if results[i] is not None or not readme_sel[i]:
+                    # preset (dedupe) rows and non-readme routes skip
                     extracted.append(None)
                     continue
                 try:
@@ -391,7 +423,7 @@ class BatchClassifier:
                         )
                     )
             for i, section in enumerate(extracted):
-                if results[i] is not None:
+                if results[i] is not None or not readme_sel[i]:
                     continue
                 if isinstance(section, BlobResult):
                     results[i] = section
@@ -401,8 +433,12 @@ class BatchClassifier:
                     results[i] = BlobResult(None, None, 0.0)
                 else:
                     sections[i] = section
+            # readme rows proceed with their extracted section (or
+            # nothing); license-routed rows keep their raw content
             contents = [
-                sections[i] if sections[i] is not None else ""
+                (sections[i] if sections[i] is not None else "")
+                if readme_sel[i]
+                else contents[i]
                 for i in range(B)
             ]
 
@@ -516,10 +552,6 @@ class BatchClassifier:
         DESCRIPTION/dist.ini/LICENSE.spdx/Cargo.toml by name) and reports
         the declared license — `other` for declared-but-unknown values,
         no match when no matcher claims the filename."""
-        from licensee_tpu.project_files.package_manager_file import (
-            PackageManagerFile,
-        )
-
         B = len(contents)
         results: list[BlobResult | None] = (
             list(preset) if preset is not None else [None] * B
@@ -527,26 +559,38 @@ class BatchClassifier:
         for i, raw in enumerate(contents):
             if results[i] is not None:
                 continue
-            filename = filenames[i] if filenames else None
-            try:
-                pf = PackageManagerFile(raw, filename)
-                matcher = pf.matcher
-                lic = matcher.match if matcher is not None else None
-                if matcher is not None and lic is not None:
-                    results[i] = BlobResult(
-                        lic.key, matcher.name, float(matcher.confidence)
-                    )
-                else:
-                    results[i] = BlobResult(None, None, 0.0)
-            except Exception as exc:  # noqa: BLE001 — per-blob containment
-                results[i] = BlobResult(
-                    None, None, 0.0, error=f"featurize_error: {exc}"
-                )
+            results[i] = self._package_match_one(
+                raw, filenames[i] if filenames else None
+            )
         empty = np.zeros((B, 0), dtype=np.uint32)
         zeros = np.zeros(B, dtype=np.int32)
         return PreparedBatch(
             results, empty, zeros, zeros, np.zeros(B, dtype=bool), []
         )
+
+    def _package_match_one(
+        self, raw, filename: str | None
+    ) -> BlobResult:
+        """One blob through the filename-dispatched package matcher table
+        (package_manager_file.rb + the matcher family's lenient regexes),
+        with the same per-blob error containment as every other chain."""
+        from licensee_tpu.project_files.package_manager_file import (
+            PackageManagerFile,
+        )
+
+        try:
+            pf = PackageManagerFile(raw, filename)
+            matcher = pf.matcher
+            lic = matcher.match if matcher is not None else None
+            if matcher is not None and lic is not None:
+                return BlobResult(
+                    lic.key, matcher.name, float(matcher.confidence)
+                )
+            return BlobResult(None, None, 0.0)
+        except Exception as exc:  # noqa: BLE001 — per-blob containment
+            return BlobResult(
+                None, None, 0.0, error=f"featurize_error: {exc}"
+            )
 
     def _prepare_one_python(
         self, raw, results, bits, n_words, lengths, cc_fp, i, prefilter=True,
@@ -570,6 +614,35 @@ class BatchClassifier:
     @staticmethod
     def _is_html(filename: str | None) -> bool:
         return bool(filename) and filename.lower().endswith((".html", ".htm"))
+
+    @staticmethod
+    def route_for(filename: str | None) -> str | None:
+        """Per-file chain dispatch for mixed manifests (--mode auto).
+
+        The reference selects each project-file class by its own filename
+        score table (project.rb:111-117 via LicenseFile.name_score
+        license_file.rb:38-59, ReadmeFile.name_score readme_file.rb:6-12,
+        PackageManagerFile.name_score package_manager_file.rb:30-41).  A
+        batch manifest emits ONE row per entry, so the top-scoring class
+        wins; ties prefer license > package > readme (the reference's
+        Project#license consults license_files first).  A filename no
+        table scores is never read at all — exactly like find_files
+        dropping score-0 entries."""
+        if not filename:
+            return None
+        from licensee_tpu.project_files.license_file import LicenseFile
+        from licensee_tpu.project_files.package_manager_file import (
+            PackageManagerFile,
+        )
+        from licensee_tpu.project_files.readme_file import ReadmeFile
+
+        score, route = max(
+            (LicenseFile.name_score(filename), "license"),
+            (PackageManagerFile.name_score(filename), "package"),
+            (ReadmeFile.name_score(filename), "readme"),
+            key=lambda t: t[0],
+        )
+        return route if score > 0 else None
 
     def _prepare_one_native(
         self, raw, results, bits, n_words, lengths, cc_fp, i, prefilter=True,
@@ -631,12 +704,13 @@ class BatchClassifier:
         threshold: float | None = None,
         prefilter: bool = True,
         filenames: list[str | None] | None = None,
+        routes: list | None = None,
     ) -> list[BlobResult]:
         threshold = (
             licensee_tpu.confidence_threshold() if threshold is None else threshold
         )
         prepared = self.prepare_batch(
-            contents, prefilter=prefilter, filenames=filenames
+            contents, prefilter=prefilter, filenames=filenames, routes=routes
         )
         outs = self.dispatch_chunks(prepared)
         self.finish_chunks(prepared, outs, threshold)
@@ -722,7 +796,7 @@ class BatchClassifier:
                     results[i].closest = self._closest_list(
                         k_rows[0][j], k_rows[1][j], results[i].key
                     )
-        if self.mode == "readme" and prepared.sections is not None:
+        if self.mode in ("readme", "auto") and prepared.sections is not None:
             for i, section in enumerate(prepared.sections):
                 r = results[i]
                 if section is None or r is None or r.key or r.error:

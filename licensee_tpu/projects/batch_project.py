@@ -40,6 +40,12 @@ from licensee_tpu.kernels.batch import BlobResult
 # marker makes an accidental leak visible instead of silent.
 _IN_BATCH_DUP = BlobResult(None, None, 0.0, error="in_batch_dup_unresolved")
 
+# the shared row for --mode auto entries no filename table scores: the
+# file is never read, never hashed, never featurized (find_files drops
+# score-0 names before load_file, project.rb:111-124).  Finished results
+# are never mutated, so one frozen instance serves every such row.
+_UNROUTED = BlobResult(None, None, 0.0)
+
 
 @functools.lru_cache(maxsize=4096)
 def _json_str(s: str | None) -> str:
@@ -83,6 +89,10 @@ class BatchStats:
     read_errors: int = 0
     featurize_errors: int = 0
     dedupe_hits: int = 0
+    # --mode auto: rows per dispatched chain ("license" / "readme" /
+    # "package" / "none" for filenames no table scores) — the per-mode
+    # stats split of a mixed-manifest run
+    routed: dict = field(default_factory=dict)
     # per-stage wall-clock seconds (the observability surface of
     # SURVEY.md §5; read+featurize accumulate across worker threads, so
     # they can exceed elapsed on multi-core hosts)
@@ -91,8 +101,14 @@ class BatchStats:
     def add_stage(self, stage: str, seconds: float) -> None:
         self.stage_seconds[stage] = self.stage_seconds.get(stage, 0.0) + seconds
 
+    def add_route(self, route: str | None) -> None:
+        route = route or "none"
+        self.routed[route] = self.routed.get(route, 0) + 1
+
     def as_dict(self) -> dict:
         d = dict(self.__dict__)
+        if not d["routed"]:
+            del d["routed"]  # fixed-mode runs keep their old stats shape
         d["stage_seconds"] = {
             k: round(v, 4) for k, v in self.stage_seconds.items()
         }
@@ -275,32 +291,51 @@ class BatchProject:
     # -- the pipeline stages --
 
     def _produce(self, start: int):
-        """Worker-thread stage: read + dedupe + prefilter + featurize."""
+        """Worker-thread stage: route + read + dedupe + prefilter +
+        featurize.  In auto mode the filename routes FIRST: a manifest
+        entry no score table claims skips the read, the hash, and the
+        device entirely — on a 50M mixed manifest the unrecognized
+        majority costs one regex scan of the basename and nothing else."""
         import hashlib
 
+        from licensee_tpu.kernels.batch import BatchClassifier
+
         chunk = self.paths[start : start + self.batch_size]
-        t0 = time.perf_counter()
-        contents = [self._read(p) for p in chunk]
-        t1 = time.perf_counter()
         filenames = [os.path.basename(p) for p in chunk]
+        routes: list | None = None
+        if self.mode == "auto":
+            routes = [BatchClassifier.route_for(f) for f in filenames]
+        t0 = time.perf_counter()
+        contents = [
+            self._read(p)
+            if routes is None or routes[i] is not None
+            else b""
+            for i, p in enumerate(chunk)
+        ]
+        t1 = time.perf_counter()
         keys: list = [None] * len(chunk)
         preset: list = [None] * len(chunk)
         dup_of: dict[int, int] = {}
+        if routes is not None:
+            for i, route in enumerate(routes):
+                if route is None:
+                    preset[i] = _UNROUTED
         if self.dedupe:
-            from licensee_tpu.kernels.batch import BatchClassifier
-
             cache = self._dedupe_cache
-            package = self.mode == "package"
             first_seen: dict = {}
             for i, c in enumerate(contents):
-                if c is None:
+                if c is None or preset[i] is not None:
                     continue
-                # license/readme: only the HTML gate reads the filename;
-                # package: the whole matcher table does
+                route = routes[i] if routes is not None else self.mode
+                # package: the whole matcher table reads the filename;
+                # license/readme: only the HTML gate does.  The route is
+                # part of the key, so a mixed manifest never shares a
+                # cached result across chains.
                 dispatch = (
+                    route,
                     filenames[i]
-                    if package
-                    else BatchClassifier._is_html(filenames[i])
+                    if route == "package"
+                    else BatchClassifier._is_html(filenames[i]),
                 )
                 # usedforsecurity=False: a cache key, not crypto — and
                 # FIPS-mode OpenSSL would otherwise refuse sha1 entirely
@@ -321,11 +356,12 @@ class BatchProject:
             [c if c is not None else b"" for c in contents],
             filenames=filenames,
             preset=preset,
+            routes=routes,
         )
         t2 = time.perf_counter()
         read_errs = [c is None for c in contents]
         return (
-            chunk, read_errs, keys, preset, dup_of, prepared,
+            chunk, read_errs, keys, preset, dup_of, routes, prepared,
             (t1 - t0, t2 - t1),
         )
 
@@ -373,7 +409,7 @@ class BatchProject:
             while futures or pending:
                 # keep up to 2 device batches in flight before draining
                 while futures and len(pending) < 2:
-                    chunk, read_errs, keys, preset, dup_of, prepared, (
+                    chunk, read_errs, keys, preset, dup_of, routes, prepared, (
                         t_read,
                         t_feat,
                     ) = futures.popleft().result()
@@ -384,13 +420,12 @@ class BatchProject:
                     device_out = self._dispatch(prepared)
                     self.stats.add_stage("dispatch", time.perf_counter() - t0)
                     pending.append(
-                        (chunk, read_errs, keys, preset, dup_of, prepared,
-                         device_out)
+                        (chunk, read_errs, keys, preset, dup_of, routes,
+                         prepared, device_out)
                     )
 
-                chunk, read_errs, keys, preset, dup_of, prepared, device_out = (
-                    pending.popleft()
-                )
+                (chunk, read_errs, keys, preset, dup_of, routes, prepared,
+                 device_out) = pending.popleft()
                 t0 = time.perf_counter()
                 results = self._finish(prepared, device_out)
                 for i, j in dup_of.items():
@@ -412,7 +447,9 @@ class BatchProject:
                         self.stats.featurize_errors += 1
                     else:
                         self._count(result)
-                        if preset[k] is not None:
+                        if routes is not None and routes[k] is None:
+                            pass  # unrecognized filename: no cache traffic
+                        elif preset[k] is not None:
                             self.stats.dedupe_hits += 1
                         elif self.dedupe and keys[k] is not None:
                             if len(cache) >= self.dedupe_cap:
@@ -431,6 +468,8 @@ class BatchProject:
                                 ),
                             )
                     self.stats.total += 1
+                    if routes is not None:
+                        self.stats.add_route(routes[k])
                     lines.append(_jsonl_row(path, result, error))
                 lines.append("")
                 out.write("\n".join(lines))

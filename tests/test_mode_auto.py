@@ -1,0 +1,235 @@
+"""batch-detect --mode auto: per-file chain routing for mixed manifests
+(north-star config 5: 50M files mixing LICENSEs, READMEs, package
+manifests, and mostly-unrelated source files).
+
+Parity targets: `projects/project.rb:111-124` (find_files selects each
+project-file class by its own name_score table and never loads score-0
+files) and the three score tables it dispatches through
+(`license_file.rb:38-59`, `readme_file.rb:6-12`,
+`package_manager_file.rb:30-41`).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from licensee_tpu.kernels.batch import BatchClassifier
+from licensee_tpu.projects.batch_project import BatchProject
+from tests.conftest import fixture_path
+
+
+def fixture_bytes(name: str) -> bytes:
+    with open(fixture_path(name), "rb") as f:
+        return f.read()
+
+
+# -- routing table --
+
+
+@pytest.mark.parametrize(
+    ("filename", "route"),
+    [
+        ("LICENSE", "license"),
+        ("license", "license"),
+        ("COPYING.md", "license"),
+        ("LICENSE.txt", "license"),
+        ("UNLICENSE", "license"),
+        ("COPYING.lesser", "license"),
+        ("MIT-LICENSE", "license"),
+        ("LICENSE-MIT.json", "license"),  # 0.70 beats the package table's 0
+        ("PATENTS", "license"),
+        ("LICENSE.html", "license"),
+        ("README", "readme"),
+        ("README.md", "readme"),
+        ("README.rst", "readme"),
+        ("package.json", "package"),
+        ("bower.json", "package"),
+        ("project.gemspec", "package"),
+        ("foo.cabal", "package"),
+        ("foo.nuspec", "package"),
+        ("Cargo.toml", "package"),
+        ("DESCRIPTION", "package"),
+        ("dist.ini", "package"),
+        ("LICENSE.spdx", "package"),  # license table excludes .spdx
+        ("COPYING.cabal", "package"),  # package 1.0 outscores license 0.75
+        ("main.c", None),
+        ("readme.html", None),  # the reference never scores .html readmes
+        ("notes.txt", None),
+        ("", None),
+    ],
+)
+def test_route_for(filename, route):
+    assert BatchClassifier.route_for(filename) == route
+
+
+# -- one-pass mixed classification --
+
+
+@pytest.fixture(scope="module")
+def auto_clf():
+    return BatchClassifier(pad_batch_to=16, mesh=None, mode="auto")
+
+
+def test_auto_classifies_mixed_blobs(auto_clf):
+    contents = [
+        fixture_bytes("mit/LICENSE.txt"),
+        fixture_bytes("license-with-readme-reference/README"),
+        b'{\n  "license": "MIT"\n}\n',
+        b"int main(void) { return 0; }\n",
+    ]
+    filenames = ["LICENSE.txt", "README", "package.json", "main.c"]
+    results = auto_clf.classify_blobs(contents, filenames=filenames)
+    assert [(r.key, r.matcher) for r in results] == [
+        ("mit", "exact"),
+        ("mit", "reference"),
+        ("mit", "npmbower"),
+        (None, None),
+    ]
+
+
+def test_auto_agrees_with_fixed_modes(auto_clf):
+    """Every routed row must equal what the corresponding fixed mode
+    produces for the same (content, filename)."""
+    cases = [
+        ("LICENSE.txt", fixture_bytes("mit/LICENSE.txt"), "license"),
+        ("LICENSE.md", fixture_bytes("gpl-3.0_markdown/LICENSE.md"), "license"),
+        ("README.md", fixture_bytes("readme/README.md"), "readme"),
+        (
+            "README",
+            fixture_bytes("license-with-readme-reference/README"),
+            "readme",
+        ),
+        ("project.gemspec", fixture_bytes("gemspec/project._gemspec"), "package"),
+        ("Cargo.toml", b'[package]\nlicense = "Apache-2.0"\n', "package"),
+    ]
+    got = auto_clf.classify_blobs(
+        [c for _, c, _ in cases], filenames=[f for f, _, _ in cases]
+    )
+    fixed = {
+        "license": BatchClassifier(pad_batch_to=16, mesh=None),
+        "readme": BatchClassifier(pad_batch_to=16, mesh=None, mode="readme"),
+        "package": BatchClassifier(mode="package"),
+    }
+    for (filename, content, mode), g in zip(cases, got):
+        w = fixed[mode].classify_blobs([content], filenames=[filename])[0]
+        assert (g.key, g.matcher, g.confidence) == (
+            w.key,
+            w.matcher,
+            w.confidence,
+        ), filename
+
+
+# -- the pipelined BatchProject path --
+
+
+def test_auto_pipeline_routes_and_stats(tmp_path):
+    (tmp_path / "LICENSE").write_bytes(fixture_bytes("mit/LICENSE.txt"))
+    (tmp_path / "README").write_bytes(
+        fixture_bytes("license-with-readme-reference/README")
+    )
+    (tmp_path / "package.json").write_text('{"license": "MIT"}\n')
+    (tmp_path / "main.c").write_text("int main(void) { return 0; }\n")
+    paths = [
+        str(tmp_path / n)
+        for n in ["LICENSE", "README", "package.json", "main.c", "gone.c"]
+    ]
+    # gone.c does not exist AND is unrouted: auto must never try to read
+    # it (no read_error row), exactly like find_files dropping score-0
+    # names before load_file
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(paths, batch_size=4, mesh=None, mode="auto")
+    stats = project.run(str(out), resume=False)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [(r["key"], r["matcher"]) for r in rows] == [
+        ("mit", "exact"),
+        ("mit", "reference"),
+        ("mit", "npmbower"),
+        (None, None),
+        (None, None),
+    ]
+    assert "error" not in rows[4]  # never read -> no read_error
+    assert stats.read_errors == 0
+    assert stats.routed == {
+        "license": 1,
+        "readme": 1,
+        "package": 1,
+        "none": 2,
+    }
+    assert stats.prefiltered_exact == 1
+    assert stats.reference_matched == 1
+    assert stats.package_matched == 1
+    assert stats.unmatched == 2
+    assert "routed" in stats.as_dict()
+
+
+def test_fixed_mode_stats_keep_their_shape(tmp_path):
+    p = tmp_path / "LICENSE"
+    p.write_bytes(fixture_bytes("mit/LICENSE.txt"))
+    project = BatchProject([str(p)], batch_size=4, mesh=None)
+    project.run(str(tmp_path / "out.jsonl"), resume=False)
+    assert "routed" not in project.stats.as_dict()
+
+
+def test_auto_dedupe_key_carries_route(tmp_path):
+    """Identical bytes under names that route differently must never
+    share a cached result: full MIT text is an Exact match as LICENSE
+    but has no '## License' section as README."""
+    mit = fixture_bytes("mit/LICENSE.txt")
+    for i in range(2):
+        d = tmp_path / f"r{i}"
+        d.mkdir()
+        (d / "LICENSE").write_bytes(mit)
+        (d / "README").write_bytes(mit)
+    paths = []
+    for i in range(2):
+        paths += [
+            str(tmp_path / f"r{i}" / "LICENSE"),
+            str(tmp_path / f"r{i}" / "README"),
+        ]
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(
+        paths, batch_size=1, workers=1, inflight=1, mode="auto"
+    )
+    stats = project.run(str(out), resume=False)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [(r["key"], r["matcher"]) for r in rows] == [
+        ("mit", "exact"),
+        (None, None),
+        ("mit", "exact"),
+        (None, None),
+    ]
+    # repeats of each (route, content) pair DO hit the cache
+    assert stats.dedupe_hits == 2
+
+
+def test_auto_closest_only_on_dice_routed_rows(tmp_path):
+    near = fixture_bytes("mit/LICENSE.txt") + b"\nnudged off exact\n"
+    (tmp_path / "LICENSE").write_bytes(near)
+    (tmp_path / "package.json").write_text('{"license": "MIT"}\n')
+    paths = [str(tmp_path / "LICENSE"), str(tmp_path / "package.json")]
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(
+        paths, batch_size=4, mode="auto", closest=2, threshold=90
+    )
+    project.run(str(out), resume=False)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert rows[0]["key"] == "mit" and len(rows[0]["closest"]) == 2
+    assert rows[1]["matcher"] == "npmbower" and "closest" not in rows[1]
+
+
+def test_cli_batch_detect_auto(tmp_path, capsys):
+    from licensee_tpu.cli.main import main
+
+    (tmp_path / "LICENSE").write_bytes(fixture_bytes("mit/LICENSE.txt"))
+    (tmp_path / "main.py").write_text("print('hello')\n")
+    manifest = tmp_path / "manifest.txt"
+    manifest.write_text(f"{tmp_path / 'LICENSE'}\n{tmp_path / 'main.py'}\n")
+    assert main(["batch-detect", str(manifest), "--mode", "auto"]) == 0
+    rows = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert rows[0]["key"] == "mit"
+    assert rows[1]["key"] is None
