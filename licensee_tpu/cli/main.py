@@ -326,6 +326,7 @@ def cmd_batch_detect(args) -> int:
             dedupe=not args.no_dedupe,
             threshold=args.confidence,
             closest=args.closest,
+            attribution=args.attribution,
             **kwargs,
         )
     except OSError as exc:
@@ -368,28 +369,9 @@ def cmd_batch_detect(args) -> int:
                 )
                 return 1
         else:
-            from licensee_tpu.kernels.batch import BatchClassifier
-
-            filenames = [os.path.basename(p) for p in paths]
-            routes = None
-            if project.mode == "auto":
-                # same pre-read routing as the pipelined path: entries no
-                # table scores are never opened
-                routes = [BatchClassifier.route_for(f) for f in filenames]
-                for r in routes:
-                    project.stats.add_route(r)
-            contents = [
-                project._read(p)
-                if routes is None or routes[i] is not None
-                else b""
-                for i, p in enumerate(paths)
-            ]
-            results = project.classifier.classify_blobs(
-                [c if c is not None else b"" for c in contents],
-                threshold=project.threshold,
-                filenames=filenames,
-                routes=routes,
-            )
+            # the shared route -> read -> classify -> attribute pass
+            # (identical semantics to the pipelined run(), minus dedupe)
+            contents, results = project.classify_paths(paths)
             for path, content, result in zip(paths, contents, results):
                 row = {"path": path, **result.as_dict()}
                 if content is None:
@@ -527,6 +509,15 @@ def build_parser() -> argparse.ArgumentParser:
         help=(
             "Minimum Dice confidence for a match (default: the global "
             f"threshold, {licensee_tpu.CONFIDENCE_THRESHOLD})"
+        ),
+    )
+    batch.add_argument(
+        "--attribution", action="store_true",
+        help=(
+            "Extract the copyright/attribution line per matched blob "
+            "(detect's Attribution row, license_file.rb:71-77): a "
+            "post-match host regex, paid only for matched rows — and "
+            "with dedupe, once per unique content"
         ),
     )
     batch.add_argument(

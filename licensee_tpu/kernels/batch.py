@@ -15,10 +15,29 @@ import numpy as np
 
 import licensee_tpu
 from licensee_tpu.corpus.compiler import CompiledCorpus, default_corpus
-from licensee_tpu.normalize.pipeline import COPYRIGHT_FULL_REGEX, NormalizedContent
+from licensee_tpu.normalize.pipeline import (
+    COPYRIGHT_FULL_REGEX,
+    COPYRIGHT_REGEX,
+    NormalizedContent,
+)
 from licensee_tpu.project_files.license_file import CC_FALSE_POSITIVE_REGEX
 from licensee_tpu.project_files.project_file import sanitize_content
 from licensee_tpu.rubytext import ruby_strip
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _has_fullname(key: str) -> bool:
+    """Does the vendored license's template carry a [fullname] field?
+    Memoized: keys come from a small fixed pool, and License.find walks
+    the whole pool — a 10M-row --attribution run must pay one dict hit
+    per row, not a list rebuild."""
+    from licensee_tpu.corpus.license import License
+
+    lic = License.find(key)
+    return lic is not None and bool(lic.content) and "[fullname]" in lic.content
 
 
 class NormalizedBlob(NormalizedContent):
@@ -42,6 +61,10 @@ class BlobResult:
     # top-k candidate list [(key, confidence), ...] when the classifier
     # runs with closest=K (the CLI's closest-licenses view, batched)
     closest: list | None = None
+    # the copyright line, when requested (--attribution) and applicable
+    # (license_file.rb:71-77: matched license carries [fullname], or the
+    # Copyright matcher fired)
+    attribution: str | None = None
 
     def as_dict(self) -> dict:
         d = {
@@ -51,6 +74,8 @@ class BlobResult:
         }
         if self.closest is not None:
             d["closest"] = [[k, c] for k, c in self.closest]
+        if self.attribution is not None:
+            d["attribution"] = self.attribution
         return d
 
 
@@ -567,6 +592,59 @@ class BatchClassifier:
         return PreparedBatch(
             results, empty, zeros, zeros, np.zeros(B, dtype=bool), []
         )
+
+    def attribution_for(
+        self,
+        raw,
+        filename: str | None,
+        result: BlobResult,
+        route: str | None = None,
+    ) -> str | None:
+        """The copyright/attribution line for one matched blob — the batch
+        twin of LicenseFile#attribution (license_file.rb:71-77): applicable
+        when the Copyright matcher fired or the matched license's template
+        carries a [fullname] field; the line is the COPYRIGHT_REGEX hit on
+        the stage-1 normalized content.
+
+        Post-match only: a 10M-file run pays this ONLY for matched rows
+        (and with dedupe, once per unique content).  Readme rows scan the
+        extracted section, exactly like Project#readme constructing the
+        ReadmeFile from license_content (project.rb:74-80).  Package rows
+        have no attribution (the reference defines it on LicenseFile
+        only).  Custom-corpus keys unknown to License.find report None —
+        there is no template to prove a [fullname] placeholder from."""
+        if result.key is None or result.error:
+            return None
+        route = route or self.mode
+        if route not in ("license", "readme"):
+            return None
+        # the copyright? gate needs BOTH the Copyright matcher and a
+        # "copyright(.ext)" filename (project_file.rb:90-95); otherwise
+        # the matched license's template must carry [fullname]
+        applicable = False
+        if result.matcher == "copyright" and filename is not None:
+            from licensee_tpu.project_files.license_file import (
+                COPYRIGHT_NAME_REGEX,
+            )
+
+            applicable = bool(COPYRIGHT_NAME_REGEX.search(filename))
+        if not applicable and not _has_fullname(result.key):
+            return None
+        content = sanitize_content(raw) if raw is not None else ""
+        if route == "readme":
+            from licensee_tpu.project_files.readme_file import ReadmeFile
+
+            if self._is_html(filename):
+                from licensee_tpu.normalize.html2md import html_to_markdown
+
+                content = html_to_markdown(content)
+                filename = None  # gate consumed, same as prepare_batch
+            content = ReadmeFile.license_content(content)
+            if content is None:
+                return None
+        blob = NormalizedBlob(content, filename=filename)
+        m = COPYRIGHT_REGEX.search(blob.content_without_title_and_version)
+        return m.group(0) if m else None
 
     def _package_match_one(
         self, raw, filename: str | None

@@ -49,6 +49,12 @@ FILENAME_SCORES = [
     (rb(r""), 0.00),                                                               # catch-all
 ]
 
+# the copyright? filename test (project_file.rb:94): a COPYRIGHT(.ext)
+# file — shared by ProjectFile.is_copyright and the batch attribution gate
+COPYRIGHT_NAME_REGEX = rb(
+    r"\Acopyright(?:" + OTHER_EXT_REGEX + r")?\Z", i=True
+)
+
 # license_file.rb:61-65: CC-NC / CC-ND must not be detected as CC-BY(-SA)
 CC_FALSE_POSITIVE_REGEX = rb(
     r"^(creative\ commons\ )?Attribution-(NonCommercial|NoDerivatives)", i=True, x=True

@@ -88,7 +88,7 @@ class ProjectFile:
         deciding if a project is multi-licensed (project_file.rb:90-95)."""
         from licensee_tpu.matchers.copyright_matcher import Copyright
         from licensee_tpu.project_files.license_file import (
-            OTHER_EXT_REGEX,
+            COPYRIGHT_NAME_REGEX,
             LicenseFile,
         )
 
@@ -96,13 +96,7 @@ class ProjectFile:
             return False
         if not isinstance(self.matcher, Copyright):
             return False
-        return bool(
-            re.match(
-                r"\Acopyright(?:" + OTHER_EXT_REGEX + r")?\Z",
-                self.filename or "",
-                re.I,
-            )
-        )
+        return bool(COPYRIGHT_NAME_REGEX.search(self.filename or ""))
 
     @property
     def content_hash(self):

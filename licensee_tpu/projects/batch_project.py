@@ -72,6 +72,8 @@ def _jsonl_row(path: str, result, error: str | None) -> str:
             f"[{_json_str(k)}, {c!r}]" for k, c in result.closest
         )
         row += f', "closest": [{inner}]'
+    if result.attribution is not None:
+        row += f', "attribution": {json.dumps(result.attribution)}'
     if error is not None:
         row += f', "error": {json.dumps(error)}'
     return row + "}"
@@ -139,6 +141,7 @@ class BatchProject:
         dedupe: bool = True,
         dedupe_cap: int = 1 << 20,
         closest: int = 0,
+        attribution: bool = False,
         already_striped: bool = False,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
@@ -206,6 +209,10 @@ class BatchProject:
         self.dedupe_cap = dedupe_cap
         self._dedupe_cache: dict = {}
         self.mode = self.classifier.mode
+        # --attribution: extract the copyright line per matched blob
+        # (post-match host regex; with dedupe, once per unique content).
+        # Raw contents ride the pipeline tuples only when enabled.
+        self.attribution = attribution
 
     @classmethod
     def from_manifest_file(cls, manifest_file: str, **kwargs) -> "BatchProject":
@@ -330,13 +337,28 @@ class BatchProject:
                 # package: the whole matcher table reads the filename;
                 # license/readme: only the HTML gate does.  The route is
                 # part of the key, so a mixed manifest never shares a
-                # cached result across chains.
-                dispatch = (
-                    route,
-                    filenames[i]
-                    if route == "package"
-                    else BatchClassifier._is_html(filenames[i]),
-                )
+                # cached result across chains.  With --attribution on,
+                # the copyright? filename gate (project_file.rb:94) also
+                # feeds the result, so its bit joins the key — COPYRIGHT
+                # and LICENSE holding identical bytes attribute
+                # differently and must not share a cache slot.
+                if route == "package":
+                    dispatch = (route, filenames[i])
+                else:
+                    dispatch = (
+                        route,
+                        BatchClassifier._is_html(filenames[i]),
+                    )
+                    if self.attribution:
+                        from licensee_tpu.project_files.license_file import (
+                            COPYRIGHT_NAME_REGEX,
+                        )
+
+                        dispatch += (
+                            bool(
+                                COPYRIGHT_NAME_REGEX.search(filenames[i])
+                            ),
+                        )
                 # usedforsecurity=False: a cache key, not crypto — and
                 # FIPS-mode OpenSSL would otherwise refuse sha1 entirely
                 keys[i] = (
@@ -362,6 +384,7 @@ class BatchProject:
         read_errs = [c is None for c in contents]
         return (
             chunk, read_errs, keys, preset, dup_of, routes, prepared,
+            contents if self.attribution else None,
             (t1 - t0, t2 - t1),
         )
 
@@ -409,10 +432,8 @@ class BatchProject:
             while futures or pending:
                 # keep up to 2 device batches in flight before draining
                 while futures and len(pending) < 2:
-                    chunk, read_errs, keys, preset, dup_of, routes, prepared, (
-                        t_read,
-                        t_feat,
-                    ) = futures.popleft().result()
+                    (chunk, read_errs, keys, preset, dup_of, routes, prepared,
+                     contents, (t_read, t_feat)) = futures.popleft().result()
                     submit_next()
                     self.stats.add_stage("read", t_read)
                     self.stats.add_stage("featurize", t_feat)
@@ -421,11 +442,11 @@ class BatchProject:
                     self.stats.add_stage("dispatch", time.perf_counter() - t0)
                     pending.append(
                         (chunk, read_errs, keys, preset, dup_of, routes,
-                         prepared, device_out)
+                         prepared, contents, device_out)
                     )
 
                 (chunk, read_errs, keys, preset, dup_of, routes, prepared,
-                 device_out) = pending.popleft()
+                 contents, device_out) = pending.popleft()
                 t0 = time.perf_counter()
                 results = self._finish(prepared, device_out)
                 for i, j in dup_of.items():
@@ -446,6 +467,23 @@ class BatchProject:
                         error = result.error
                         self.stats.featurize_errors += 1
                     else:
+                        if (
+                            self.attribution
+                            and preset[k] is None
+                            and result.key is not None
+                        ):
+                            result.attribution = (
+                                self.classifier.attribution_for(
+                                    contents[k],
+                                    os.path.basename(path),
+                                    result,
+                                    route=(
+                                        routes[k]
+                                        if routes is not None
+                                        else None
+                                    ),
+                                )
+                            )
                         self._count(result)
                         if routes is not None and routes[k] is None:
                             pass  # unrecognized filename: no cache traffic
@@ -479,6 +517,47 @@ class BatchProject:
                 self.stats.add_stage("write", t2 - t1)
         self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats
+
+    def classify_paths(self, paths: list[str]):
+        """Route, read, classify and (optionally) attribute paths in one
+        unpipelined pass — the small-manifest twin of run(), used by the
+        CLI's no---output mode.  Returns (contents, results); a row's
+        content is None when the read failed (the caller decides how to
+        surface that), b"" when auto routing skipped the read."""
+        from licensee_tpu.kernels.batch import BatchClassifier
+
+        filenames = [os.path.basename(p) for p in paths]
+        routes = None
+        if self.mode == "auto":
+            routes = [BatchClassifier.route_for(f) for f in filenames]
+            for r in routes:
+                self.stats.add_route(r)
+        contents = [
+            self._read(p)
+            if routes is None or routes[i] is not None
+            else b""
+            for i, p in enumerate(paths)
+        ]
+        results = self.classifier.classify_blobs(
+            [c if c is not None else b"" for c in contents],
+            threshold=self.threshold,
+            filenames=filenames,
+            routes=routes,
+        )
+        if self.attribution:
+            for i, r in enumerate(results):
+                if (
+                    contents[i] is not None
+                    and not r.error
+                    and r.key is not None
+                ):
+                    r.attribution = self.classifier.attribution_for(
+                        contents[i],
+                        filenames[i],
+                        r,
+                        route=routes[i] if routes is not None else None,
+                    )
+        return contents, results
 
     def classify_contents(
         self,

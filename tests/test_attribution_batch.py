@@ -1,0 +1,172 @@
+"""batch-detect --attribution: the copyright line per matched blob.
+
+Parity target: `LicenseFile#attribution` (license_file.rb:71-77) — the
+batch rows must carry exactly what the scalar CLI's Attribution field
+shows for the same content.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from licensee_tpu.kernels.batch import BatchClassifier
+from licensee_tpu.projects.batch_project import BatchProject
+from tests.conftest import fixture_path
+
+
+def fixture_bytes(name: str) -> bytes:
+    with open(fixture_path(name), "rb") as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def clf():
+    return BatchClassifier(pad_batch_to=16, mesh=None)
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "mit/LICENSE.txt",
+        "apache-2.0_markdown/LICENSE.md",
+        "gpl-3.0_markdown/LICENSE.md",
+        "bsd-2-author/LICENSE",
+        "bsd-3-clause_markdown/LICENSE.md",
+        "crlf-license/LICENSE",
+        "copyright-encoding/COPYING",
+    ],
+)
+def test_attribution_matches_scalar_license_file(clf, name):
+    from licensee_tpu.project_files.license_file import LicenseFile
+
+    raw = fixture_bytes(name)
+    result = clf.classify_blobs([raw])[0]
+    got = clf.attribution_for(raw, os.path.basename(name), result)
+    want = LicenseFile(raw, os.path.basename(name)).attribution
+    assert got == want
+
+
+def test_attribution_on_copyright_prefiltered_row(clf):
+    """The copyright? gate needs BOTH the Copyright matcher AND a
+    copyright(.ext) filename (project_file.rb:90-95): COPYRIGHT gets the
+    line, the same content as LICENSE does not (no-license's pseudo
+    template has no [fullname])."""
+    raw = b"Copyright (c) 2024 Example Corp. All rights reserved.\n"
+    result = clf.classify_blobs([raw])[0]
+    assert result.matcher == "copyright"
+    got = clf.attribution_for(raw, "COPYRIGHT", result)
+    assert got is not None and "Example Corp" in got
+    assert clf.attribution_for(raw, "COPYRIGHT.txt", result) is not None
+    assert clf.attribution_for(raw, "LICENSE", result) is None
+
+
+def test_attribution_absent_without_fullname_field(clf):
+    # unmatched rows never report attribution
+    raw = b"just some prose that matches nothing"
+    result = clf.classify_blobs([raw])[0]
+    assert clf.attribution_for(raw, "LICENSE", result) is None
+
+
+def test_attribution_pipeline_rows_and_dedupe(tmp_path):
+    mit = fixture_bytes("mit/LICENSE.txt")
+    paths = []
+    for i in range(4):
+        d = tmp_path / f"r{i}"
+        d.mkdir()
+        p = d / "LICENSE"
+        p.write_bytes(mit)
+        paths.append(str(p))
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(
+        paths, batch_size=1, workers=1, inflight=1, attribution=True
+    )
+    stats = project.run(str(out), resume=False)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert all(
+        r["attribution"] == "Copyright (c) 2016 Ben Balter" for r in rows
+    )
+    # cache hits reuse the stored attribution (computed once per unique
+    # content) — and the cached snapshot carries it
+    assert stats.dedupe_hits >= 1
+    for cached in project._dedupe_cache.values():
+        assert cached.attribution == "Copyright (c) 2016 Ben Balter"
+
+
+def test_attribution_dedupe_key_carries_copyright_gate(tmp_path):
+    """Identical bytes under COPYRIGHT vs LICENSE names attribute
+    differently (the copyright? filename gate) — the dedupe cache must
+    not share a slot across that gate, in either insertion order."""
+    raw = b"Copyright (c) 2024 Example Corp. All rights reserved.\n"
+    for order in (["COPYRIGHT", "LICENSE"], ["LICENSE", "COPYRIGHT"]):
+        base = tmp_path / "-".join(order)
+        base.mkdir()
+        paths = []
+        for i, name in enumerate(order * 2):
+            d = base / f"r{i}"
+            d.mkdir()
+            (d / name).write_bytes(raw)
+            paths.append(str(d / name))
+        rows_by_dedupe = {}
+        for dedupe in (True, False):
+            out = base / f"out-{dedupe}.jsonl"
+            project = BatchProject(
+                paths,
+                batch_size=1,
+                workers=1,
+                inflight=1,
+                attribution=True,
+                dedupe=dedupe,
+            )
+            project.run(str(out), resume=False)
+            rows_by_dedupe[dedupe] = [
+                {k: v for k, v in json.loads(line).items() if k != "path"}
+                for line in out.read_text().splitlines()
+            ]
+        assert rows_by_dedupe[True] == rows_by_dedupe[False], order
+        for row, name in zip(rows_by_dedupe[True], order * 2):
+            assert ("attribution" in row) == (name == "COPYRIGHT"), order
+
+
+def test_attribution_off_by_default(tmp_path):
+    p = tmp_path / "LICENSE"
+    p.write_bytes(fixture_bytes("mit/LICENSE.txt"))
+    project = BatchProject([str(p)], batch_size=4)
+    out = tmp_path / "out.jsonl"
+    project.run(str(out), resume=False)
+    row = json.loads(out.read_text().splitlines()[0])
+    assert "attribution" not in row
+
+
+def test_attribution_readme_route_scans_extracted_section(tmp_path):
+    readme = (
+        b"# Project\n\nCopyright (c) 1999 Wrong Section\n\n"
+        b"## License\n\n" + fixture_bytes("mit/LICENSE.txt")
+    )
+    (tmp_path / "README.md").write_bytes(readme)
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(
+        [str(tmp_path / "README.md")],
+        batch_size=4,
+        mode="auto",
+        attribution=True,
+    )
+    project.run(str(out), resume=False)
+    row = json.loads(out.read_text().splitlines()[0])
+    assert row["key"] == "mit"
+    # the line comes from the extracted License section, not the README
+    # preamble (project.rb:74-80 builds the ReadmeFile from the section)
+    assert row["attribution"] == "Copyright (c) 2016 Ben Balter"
+
+
+def test_cli_batch_detect_attribution(tmp_path, capsys):
+    from licensee_tpu.cli.main import main
+
+    (tmp_path / "LICENSE").write_bytes(fixture_bytes("mit/LICENSE.txt"))
+    manifest = tmp_path / "manifest.txt"
+    manifest.write_text(f"{tmp_path / 'LICENSE'}\n")
+    assert main(["batch-detect", str(manifest), "--attribution"]) == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert row["attribution"] == "Copyright (c) 2016 Ben Balter"
