@@ -158,6 +158,15 @@ class NativePipeline:
         lib.pipe_exact_hash.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint8),
         ]
+        lib.pipe_refscan_new.restype = ctypes.c_void_p
+        lib.pipe_refscan_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.pipe_refscan_del.argtypes = [ctypes.c_void_p]
+        lib.pipe_refscan_min.restype = ctypes.c_int
+        lib.pipe_refscan_min.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
         lib.pipe_featurize_raw.restype = ctypes.c_int
         lib.pipe_featurize_raw.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
@@ -341,6 +350,27 @@ class NativePipeline:
             status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
         )
         return status
+
+    def refscan_new(self, pattern: re.Pattern, extra_flags: str = ""):
+        """Compile a scan union (named groups ``g<i>``) with PCRE2+JIT.
+
+        Default byte mode is the faithful twin of the repo's rb()
+        patterns (re.A: ASCII-only \\b/\\w/case folding — in UTF-8 every
+        non-ASCII byte is non-word, exactly like re.A's treatment of
+        non-ASCII characters).  ``extra_flags``: 'u' switches to
+        PCRE2_UTF|PCRE2_UCP Unicode semantics — NOT what rb() patterns
+        mean; only for patterns compiled without re.A.  Returns an
+        opaque handle, or None if PCRE2 rejects the pattern (caller
+        keeps the pure-Python scan)."""
+        data = _pcre_pattern(pattern)
+        flags = (_flags_str(pattern) + extra_flags).encode()
+        return self._lib.pipe_refscan_new(data, len(data), flags) or None
+
+    def refscan_min(self, handle, section: str) -> int:
+        """Min named-group pool index over every scan hit; -1 no hit,
+        -2 PCRE2 resource/UTF failure (caller falls back to Python)."""
+        data = section.encode("utf-8")
+        return self._lib.pipe_refscan_min(handle, data, len(data))
 
     def exact_hash(self, wordset) -> bytes:
         """The 16-byte hash pipe_featurize computes, for a Python-side

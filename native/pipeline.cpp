@@ -54,6 +54,7 @@ int pcre2_substitute_8(const pcre2_code *, const uint8_t *, size_t, size_t,
                        size_t, uint8_t *, size_t *);
 size_t *pcre2_get_ovector_pointer_8(pcre2_match_data *);
 void pcre2_get_error_message_8(int, uint8_t *, size_t);
+int pcre2_pattern_info_8(const pcre2_code *, uint32_t, void *);
 }
 
 static const uint32_t kCaseless = 0x00000008u;     // PCRE2_CASELESS
@@ -64,6 +65,8 @@ static const uint32_t kSubGlobal = 0x00000100u;    // PCRE2_SUBSTITUTE_GLOBAL
 static const uint32_t kSubOverflow = 0x00001000u;  // ..._OVERFLOW_LENGTH
 static const uint32_t kJitComplete = 0x00000001u;  // PCRE2_JIT_COMPLETE
 static const uint32_t kNoJit = 0x00002000u;        // PCRE2_NO_JIT
+static const uint32_t kUtf = 0x00080000u;          // PCRE2_UTF
+static const uint32_t kUcp = 0x00020000u;          // PCRE2_UCP
 static const int kNoMatch = -1;                    // PCRE2_ERROR_NOMATCH
 static const int kNoMemory = -48;                  // PCRE2_ERROR_NOMEMORY
 
@@ -82,6 +85,11 @@ struct Pat {
       if (f == 'i') options |= kCaseless;
       if (f == 's') options |= kDotall;
       if (f == 'x') options |= kExtended;
+      // 'u': full Unicode semantics (\b, case folding).  NOTE: the
+      // repo's rb() patterns are re.A (ASCII classes), whose faithful
+      // PCRE2 twin is the DEFAULT byte mode — 'u' exists only for
+      // patterns compiled without re.A.
+      if (f == 'u') options |= kUtf | kUcp;
     }
     int errcode = 0;
     size_t erroff = 0;
@@ -719,6 +727,88 @@ void pipe_featurize_batch(void *handle, void *vocab_handle,
         meta_out + static_cast<size_t>(i) * 3,
         hash_out + static_cast<size_t>(i) * 16));
   }
+}
+
+// ---------------------------------------------------------------------------
+// Reference-matcher union scan (matchers/reference.rb:7-11 at batch scale)
+//
+// One JIT-compiled alternation of every license's title|source pattern,
+// each wrapped in a named group "g<pool-index>".  pipe_refscan_min walks
+// every scan hit of a section and returns the MINIMUM pool index seen —
+// the floor the Python side resolves exactly (it re-checks the few
+// licenses below the floor with their own regexes, because a hit lying
+// strictly inside another alternative's span is shadowed in a scan).
+
+struct RefScan {
+  Pat pat;
+  pcre2_match_data *md = nullptr;
+  std::vector<int> group_pool;  // capture-group number -> pool index (-1)
+  ~RefScan() {
+    if (md) pcre2_match_data_free_8(md);
+  }
+};
+
+static const uint32_t kInfoCaptureCount = 4;   // PCRE2_INFO_CAPTURECOUNT
+static const uint32_t kInfoNameCount = 17;     // PCRE2_INFO_NAMECOUNT
+static const uint32_t kInfoNameEntrySize = 18; // PCRE2_INFO_NAMEENTRYSIZE
+static const uint32_t kInfoNameTable = 19;     // PCRE2_INFO_NAMETABLE
+
+void *pipe_refscan_new(const char *pattern, size_t len, const char *flags) {
+  auto *rs = new RefScan();
+  std::string err;
+  if (!rs->pat.compile(std::string(pattern, len), flags ? flags : "",
+                       &err)) {
+    delete rs;
+    return nullptr;
+  }
+  uint32_t cap = 0, namecount = 0, entsize = 0;
+  const uint8_t *table = nullptr;
+  pcre2_pattern_info_8(rs->pat.code, kInfoCaptureCount, &cap);
+  pcre2_pattern_info_8(rs->pat.code, kInfoNameCount, &namecount);
+  pcre2_pattern_info_8(rs->pat.code, kInfoNameEntrySize, &entsize);
+  pcre2_pattern_info_8(rs->pat.code, kInfoNameTable, &table);
+  rs->md = pcre2_match_data_create_8(cap + 1, nullptr);
+  rs->group_pool.assign(cap + 1, -1);
+  for (uint32_t i = 0; i < namecount && table; ++i) {
+    const uint8_t *e = table + static_cast<size_t>(i) * entsize;
+    uint32_t num = (static_cast<uint32_t>(e[0]) << 8) | e[1];  // big-endian
+    const char *name = reinterpret_cast<const char *>(e + 2);
+    if (name[0] == 'g' && num < rs->group_pool.size())
+      rs->group_pool[num] = std::atoi(name + 1);
+  }
+  return rs;
+}
+
+void pipe_refscan_del(void *h) { delete static_cast<RefScan *>(h); }
+
+// Returns the min pool index over all hits, -1 for no hit, -2 on a PCRE2
+// resource failure (the caller fails the section over to the Python
+// chain rather than silently diverging).
+int pipe_refscan_min(void *h, const char *data, size_t len) {
+  auto *rs = static_cast<RefScan *>(h);
+  const uint8_t *subj = reinterpret_cast<const uint8_t *>(data);
+  const size_t kUnset = ~static_cast<size_t>(0);  // PCRE2_UNSET
+  size_t off = 0;
+  int best = -1;
+  while (off <= len) {
+    int rc = pcre2_match_8(rs->pat.code, subj, len, off, 0, rs->md, nullptr);
+    if (rc < 0 && rc != kNoMatch)
+      rc = pcre2_match_8(rs->pat.code, subj, len, off, kNoJit, rs->md,
+                         nullptr);
+    if (rc == kNoMatch) break;
+    if (rc < 0) return -2;
+    size_t *ov = pcre2_get_ovector_pointer_8(rs->md);
+    // exactly one alternative (named group) participates per hit
+    for (size_t n = 1; n < rs->group_pool.size(); ++n) {
+      if (rs->group_pool[n] < 0 || ov[2 * n] == kUnset) continue;
+      if (best < 0 || rs->group_pool[n] < best) best = rs->group_pool[n];
+      break;
+    }
+    if (best == 0) return 0;  // nothing can beat pool index 0
+    size_t end = ov[1];
+    off = end > off ? end : off + 1;  // never stall on an empty match
+  }
+  return best;
 }
 
 // Hash a '\0'-joined unique-token blob (Python-side template wordsets, any

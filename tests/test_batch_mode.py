@@ -116,6 +116,60 @@ def test_readme_html_converted_before_extraction(readme_clf):
     assert results[0].key is None
 
 
+def test_reference_match_union_agrees_with_naive_chain(monkeypatch):
+    """The batched union fast path must answer EXACTLY like the naive
+    first-in-pool-order chain (matchers/reference.rb:7-11) — including
+    shadow cases where an early-pool license's only hit lies inside
+    another alternative's matched span, and non-ASCII adjacency where
+    rb()'s re.A word boundaries differ from Unicode ones ('MITライセンス'
+    is the standard Japanese README phrasing: ASCII \\b sees a boundary
+    before 'ラ', Unicode \\b does not).  Both the native-PCRE2 and the
+    pure-Python scan paths are pinned."""
+    import licensee_tpu.kernels.batch as batch_mod
+    from licensee_tpu.corpus.license import License
+
+    def naive(section):
+        for lic in License.all(hidden=True, pseudo=False):
+            if lic.reference_regex.search(section):
+                return lic
+        return None
+
+    pool = License.all(hidden=True, pseudo=False)
+    sections = []
+    for lic in pool:
+        sections.append(f"Licensed under the {lic.name}.")
+        if lic.meta.source:
+            sections.append(f"See {lic.meta.source} for details.")
+    sections += [
+        "",
+        "no license mentioned here at all",
+        "see the LICENSE file",
+        "GNU Affero General Public License v3.0",
+        "GNU General Public License as published by the FSF",
+        "dual-licensed: MIT License or Apache License 2.0",
+        "the gnu lesser general public license, version 2.1 only",
+        "BSD 3-Clause Clear License",
+        "Creative Commons Attribution Share Alike 4.0 International",
+        "MITライセンス",
+        "ライセンスはMIT Licenseです",
+        "über die Apache License 2.0 lizenziert",
+        "KMIT License",  # Kelvin sign abutting the title
+    ]
+    paths = [None]  # the pure-Python union scan
+    if batch_mod._refscan_native() is not None:
+        paths.append(batch_mod._refscan_native())
+    for path in paths:
+        monkeypatch.setattr(
+            batch_mod, "_refscan_native", lambda p=path: p
+        )
+        for s in sections:
+            got = BatchClassifier._reference_match(s)
+            want = naive(s)
+            assert (got.key if got else None) == (
+                want.key if want else None
+            ), (s, "native" if path else "python")
+
+
 # -- package mode --
 
 
