@@ -68,6 +68,21 @@ the MXU variant's VMEM arithmetic then beats the HBM round-trip by
 construction.  The device is >99% idle against the host featurizer
 either way (see bench.py end_to_end), so the end-to-end number does
 not move with this choice.
+
+ADR addendum (round 4) — TP at full SPDX width does not pay
+-----------------------------------------------------------
+Measured single-chip at T=608 (bench.py bench_tp_width, v5e-1,
+2026-07-30): slicing the lane axis in half — exactly the per-chip
+shape of a TP=2 model-axis shard (parallel/mesh.py:127-167) — lifts
+matmul only 1.08x (8.41 -> 9.08 M/s; popcount 1.31x), and a real TP=2
+pays an ICI psum on top.  So the T=608-vs-T=47 gap (8.6 vs 34.5 M/s)
+is NOT the 32x unpack's HBM round-trip: it is template-axis MXU
+compute — 12.9x more (blob, template) pairs for a ~4x rate drop, i.e.
+MXU utilization actually rises with T.  Model-axis sharding therefore
+cannot recover the full-width rate; it remains an HBM-capacity lever
+(T x V matrices that outgrow one chip), while throughput scales with
+DP over the data axis.  The earlier attribution of the gap to the
+unpack (round-3 ADR draft) is corrected by this measurement.
 """
 
 from __future__ import annotations

@@ -741,11 +741,8 @@ void pipe_featurize_batch(void *handle, void *vocab_handle,
 
 struct RefScan {
   Pat pat;
-  pcre2_match_data *md = nullptr;
+  uint32_t capture_count = 0;
   std::vector<int> group_pool;  // capture-group number -> pool index (-1)
-  ~RefScan() {
-    if (md) pcre2_match_data_free_8(md);
-  }
 };
 
 static const uint32_t kInfoCaptureCount = 4;   // PCRE2_INFO_CAPTURECOUNT
@@ -767,7 +764,7 @@ void *pipe_refscan_new(const char *pattern, size_t len, const char *flags) {
   pcre2_pattern_info_8(rs->pat.code, kInfoNameCount, &namecount);
   pcre2_pattern_info_8(rs->pat.code, kInfoNameEntrySize, &entsize);
   pcre2_pattern_info_8(rs->pat.code, kInfoNameTable, &table);
-  rs->md = pcre2_match_data_create_8(cap + 1, nullptr);
+  rs->capture_count = cap;
   rs->group_pool.assign(cap + 1, -1);
   for (uint32_t i = 0; i < namecount && table; ++i) {
     const uint8_t *e = table + static_cast<size_t>(i) * entsize;
@@ -788,26 +785,36 @@ int pipe_refscan_min(void *h, const char *data, size_t len) {
   auto *rs = static_cast<RefScan *>(h);
   const uint8_t *subj = reinterpret_cast<const uint8_t *>(data);
   const size_t kUnset = ~static_cast<size_t>(0);  // PCRE2_UNSET
+  // per-call match data: the handle is process-global (one per union)
+  // and callers may scan from several threads — pcre2_match on a shared
+  // match_data is undefined behavior, and a torn ovector could surface
+  // as a silent no-hit
+  pcre2_match_data *md = pcre2_match_data_create_8(rs->capture_count + 1,
+                                                   nullptr);
+  if (!md) return -2;
   size_t off = 0;
   int best = -1;
   while (off <= len) {
-    int rc = pcre2_match_8(rs->pat.code, subj, len, off, 0, rs->md, nullptr);
+    int rc = pcre2_match_8(rs->pat.code, subj, len, off, 0, md, nullptr);
     if (rc < 0 && rc != kNoMatch)
-      rc = pcre2_match_8(rs->pat.code, subj, len, off, kNoJit, rs->md,
-                         nullptr);
+      rc = pcre2_match_8(rs->pat.code, subj, len, off, kNoJit, md, nullptr);
     if (rc == kNoMatch) break;
-    if (rc < 0) return -2;
-    size_t *ov = pcre2_get_ovector_pointer_8(rs->md);
+    if (rc < 0) {
+      pcre2_match_data_free_8(md);
+      return -2;
+    }
+    size_t *ov = pcre2_get_ovector_pointer_8(md);
     // exactly one alternative (named group) participates per hit
     for (size_t n = 1; n < rs->group_pool.size(); ++n) {
       if (rs->group_pool[n] < 0 || ov[2 * n] == kUnset) continue;
       if (best < 0 || rs->group_pool[n] < best) best = rs->group_pool[n];
       break;
     }
-    if (best == 0) return 0;  // nothing can beat pool index 0
+    if (best == 0) break;  // nothing can beat pool index 0
     size_t end = ov[1];
     off = end > off ? end : off + 1;  // never stall on an empty match
   }
+  pcre2_match_data_free_8(md);
   return best;
 }
 

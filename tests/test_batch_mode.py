@@ -170,6 +170,31 @@ def test_reference_match_union_agrees_with_naive_chain(monkeypatch):
             ), (s, "native" if path else "python")
 
 
+def test_reference_match_thread_safe():
+    """The process-global refscan handle must serve concurrent scans:
+    pipe_refscan_min allocates per-call match data, so parallel
+    classify_blobs callers cannot tear each other's ovectors."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    sections = [
+        "Released under the MIT License.",
+        "see the LICENSE file",
+        "GNU Affero General Public License v3.0",
+        "Licensed under the Apache License 2.0.",
+        "no license mentioned here at all " * 20,
+        "BSD 3-Clause Clear License",
+    ] * 40
+
+    def key(s):
+        lic = BatchClassifier._reference_match(s)
+        return lic.key if lic else None
+
+    want = [key(s) for s in sections]
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        got = list(pool.map(key, sections))
+    assert got == want
+
+
 # -- package mode --
 
 
