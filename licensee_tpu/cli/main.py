@@ -327,6 +327,7 @@ def cmd_batch_detect(args) -> int:
             threshold=args.confidence,
             closest=args.closest,
             attribution=args.attribution,
+            featurize_procs=args.featurize_procs,
             **kwargs,
         )
     except OSError as exc:
@@ -531,6 +532,15 @@ def build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--batch-size", type=int, default=4096)
     batch.add_argument("--workers", type=int, default=None,
                        help="Featurization worker threads (default: cpu count)")
+    batch.add_argument(
+        "--featurize-procs", type=int, default=0, metavar="N",
+        help=(
+            "Featurize in N worker PROCESSES instead of threads (GIL "
+            "insurance for hosts where the native pipeline is absent and "
+            "thread scaling disappoints; bit-identical output, resume "
+            "unchanged).  Threads win when the native pipeline is up"
+        ),
+    )
     batch.add_argument("--stats", action="store_true",
                        help="Print run stats + per-stage timers to stderr")
     batch.add_argument("--profile", default=None, metavar="DIR",

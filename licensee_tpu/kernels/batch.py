@@ -160,9 +160,8 @@ class BatchClassifier:
         mesh="auto",
         mode: str = "license",
         closest: int = 0,
+        device: bool = True,
     ):
-        from licensee_tpu.kernels.dice_xla import CorpusArrays, make_best_match_fn
-
         if mode not in ("license", "readme", "package", "auto"):
             raise ValueError(f"unknown mode {mode!r}")
         self.mode = mode
@@ -203,6 +202,22 @@ class BatchClassifier:
             method = "popcount" if self.corpus.n_templates <= 128 else "matmul"
         self.method = method
         self.pad_batch_to = pad_batch_to
+        if not device:
+            # host-only twin for featurize worker PROCESSES
+            # (--featurize-procs): prepare_batch works in full, but no
+            # jax is touched — the worker never initializes a backend,
+            # so it cannot contend for the TPU.  dispatch_chunks raises.
+            self.mesh = None
+            self._fn = None
+            self.arrays = None
+            self._exact_map = self.corpus.exact_sets
+            self._init_native()
+            return
+        from licensee_tpu.kernels.dice_xla import (
+            CorpusArrays,
+            make_best_match_fn,
+        )
+
         self.arrays = CorpusArrays.from_compiled(self.corpus)
         # Scale-out is the default product path (SURVEY.md §2.7 DP row):
         # with >1 visible device the scorer is jitted over a
@@ -843,6 +858,11 @@ class BatchClassifier:
         chunks.  The returned device outputs are lazy (JAX dispatch is
         asynchronous): the host featurizes the next batch while the device
         scores this one; finish_chunks() synchronizes."""
+        if prepared.todo and self._fn is None:
+            raise RuntimeError(
+                "device=False classifier cannot dispatch (featurize "
+                "workers only prepare batches)"
+            )
         bits, n_words, lengths, cc_fp, todo = (
             prepared.bits,
             prepared.n_words,

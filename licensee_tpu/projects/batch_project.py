@@ -67,6 +67,17 @@ _IN_BATCH_DUP = BlobResult(None, None, 0.0, error="in_batch_dup_unresolved")
 _UNROUTED = BlobResult(None, None, 0.0)
 
 
+def _read_capped(path: str) -> bytes | None:
+    """Read at most 64 KiB — the MAX_LICENSE_SIZE cap (git_project.rb:53);
+    None on any OS error (the caller reports a read_error row).  The one
+    read policy for every ingestion path."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(64 * 1024)
+    except OSError:
+        return None
+
+
 @functools.lru_cache(maxsize=4096)
 def _json_str(s: str | None) -> str:
     """json.dumps memoized per distinct value: keys and matcher names
@@ -97,6 +108,155 @@ def _jsonl_row(path: str, result, error: str | None) -> str:
     if error is not None:
         row += f', "error": {json.dumps(error)}'
     return row + "}"
+
+
+def _produce_batch(
+    classifier, chunk, mode, dedupe, attribution, cache=None
+):
+    """The produce stage, shared by the thread path (live ``cache``) and
+    the worker-process path (``cache=None`` — the cross-batch cache
+    lives in the parent, which applies it on receipt).
+
+    In auto mode the filename routes FIRST: a manifest entry no score
+    table claims skips the read, the hash, and the device entirely — on
+    a 50M mixed manifest the unrecognized majority costs one regex scan
+    of the basename and nothing else."""
+    import hashlib
+
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    filenames = [os.path.basename(p) for p in chunk]
+    routes: list | None = None
+    if mode == "auto":
+        routes = [BatchClassifier.route_for(f) for f in filenames]
+    t0 = time.perf_counter()
+    contents = [
+        _read_capped(p)
+        if routes is None or routes[i] is not None
+        else b""
+        for i, p in enumerate(chunk)
+    ]
+    t1 = time.perf_counter()
+    keys: list = [None] * len(chunk)
+    preset: list = [None] * len(chunk)
+    dup_of: dict[int, int] = {}
+    if routes is not None:
+        for i, route in enumerate(routes):
+            if route is None:
+                preset[i] = _UNROUTED
+    if dedupe:
+        first_seen: dict = {}
+        for i, c in enumerate(contents):
+            if c is None or preset[i] is not None:
+                continue
+            route = routes[i] if routes is not None else mode
+            # package: the whole matcher table reads the filename;
+            # license/readme: only the HTML gate does.  The route is
+            # part of the key, so a mixed manifest never shares a
+            # cached result across chains.  With --attribution on, the
+            # copyright? filename gate (project_file.rb:94) also feeds
+            # the result, so its bit joins the key — COPYRIGHT and
+            # LICENSE holding identical bytes attribute differently and
+            # must not share a cache slot.
+            if route == "package":
+                dispatch = (route, filenames[i])
+            else:
+                dispatch = (route, BatchClassifier._is_html(filenames[i]))
+                if attribution:
+                    from licensee_tpu.project_files.license_file import (
+                        COPYRIGHT_NAME_REGEX,
+                    )
+
+                    dispatch += (
+                        bool(COPYRIGHT_NAME_REGEX.search(filenames[i])),
+                    )
+            # usedforsecurity=False: a cache key, not crypto — and
+            # FIPS-mode OpenSSL would otherwise refuse sha1 entirely
+            keys[i] = (
+                dispatch,
+                hashlib.sha1(c, usedforsecurity=False).digest(),
+            )
+            if cache is not None:
+                preset[i] = cache.get(keys[i])
+            if preset[i] is None:
+                # in-batch dedupe: repeats of a key first seen in THIS
+                # batch are featurized/scored once and copied after
+                # finish (no cross-batch pipeline lag)
+                j = first_seen.setdefault(keys[i], i)
+                if j != i:
+                    dup_of[i] = j
+                    preset[i] = _IN_BATCH_DUP
+    prepared = classifier.prepare_batch(
+        [c if c is not None else b"" for c in contents],
+        filenames=filenames,
+        preset=preset,
+        routes=routes,
+    )
+    t2 = time.perf_counter()
+    read_errs = [c is None for c in contents]
+    if attribution:
+        # keep raw contents ONLY for rows that can still need the
+        # attribution regex (license/readme route, not already finished
+        # as unmatched, not a preset/dup row) — in process mode every
+        # kept row is pickled parent-ward, up to 64 KiB each
+        kept = []
+        for i, c in enumerate(contents):
+            route = routes[i] if routes is not None else mode
+            r = prepared.results[i]
+            need = (
+                route in ("license", "readme")
+                and preset[i] is None
+                and (r is None or (r.key is not None and not r.error))
+            )
+            kept.append(c if need else None)
+        contents = kept
+    return (
+        read_errs, keys, preset, dup_of, routes, prepared,
+        contents if attribution else None,
+        (t1 - t0, t2 - t1),
+    )
+
+
+# -- process-pool featurization (--featurize-procs) --
+#
+# GIL insurance: the thread pipeline's scaling argument rests on the
+# native batch crossing dropping the GIL; on hosts where that
+# disappoints (or the pure-Python fallback pipeline runs), worker
+# PROCESSES featurize instead.  Workers build a host-only classifier
+# (device=False — no jax backend init, no TPU contention) from the
+# parent's pickled CompiledCorpus; batches come back as plain numpy +
+# dataclasses.  The cross-batch dedupe cache stays in the parent and is
+# applied on receipt: a cache-hit row still pays worker featurization
+# (the price of process isolation) but skips device scoring.  Output is
+# bit-identical to the thread path; the resume invariant (in-order
+# writes) is untouched because only the produce stage moves.
+#
+# Crossover guidance: spawn + per-worker corpus build costs seconds up
+# front and each batch pays ~2 MB of array pickling (plus, with
+# --attribution, the raw bytes of rows still in the running for the
+# attribution regex — up to 64 KiB each, trimmed in _produce_batch);
+# threads win whenever the native pipeline is up (its crossing releases
+# the GIL), processes win on the pure-Python pipeline beyond ~2 cores.
+
+_MP_STATE: dict = {}
+
+
+def _mp_init(corpus, mode, batch_size):
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    _MP_STATE["clf"] = BatchClassifier(
+        corpus=corpus,
+        mode=mode,
+        pad_batch_to=batch_size,
+        mesh=None,
+        device=False,
+    )
+
+
+def _mp_produce(chunk, mode, dedupe, attribution):
+    return (chunk, *_produce_batch(
+        _MP_STATE["clf"], chunk, mode, dedupe, attribution, cache=None
+    ))
 
 
 @dataclass
@@ -162,6 +322,7 @@ class BatchProject:
         dedupe_cap: int = 1 << 20,
         closest: int = 0,
         attribution: bool = False,
+        featurize_procs: int = 0,
         already_striped: bool = False,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
@@ -233,6 +394,9 @@ class BatchProject:
         # (post-match host regex; with dedupe, once per unique content).
         # Raw contents ride the pipeline tuples only when enabled.
         self.attribution = attribution
+        # --featurize-procs N: produce batches in N worker PROCESSES
+        # instead of threads (see the _mp_* machinery above)
+        self.featurize_procs = int(featurize_procs or 0)
 
     @classmethod
     def from_manifest_file(cls, manifest_file: str, **kwargs) -> "BatchProject":
@@ -288,11 +452,7 @@ class BatchProject:
         return cls(paths, **kwargs)
 
     def _read(self, path: str) -> bytes | None:
-        try:
-            with open(path, "rb") as f:
-                return f.read(64 * 1024)  # MAX_LICENSE_SIZE cap (git_project.rb:53)
-        except OSError:
-            return None
+        return _read_capped(path)
 
     @staticmethod
     def _resume_point(output: str) -> int:
@@ -319,94 +479,17 @@ class BatchProject:
 
     def _produce(self, start: int):
         """Worker-thread stage: route + read + dedupe + prefilter +
-        featurize.  In auto mode the filename routes FIRST: a manifest
-        entry no score table claims skips the read, the hash, and the
-        device entirely — on a 50M mixed manifest the unrecognized
-        majority costs one regex scan of the basename and nothing else."""
-        import hashlib
-
-        from licensee_tpu.kernels.batch import BatchClassifier
-
+        featurize (the shared ``_produce_batch`` core, with the live
+        cross-batch dedupe cache)."""
         chunk = self.paths[start : start + self.batch_size]
-        filenames = [os.path.basename(p) for p in chunk]
-        routes: list | None = None
-        if self.mode == "auto":
-            routes = [BatchClassifier.route_for(f) for f in filenames]
-        t0 = time.perf_counter()
-        contents = [
-            self._read(p)
-            if routes is None or routes[i] is not None
-            else b""
-            for i, p in enumerate(chunk)
-        ]
-        t1 = time.perf_counter()
-        keys: list = [None] * len(chunk)
-        preset: list = [None] * len(chunk)
-        dup_of: dict[int, int] = {}
-        if routes is not None:
-            for i, route in enumerate(routes):
-                if route is None:
-                    preset[i] = _UNROUTED
-        if self.dedupe:
-            cache = self._dedupe_cache
-            first_seen: dict = {}
-            for i, c in enumerate(contents):
-                if c is None or preset[i] is not None:
-                    continue
-                route = routes[i] if routes is not None else self.mode
-                # package: the whole matcher table reads the filename;
-                # license/readme: only the HTML gate does.  The route is
-                # part of the key, so a mixed manifest never shares a
-                # cached result across chains.  With --attribution on,
-                # the copyright? filename gate (project_file.rb:94) also
-                # feeds the result, so its bit joins the key — COPYRIGHT
-                # and LICENSE holding identical bytes attribute
-                # differently and must not share a cache slot.
-                if route == "package":
-                    dispatch = (route, filenames[i])
-                else:
-                    dispatch = (
-                        route,
-                        BatchClassifier._is_html(filenames[i]),
-                    )
-                    if self.attribution:
-                        from licensee_tpu.project_files.license_file import (
-                            COPYRIGHT_NAME_REGEX,
-                        )
-
-                        dispatch += (
-                            bool(
-                                COPYRIGHT_NAME_REGEX.search(filenames[i])
-                            ),
-                        )
-                # usedforsecurity=False: a cache key, not crypto — and
-                # FIPS-mode OpenSSL would otherwise refuse sha1 entirely
-                keys[i] = (
-                    dispatch,
-                    hashlib.sha1(c, usedforsecurity=False).digest(),
-                )
-                preset[i] = cache.get(keys[i])
-                if preset[i] is None:
-                    # in-batch dedupe: repeats of a key first seen in THIS
-                    # batch are featurized/scored once and copied after
-                    # finish (no cross-batch pipeline lag)
-                    j = first_seen.setdefault(keys[i], i)
-                    if j != i:
-                        dup_of[i] = j
-                        preset[i] = _IN_BATCH_DUP
-        prepared = self.classifier.prepare_batch(
-            [c if c is not None else b"" for c in contents],
-            filenames=filenames,
-            preset=preset,
-            routes=routes,
-        )
-        t2 = time.perf_counter()
-        read_errs = [c is None for c in contents]
-        return (
-            chunk, read_errs, keys, preset, dup_of, routes, prepared,
-            contents if self.attribution else None,
-            (t1 - t0, t2 - t1),
-        )
+        return (chunk, *_produce_batch(
+            self.classifier,
+            chunk,
+            self.mode,
+            self.dedupe,
+            self.attribution,
+            cache=self._dedupe_cache if self.dedupe else None,
+        ))
 
     def _dispatch(self, prepared):
         """Main-thread stage: launch device scoring (asynchronous)."""
@@ -435,14 +518,42 @@ class BatchProject:
 
         starts = deque(range(done, len(self.paths), self.batch_size))
         t_run = time.perf_counter()
-        with ThreadPoolExecutor(max_workers=self.workers) as pool, open(
-            output, mode, encoding="utf-8"
-        ) as out:
+        use_procs = self.featurize_procs > 0
+        if use_procs:
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+
+            # spawn, not fork: the parent holds a live TPU backend and
+            # forked XLA runtime threads are undefined behavior; spawned
+            # workers build a device=False classifier and never
+            # initialize a backend at all
+            pool = ProcessPoolExecutor(
+                max_workers=self.featurize_procs,
+                mp_context=multiprocessing.get_context("spawn"),
+                initializer=_mp_init,
+                initargs=(self.classifier.corpus, self.mode, self.batch_size),
+            )
+        else:
+            pool = ThreadPoolExecutor(max_workers=self.workers)
+        with pool, open(output, mode, encoding="utf-8") as out:
             futures: deque = deque()
 
             def submit_next() -> None:
-                if starts:
-                    futures.append(pool.submit(self._produce, starts.popleft()))
+                if not starts:
+                    return
+                start = starts.popleft()
+                if use_procs:
+                    futures.append(
+                        pool.submit(
+                            _mp_produce,
+                            self.paths[start : start + self.batch_size],
+                            self.mode,
+                            self.dedupe,
+                            self.attribution,
+                        )
+                    )
+                else:
+                    futures.append(pool.submit(self._produce, start))
 
             for _ in range(self.inflight):
                 submit_next()
@@ -457,6 +568,25 @@ class BatchProject:
                     submit_next()
                     self.stats.add_stage("read", t_read)
                     self.stats.add_stage("featurize", t_feat)
+                    if use_procs and self.dedupe:
+                        # the cross-batch cache lives here in the parent:
+                        # hit rows (featurized in vain by the worker —
+                        # the price of process isolation) skip the device
+                        cache = self._dedupe_cache
+                        hit = False
+                        for i, k in enumerate(keys):
+                            if k is not None and preset[i] is None:
+                                cached = cache.get(k)
+                                if cached is not None:
+                                    preset[i] = cached
+                                    prepared.results[i] = cached
+                                    hit = True
+                        if hit:
+                            prepared.todo = [
+                                i
+                                for i, r in enumerate(prepared.results)
+                                if r is None
+                            ]
                     t0 = time.perf_counter()
                     device_out = self._dispatch(prepared)
                     self.stats.add_stage("dispatch", time.perf_counter() - t0)

@@ -1,0 +1,132 @@
+"""--featurize-procs: worker-PROCESS featurization (GIL insurance).
+
+The process path must be bit-identical to the thread path — same rows,
+same order, same resume behavior — with the cross-batch dedupe cache
+applied in the parent and no jax backend ever initialized in a worker
+(device=False classifier).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from licensee_tpu.kernels.batch import BatchClassifier
+from licensee_tpu.projects.batch_project import BatchProject
+from tests.conftest import fixture_path
+
+
+def fixture_bytes(name: str) -> bytes:
+    with open(fixture_path(name), "rb") as f:
+        return f.read()
+
+
+def _mixed_corpus(tmp_path, n_repos: int = 6):
+    """A small mixed tree with dups (dedupe), a near-miss (Dice+closest),
+    a package manifest, and an unrecognized file (auto routing)."""
+    mit = fixture_bytes("mit/LICENSE.txt")
+    paths = []
+    for i in range(n_repos):
+        d = tmp_path / f"repo{i}"
+        d.mkdir()
+        (d / "LICENSE").write_bytes(
+            mit if i % 2 == 0 else mit + b"\nnudged off exact\n"
+        )
+        (d / "package.json").write_text('{"license": "Apache-2.0"}\n')
+        (d / "main.c").write_text(f"int f(void) {{ return {i}; }}\n")
+        paths += [
+            str(d / "LICENSE"),
+            str(d / "package.json"),
+            str(d / "main.c"),
+        ]
+    return paths
+
+
+def _run(paths, out, **kwargs):
+    project = BatchProject(
+        paths,
+        batch_size=4,
+        workers=2,
+        inflight=2,
+        mode="auto",
+        closest=2,
+        threshold=90,
+        attribution=True,
+        **kwargs,
+    )
+    stats = project.run(str(out), resume=False)
+    return stats, out.read_text()
+
+
+@pytest.mark.slow
+def test_process_path_bit_identical_to_threads(tmp_path):
+    paths = _mixed_corpus(tmp_path)
+    _, want = _run(paths, tmp_path / "threads.jsonl")
+    stats, got = _run(paths, tmp_path / "procs.jsonl", featurize_procs=2)
+    assert got == want  # byte-identical JSONL
+    # the parent-side cache fired for the repeated contents
+    assert stats.dedupe_hits >= 1
+
+
+@pytest.mark.slow
+def test_process_path_resume(tmp_path):
+    paths = _mixed_corpus(tmp_path, n_repos=4)
+    out = tmp_path / "out.jsonl"
+    # phase 1: first half only, then a torn tail simulating a crash
+    p1 = BatchProject(
+        paths[: len(paths) // 2], batch_size=4, featurize_procs=2,
+        mode="auto",
+    )
+    p1.run(str(out), resume=False)
+    with open(out, "a", encoding="utf-8") as f:
+        f.write('{"path": "torn"')
+    # phase 2: resume over the full manifest, still in process mode
+    p2 = BatchProject(paths, batch_size=4, featurize_procs=2, mode="auto")
+    p2.run(str(out), resume=True)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["path"] for r in rows] == paths
+    # ground truth: one thread-path pass over everything
+    ref = tmp_path / "ref.jsonl"
+    BatchProject(paths, batch_size=4, mode="auto").run(
+        str(ref), resume=False
+    )
+    assert out.read_text() == ref.read_text()
+
+
+def test_device_false_classifier_prepares_but_cannot_dispatch():
+    clf = BatchClassifier(pad_batch_to=8, device=False)
+    assert clf._fn is None and clf.arrays is None
+    prepared = clf.prepare_batch(
+        [fixture_bytes("mit/LICENSE.txt"), b"some random words"],
+        filenames=["LICENSE", "LICENSE"],
+    )
+    # the exact prefilter still fires host-side
+    assert prepared.results[0].matcher == "exact"
+    assert prepared.todo == [1]
+    with pytest.raises(RuntimeError):
+        clf.dispatch_chunks(prepared)
+
+
+def test_worker_state_roundtrip():
+    """_mp_init + _mp_produce run in-process too (what each spawned
+    worker executes): the corpus object pickles, the host-only
+    classifier builds, and a produced batch carries featurized rows."""
+    import pickle
+
+    from licensee_tpu.projects import batch_project as bp
+
+    corpus = BatchClassifier(pad_batch_to=8).corpus
+    corpus = pickle.loads(pickle.dumps(corpus))  # the spawn crossing
+    bp._mp_init(corpus, "license", 8)
+    try:
+        chunk = [fixture_path("mit/LICENSE.txt")]
+        (paths, read_errs, keys, preset, dup_of, routes, prepared,
+         contents, _times) = bp._mp_produce(chunk, "license", True, False)
+        assert paths == chunk
+        assert read_errs == [False]
+        assert keys[0] is not None
+        assert prepared.results[0].matcher == "exact"
+    finally:
+        bp._MP_STATE.clear()
