@@ -2,28 +2,23 @@
 detected key, matcher name, and content hash against fixtures.yml
 (parity with spec/fixture_spec.rb)."""
 
-import os
-
 import pytest
 import yaml
 
 import licensee_tpu
 from licensee_tpu.corpus.license import License
 from licensee_tpu.projects import FSProject
-from tests.conftest import FIXTURES_DIR, fixture_path
+from tests.conftest import fixture_path
 
 with open(fixture_path("fixtures.yml"), encoding="utf-8") as f:
     FIXTURE_LICENSES = yaml.safe_load(f)
 
-# data-only fixture dirs (not project trees mirrored from spec/fixtures)
-_NON_PROJECT = {"spdx-adversarial"}
+# the single fixture-enumeration rule (sorted project dirs, data-only
+# dirs excluded) lives next to the regeneration tooling, so these tests
+# and the fixtures.yml generator can never enumerate different sets
+from licensee_tpu.corpus.vendoring import fixture_names
 
-FIXTURES = sorted(
-    name
-    for name in os.listdir(FIXTURES_DIR)
-    if os.path.isdir(os.path.join(FIXTURES_DIR, name))
-    and name not in _NON_PROJECT
-)
+FIXTURES = fixture_names()
 
 
 def project_for(fixture):
