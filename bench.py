@@ -707,6 +707,101 @@ def bench_end_to_end_1m(n_files: int = 1_000_000) -> dict:
     }
 
 
+def bench_end_to_end_1m_auto(n_files: int = 1_000_000) -> dict:
+    """Opt-in companion to bench_end_to_end_1m: the BASELINE.md config-5
+    shape — a >=1M-entry MIXED manifest (~70% source files no table
+    routes, the rest LICENSE/README/package spread) through ONE
+    `--mode auto` pass.  The unrouted majority must cost a basename
+    scan and nothing else (never read), which is exactly what this
+    measures."""
+    import os
+    import tempfile
+
+    bodies = list(_license_bodies().values())
+    rng = np.random.default_rng(5)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        # distinct files on disk; the manifest references them many times
+        src = []
+        for i in range(100):
+            p = os.path.join(tmpdir, f"mod_{i}.c")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(f"int f{i}(void) {{ return {i}; }}\n")
+            src.append(p)
+        lic = []
+        for i in range(2000):
+            body = bodies[i % len(bodies)]
+            hdr = (
+                f"Copyright (c) {1990 + i % 30} Org {i % 200}\n\n"
+                if i % 3
+                else ""
+            )
+            p = os.path.join(tmpdir, f"l{i}")
+            os.mkdir(p)
+            p = os.path.join(p, "LICENSE")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(hdr + body)
+            lic.append(p)
+        rdm = []
+        for i in range(500):
+            d = os.path.join(tmpdir, f"r{i}")
+            os.mkdir(d)
+            p = os.path.join(d, "README.md")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(
+                    f"# P{i}\n\n## License\n\n"
+                    + (
+                        "Released under the MIT License.\n"
+                        if i % 2
+                        else bodies[i % len(bodies)]
+                    )
+                )
+            rdm.append(p)
+        pkg = []
+        for i in range(500):
+            d = os.path.join(tmpdir, f"p{i}")
+            os.mkdir(d)
+            p = os.path.join(d, "package.json")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(f'{{"name": "p{i}", "license": "MIT"}}\n')
+            pkg.append(p)
+
+        entries = []
+        for pool, share in (
+            (src, 0.70), (lic, 0.12), (rdm, 0.09), (pkg, 0.09),
+        ):
+            n = int(n_files * share)
+            idx = rng.integers(0, len(pool), size=n)
+            entries.extend(pool[int(i)] for i in idx)
+        rng.shuffle(entries)
+        entries = entries[:n_files]
+
+        from licensee_tpu.kernels.batch import BatchClassifier
+        from licensee_tpu.projects.batch_project import BatchProject
+
+        classifier = BatchClassifier(pad_batch_to=8192, mode="auto")
+        classifier.classify_blobs([b"warm up"], filenames=["LICENSE"])
+        t0 = time.perf_counter()
+        project = BatchProject(
+            entries, batch_size=8192, classifier=classifier
+        )
+        stats = project.run(os.path.join(tmpdir, "out.jsonl"), resume=False)
+        elapsed = time.perf_counter() - t0
+
+    return {
+        "files": len(entries),
+        "files_per_sec": round(stats.total / elapsed, 1),
+        "routed": dict(stats.routed),
+        "dedupe_hits": stats.dedupe_hits,
+        "matched": stats.total
+        - stats.unmatched
+        - stats.read_errors
+        - stats.featurize_errors,
+        "stage_seconds": {
+            k: round(v, 3) for k, v in stats.stage_seconds.items()
+        },
+    }
+
+
 def bench_agreement(n_blobs: int = 512) -> dict:
     """Top-1 agreement between the device batch path and the scalar
     reference-semantics chain (Copyright -> Exact -> Dice) — the north
@@ -881,10 +976,14 @@ def main() -> None:
     agreement = run_safe("agreement", bench_agreement)
 
     end_to_end_1m = None
+    end_to_end_1m_auto = None
     import os as _os
 
     if _os.environ.get("LICENSEE_TPU_BENCH_1M") or "1m" in sys.argv[1:]:
         end_to_end_1m = run_safe("end_to_end_1m", bench_end_to_end_1m)
+        end_to_end_1m_auto = run_safe(
+            "end_to_end_1m_auto", bench_end_to_end_1m_auto
+        )
 
     result = {
         "metric": (
@@ -913,6 +1012,7 @@ def main() -> None:
             "tp_width": tp_width,
             "scalar_agreement": agreement,
             "end_to_end_1m": end_to_end_1m,
+            "end_to_end_1m_auto": end_to_end_1m_auto,
         },
     }
     print(json.dumps(result))
