@@ -76,10 +76,12 @@ CHILD = textwrap.dedent(
 
     with open(sys.argv[1], encoding="utf-8") as f:
         paths = [line.strip() for line in f if line.strip()]
-    project = BatchProject(paths, batch_size=4, mesh=None)
+    mode = sys.argv[3] if len(sys.argv) > 3 else "license"
+    project = BatchProject(paths, batch_size=4, mesh=None, mode=mode)
     assert project.process_index == process_index
     stats = project.run(sys.argv[2], resume=True)
-    print(json.dumps({{"rank": process_index, "total": stats.total}}))
+    print(json.dumps({{"rank": process_index, "total": stats.total,
+                       "routed": stats.routed}}))
     """
 ).format(repo=REPO)
 
@@ -90,7 +92,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_cluster(manifest: str, output: str, port: int):
+def _run_cluster(manifest: str, output: str, port: int, mode="license"):
     procs = []
     for rank in (0, 1):
         env = {
@@ -102,7 +104,7 @@ def _run_cluster(manifest: str, output: str, port: int):
         }
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", CHILD, manifest, output],
+                [sys.executable, "-c", CHILD, manifest, output, mode],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
@@ -163,6 +165,63 @@ def test_two_process_cluster_classifies_split_manifest(tmp_path):
     assert by_rank[1]["total"] == 1  # only the torn row was re-classified
     rows1b = [json.loads(l) for l in open(shard1, encoding="utf-8")]
     assert rows1b == rows1
+
+
+def test_two_process_cluster_mode_auto_mixed_manifest(tmp_path):
+    """BASELINE config 5, multi-host: a MIXED manifest stripes across two
+    processes, each routing per filename (--mode auto), shards union to
+    the single-process answer, per-route stats split per host."""
+    (tmp_path / "LICENSE").write_bytes(
+        open(fixture_path("mit/LICENSE.txt"), "rb").read()
+    )
+    (tmp_path / "package.json").write_text('{"license": "Apache-2.0"}\n')
+    (tmp_path / "README").write_bytes(
+        open(
+            fixture_path("license-with-readme-reference/README"), "rb"
+        ).read()
+    )
+    (tmp_path / "main.c").write_text("int main(void) { return 0; }\n")
+    contents = [
+        str(tmp_path / "LICENSE"),
+        str(tmp_path / "main.c"),
+        str(tmp_path / "package.json"),
+        str(tmp_path / "README"),
+        str(tmp_path / "gone.h"),  # unrouted AND missing: never read
+        str(tmp_path / "LICENSE"),
+    ]
+    manifest = tmp_path / "manifest.txt"
+    manifest.write_text("\n".join(contents) + "\n")
+    output = str(tmp_path / "out.jsonl")
+
+    stats = _run_cluster(str(manifest), output, _free_port(), mode="auto")
+    by_rank = {s["rank"]: s for s in stats}
+    assert by_rank[0]["routed"] == {"license": 1, "none": 1, "package": 1}
+    assert by_rank[1]["routed"] == {"readme": 1, "none": 1, "license": 1}
+
+    rows = []
+    for shard in (0, 1):
+        path = f"{output}.shard-0000{shard}-of-00002"
+        rows += [json.loads(l) for l in open(path, encoding="utf-8")]
+    assert [r["path"] for r in rows] == contents
+    assert [(r["key"], r["matcher"]) for r in rows] == [
+        ("mit", "exact"),
+        (None, None),
+        ("apache-2.0", "npmbower"),
+        ("mit", "reference"),
+        (None, None),
+        ("mit", "exact"),
+    ]
+    assert "error" not in rows[4]  # gone.h skipped unread on its host
+
+    # union agrees with one single-process auto pass
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    single_out = str(tmp_path / "single.jsonl")
+    BatchProject(contents, batch_size=4, mesh=None, mode="auto").run(
+        single_out, resume=False
+    )
+    single = [json.loads(l) for l in open(single_out, encoding="utf-8")]
+    assert rows == single
 
 
 def test_from_manifest_file_materializes_only_the_stripe(tmp_path):
