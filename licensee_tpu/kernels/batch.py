@@ -60,7 +60,7 @@ def _refscan_native():
     nat = native_pipeline.load()
     if nat is None:
         return None
-    _, union = _reference_union()
+    lics, union = _reference_union()
     # byte-mode PCRE2 (no UTF/UCP) IS the faithful translation of the
     # Python side: rb() compiles with re.A (Ruby's ASCII-only \b/\w),
     # and in UTF-8 every non-ASCII byte is a non-word byte — exactly
@@ -69,6 +69,10 @@ def _refscan_native():
     handle = nat.refscan_new(union)
     if handle is None:
         return None
+    # per-license patterns let the exact shadow resolution run in ONE
+    # crossing (pipe_refscan_resolve); failure just means the Python
+    # shadow loop stays in charge
+    nat.refscan_set_singles(handle, [lic.reference_regex for lic in lics])
     return nat, handle
 
 
@@ -1015,38 +1019,45 @@ class BatchClassifier:
         own Python regex; any divergence degrades to the exact
         sequential chain."""
         lics, union = _reference_union()
-        floor = None
-        nat = _refscan_native()
-        if nat is not None:
-            f = nat[0].refscan_min(nat[1], section)
-            if f == -1:
-                return None
-            if f >= 0:
-                floor = f
-            # f == -2: PCRE2 resource/UTF failure -> Python scan below
-        if floor is None:
-            for m in union.finditer(section):
-                # exactly one alternative (named group) matches per hit;
-                # groupdict preserves pattern (= pool) order, so the
-                # first non-None entry is it
-                i = next(
-                    int(name[1:])
-                    for name, val in m.groupdict().items()
-                    if val is not None
-                )
-                if floor is None or i < floor:
-                    floor = i
-                if floor == 0:
-                    break
-            if floor is None:
-                return None
-        if not lics[floor].reference_regex.search(section):
-            # scan/backtracker divergence (should not happen): fall back
-            # to the reference's own exact sequential chain
+
+        def exact_chain():
+            # the reference's own sequential chain — the last-resort
+            # answer on (never-observed) scan/backtracker divergence
             for lic in lics:
                 if lic.reference_regex.search(section):
                     return lic
             return None
+
+        nat = _refscan_native()
+        if nat is not None:
+            f = nat[0].refscan_resolve(nat[1], section)
+            if f == -1:
+                return None
+            if f >= 0:
+                # already shadow-resolved in C; one Python confirm guards
+                # the divergence case
+                if lics[f].reference_regex.search(section):
+                    return lics[f]
+                return exact_chain()
+            # f == -2: PCRE2 resource failure -> Python scan below
+        floor = None
+        for m in union.finditer(section):
+            # exactly one alternative (named group) matches per hit;
+            # groupdict preserves pattern (= pool) order, so the first
+            # non-None entry is it
+            i = next(
+                int(name[1:])
+                for name, val in m.groupdict().items()
+                if val is not None
+            )
+            if floor is None or i < floor:
+                floor = i
+            if floor == 0:
+                break
+        if floor is None:
+            return None
+        if not lics[floor].reference_regex.search(section):
+            return exact_chain()
         for i in range(floor):
             if lics[i].reference_regex.search(section):
                 return lics[i]

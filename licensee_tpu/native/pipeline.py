@@ -167,6 +167,15 @@ class NativePipeline:
         lib.pipe_refscan_min.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
         ]
+        lib.pipe_refscan_set_singles.restype = ctypes.c_int
+        lib.pipe_refscan_set_singles.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.pipe_refscan_resolve.restype = ctypes.c_int
+        lib.pipe_refscan_resolve.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
         lib.pipe_featurize_raw.restype = ctypes.c_int
         lib.pipe_featurize_raw.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
@@ -368,9 +377,38 @@ class NativePipeline:
 
     def refscan_min(self, handle, section: str) -> int:
         """Min named-group pool index over every scan hit; -1 no hit,
-        -2 PCRE2 resource/UTF failure (caller falls back to Python)."""
+        -2 PCRE2 resource failure (caller falls back to Python)."""
         data = section.encode("utf-8")
         return self._lib.pipe_refscan_min(handle, data, len(data))
+
+    def refscan_set_singles(
+        self,
+        handle,
+        patterns: list[re.Pattern],
+        extra_flags: str = "",
+    ) -> bool:
+        """Attach the per-pool-index patterns the exact resolver needs
+        (all must share one flag set); False if PCRE2 rejects any."""
+        if not patterns:
+            return False
+        flags = {_flags_str(p) for p in patterns}
+        if len(flags) != 1:
+            return False
+        blob = b"\0".join(_pcre_pattern(p) for p in patterns)
+        # the expected count makes index misalignment (an embedded NUL
+        # splitting one pattern into two) a hard failure, never a shift
+        n = self._lib.pipe_refscan_set_singles(
+            handle, blob, len(blob),
+            (flags.pop() + extra_flags).encode(), len(patterns),
+        )
+        return n == len(patterns)
+
+    def refscan_resolve(self, handle, section: str) -> int:
+        """The exact first-matching pool index (union floor + per-index
+        shadow re-checks, all in C); -1 no match, -2 fall back to the
+        Python chain."""
+        data = section.encode("utf-8")
+        return self._lib.pipe_refscan_resolve(handle, data, len(data))
 
     def exact_hash(self, wordset) -> bytes:
         """The 16-byte hash pipe_featurize computes, for a Python-side

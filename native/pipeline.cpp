@@ -28,6 +28,7 @@
 #include <cstring>
 #include <algorithm>
 #include <map>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -743,6 +744,13 @@ struct RefScan {
   Pat pat;
   uint32_t capture_count = 0;
   std::vector<int> group_pool;  // capture-group number -> pool index (-1)
+  // per-license patterns (pool order) for the exact shadow resolution:
+  // a hit inside another alternative's matched span is invisible to the
+  // union scan, so every pool index BELOW the scan floor re-checks with
+  // its own regex — in C, one JIT match each, instead of a Python loop.
+  // unique_ptr: Pat owns a raw pcre2_code* and has no move semantics, so
+  // it must never be copied by vector growth
+  std::vector<std::unique_ptr<Pat>> singles;
 };
 
 static const uint32_t kInfoCaptureCount = 4;   // PCRE2_INFO_CAPTURECOUNT
@@ -777,6 +785,61 @@ void *pipe_refscan_new(const char *pattern, size_t len, const char *flags) {
 }
 
 void pipe_refscan_del(void *h) { delete static_cast<RefScan *>(h); }
+
+// Attach the per-license patterns ('\0'-joined, pool order; `expected`
+// is the pool size).  Returns `expected` on success; -1 — with the
+// handle's singles set guaranteed EMPTY (resolve then reports -2 and
+// the caller's Python shadow loop stays in charge) — if any pattern
+// fails to compile, any segment is empty, or the segment count differs
+// from `expected` (an embedded NUL in a pattern would silently shift
+// every later index onto the wrong license otherwise).
+int pipe_refscan_set_singles(void *h, const char *blob, size_t len,
+                             const char *flags, int expected) {
+  auto *rs = static_cast<RefScan *>(h);
+  rs->singles.clear();
+  std::vector<std::unique_ptr<Pat>> pats;
+  size_t start = 0;
+  for (size_t i = 0; i <= len; ++i) {
+    if (i == len || blob[i] == '\0') {
+      if (i == start) return -1;  // empty segment: indexes would shift
+      auto pat = std::make_unique<Pat>();
+      std::string err;
+      if (!pat->compile(std::string(blob + start, i - start),
+                        flags ? flags : "", &err))
+        return -1;
+      pats.push_back(std::move(pat));
+      start = i + 1;
+      if (i == len) break;
+    }
+  }
+  if (static_cast<int>(pats.size()) != expected) return -1;
+  rs->singles = std::move(pats);
+  return static_cast<int>(rs->singles.size());
+}
+
+int pipe_refscan_min(void *h, const char *data, size_t len);  // below
+
+// Exact Reference resolution in one crossing: the union scan's floor,
+// then each pool index below it re-checked with its own pattern (the
+// chain semantics of matchers/reference.rb:7-11).  Returns the first
+// matching pool index, -1 for no match, -2 on a PCRE2 resource failure
+// or if singles were never attached (caller resolves in Python).
+int pipe_refscan_resolve(void *h, const char *data, size_t len) {
+  auto *rs = static_cast<RefScan *>(h);
+  if (rs->singles.empty()) return -2;
+  int floor = pipe_refscan_min(h, data, len);
+  // <=0 needs no shadow loop: no hit (-1), resource failure (-2), or
+  // pool index 0 (nothing earlier to check) — skip the section copy
+  if (floor <= 0) return floor;
+  Scratch scr;
+  std::string s(data, len);
+  for (int i = 0; i < floor; ++i) {
+    if (static_cast<size_t>(i) >= rs->singles.size()) break;
+    if (search(*rs->singles[i], s, scr)) return i;
+    if (scr.err) return -2;
+  }
+  return floor;
+}
 
 // Returns the min pool index over all hits, -1 for no hit, -2 on a PCRE2
 // resource failure (the caller fails the section over to the Python
