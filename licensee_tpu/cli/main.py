@@ -328,6 +328,7 @@ def cmd_batch_detect(args) -> int:
             closest=args.closest,
             attribution=args.attribution,
             featurize_procs=args.featurize_procs,
+            progress_every=args.progress,
             **kwargs,
         )
     except OSError as exc:
@@ -543,6 +544,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     batch.add_argument("--stats", action="store_true",
                        help="Print run stats + per-stage timers to stderr")
+    batch.add_argument(
+        "--progress", type=float, default=0, metavar="SECS",
+        help=(
+            "With --output: emit a JSON progress line (rows done, "
+            "files/sec, dedupe hits) to stderr at most every SECS "
+            "seconds — a 50M-file scan should not be a black box"
+        ),
+    )
     batch.add_argument("--profile", default=None, metavar="DIR",
                        help="Write a jax.profiler trace to DIR")
     batch.set_defaults(func=cmd_batch_detect)

@@ -44,6 +44,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import sys
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -323,6 +324,7 @@ class BatchProject:
         closest: int = 0,
         attribution: bool = False,
         featurize_procs: int = 0,
+        progress_every: float = 0,
         already_striped: bool = False,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
@@ -397,6 +399,14 @@ class BatchProject:
         # --featurize-procs N: produce batches in N worker PROCESSES
         # instead of threads (see the _mp_* machinery above)
         self.featurize_procs = int(featurize_procs or 0)
+        # --progress SECS: emit a JSON progress line to stderr at most
+        # every SECS seconds while run() streams (a 50M-file scan should
+        # not be a black box for an hour); 0 disables
+        self.progress_every = float(progress_every or 0)
+        if not (self.progress_every >= 0):  # rejects negatives AND NaN
+            raise ValueError(
+                f"progress_every must be >= 0, got {progress_every!r}"
+            )
 
     @classmethod
     def from_manifest_file(cls, manifest_file: str, **kwargs) -> "BatchProject":
@@ -518,6 +528,7 @@ class BatchProject:
 
         starts = deque(range(done, len(self.paths), self.batch_size))
         t_run = time.perf_counter()
+        t_progress = t_run
         use_procs = self.featurize_procs > 0
         if use_procs:
             import multiprocessing
@@ -665,6 +676,25 @@ class BatchProject:
                 t2 = time.perf_counter()
                 self.stats.add_stage("score", t1 - t0)
                 self.stats.add_stage("write", t2 - t1)
+                if (
+                    self.progress_every
+                    and t2 - t_progress >= self.progress_every
+                ):
+                    t_progress = t2
+                    print(
+                        json.dumps(
+                            {
+                                "progress": self.stats.total,
+                                "of": len(self.paths) - done,
+                                "files_per_sec": round(
+                                    self.stats.total / (t2 - t_run), 1
+                                ),
+                                "dedupe_hits": self.stats.dedupe_hits,
+                            }
+                        ),
+                        file=sys.stderr,
+                        flush=True,
+                    )
         self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats
 

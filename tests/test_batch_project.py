@@ -3,6 +3,8 @@
 import json
 import os
 
+import pytest
+
 from licensee_tpu.projects.batch_project import BatchProject
 from tests.conftest import FIXTURES_DIR, fixture_path
 
@@ -242,6 +244,37 @@ def test_dedupe_short_circuits_repeats(tmp_path):
         {k: v for k, v in r.items() if k != "path"} for r in rows
     ] == [{k: v for k, v in r.items() if k != "path"} for r in rows2]
     assert stats2.dedupe_hits == 0
+
+
+def test_progress_lines(tmp_path, capsys):
+    """--progress SECS: JSON heartbeat on stderr while run() streams
+    (rate-limited; 0 disables)."""
+    mit = open(fixture_path("mit/LICENSE.txt"), "rb").read()
+    paths = []
+    for i in range(6):
+        p = tmp_path / f"L{i}"
+        p.write_bytes(mit + str(i).encode())
+        paths.append(str(p))
+    project = BatchProject(
+        paths, batch_size=1, workers=1, inflight=1, progress_every=1e-9
+    )
+    project.run(str(tmp_path / "out.jsonl"), resume=False)
+    lines = [
+        json.loads(l)
+        for l in capsys.readouterr().err.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert lines, "expected progress heartbeats"
+    assert lines[-1]["progress"] == 6 and lines[-1]["of"] == 6
+    assert all("files_per_sec" in l for l in lines)
+
+    project2 = BatchProject(paths, batch_size=1)
+    project2.run(str(tmp_path / "out2.jsonl"), resume=False)
+    assert capsys.readouterr().err.strip() == ""  # off by default
+
+    for bad in (-1, float("nan")):
+        with pytest.raises(ValueError):
+            BatchProject(paths, progress_every=bad)
 
 
 def test_dedupe_cache_holds_immutable_snapshots(tmp_path):
