@@ -75,19 +75,29 @@ def vendored_spdx_ids(vendor_dir: str | None = None) -> list[str]:
     return ids
 
 
-def vendor_spdx(checkout: str, vendor_dir: str | None = None) -> list[str]:
+def vendor_spdx(
+    checkout: str,
+    vendor_dir: str | None = None,
+    licenses_vendor_dir: str | None = None,
+) -> list[str]:
     """Re-vendor `src/<spdx-id>.xml` for every vendored license from a
     local spdx/license-list-XML checkout (script/vendor-spdx:1-9).
     Returns the copied paths; raises if any vendored id has no XML in
     the checkout (a partial vendor tree would silently shrink the
-    corpus)."""
+    corpus).
+
+    ``licenses_vendor_dir``: where the include-list of spdx-ids comes
+    from — pass the SAME alternate dir a prior vendor_licenses(...,
+    vendor_dir=...) wrote, or the default repo tree is consulted (an
+    alternate-dir refresh that greps the stale default tree would
+    silently skip newly added licenses)."""
     vendor_dir = vendor_dir or VENDOR_SPDX_DIR
     src_dir = os.path.join(checkout, "src")
     if not os.path.isdir(src_dir):
         raise FileNotFoundError(
             f"not a license-list-XML checkout: {checkout!r} has no src/"
         )
-    ids = vendored_spdx_ids()
+    ids = vendored_spdx_ids(licenses_vendor_dir)
     missing = [
         i for i in ids
         if not os.path.isfile(os.path.join(src_dir, f"{i}.xml"))

@@ -146,6 +146,10 @@ def _produce_batch(
             if route is None:
                 preset[i] = _UNROUTED
     if dedupe:
+        if attribution:
+            from licensee_tpu.project_files.license_file import (
+                COPYRIGHT_NAME_REGEX,
+            )
         first_seen: dict = {}
         for i, c in enumerate(contents):
             if c is None or preset[i] is not None:
@@ -164,10 +168,6 @@ def _produce_batch(
             else:
                 dispatch = (route, BatchClassifier._is_html(filenames[i]))
                 if attribution:
-                    from licensee_tpu.project_files.license_file import (
-                        COPYRIGHT_NAME_REGEX,
-                    )
-
                     dispatch += (
                         bool(COPYRIGHT_NAME_REGEX.search(filenames[i])),
                     )

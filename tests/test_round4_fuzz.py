@@ -1,0 +1,117 @@
+"""Seeded differential fuzz over the round-4 surfaces.
+
+auto-mode routing must equal the corresponding fixed-mode classifier for
+every (filename, content) pair, and batch attribution must equal the
+scalar LicenseFile path — across randomized filenames, license bodies,
+noise documents, copyright lines, and README shapes.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+
+import pytest
+
+from licensee_tpu.corpus.license import License
+from licensee_tpu.kernels.batch import BatchClassifier
+
+
+@pytest.fixture(scope="module")
+def clfs():
+    return {
+        "auto": BatchClassifier(pad_batch_to=32, mesh=None, mode="auto"),
+        "license": BatchClassifier(pad_batch_to=32, mesh=None),
+        "readme": BatchClassifier(pad_batch_to=32, mesh=None, mode="readme"),
+        "package": BatchClassifier(mode="package"),
+    }
+
+
+def _random_cases(rng: random.Random, n: int):
+    licenses = License.all(hidden=True, pseudo=False)
+    bodies = [
+        re.sub(r"\[(\w+)\]", "example", lic.content or "")
+        for lic in licenses[:12]
+    ]
+    filenames = [
+        "LICENSE", "LICENSE.md", "COPYING", "license.txt", "LICENSE-MIT",
+        "MIT-LICENSE", "COPYRIGHT", "PATENTS", "UNLICENSE",
+        "README", "README.md", "README.rst", "readme.txt",
+        "package.json", "bower.json", "Cargo.toml", "DESCRIPTION",
+        "dist.ini", "LICENSE.spdx", "proj.gemspec", "lib.cabal",
+        "x.nuspec", "main.c", "setup.py", "notes.md", "index.html",
+        "Makefile", "LICENSE.html", "readme.html", "",
+    ]
+    noise = [
+        "just some prose\n", "int main(void) { return 0; }\n",
+        '{"license": "MIT"}\n', '{"license": "Zlib"}\n',
+        '[package]\nlicense = "ISC"\n',
+        "Package: x\nLicense: GPL-3\n",
+        "Copyright (c) 2020 Someone Somewhere\n",
+    ]
+    cases = []
+    for _ in range(n):
+        filename = rng.choice(filenames)
+        kind = rng.randrange(5)
+        if kind == 0:
+            content = rng.choice(bodies)
+        elif kind == 1:
+            hdr = f"Copyright (c) {rng.randrange(1980, 2030)} Fuzz Co\n\n"
+            content = hdr + rng.choice(bodies)
+        elif kind == 2:
+            content = (
+                f"# Project\n\n## License\n\n{rng.choice(bodies)}"
+                if rng.random() < 0.5
+                else "# Project\n\n## License\n\nMIT License.\n"
+            )
+        elif kind == 3:
+            content = rng.choice(noise)
+        else:
+            content = rng.choice(bodies)[: rng.randrange(10, 400)]
+        cases.append((filename, content.encode()))
+    return cases
+
+
+def test_auto_routing_agrees_with_fixed_modes(clfs):
+    rng = random.Random(20260730)
+    cases = _random_cases(rng, 120)
+    got = clfs["auto"].classify_blobs(
+        [c for _, c in cases], filenames=[f for f, _ in cases]
+    )
+    for (filename, content), g in zip(cases, got):
+        route = BatchClassifier.route_for(filename)
+        if route is None:
+            assert (g.key, g.matcher, g.confidence) == (None, None, 0.0), (
+                filename
+            )
+            continue
+        w = clfs[route].classify_blobs([content], filenames=[filename])[0]
+        assert (g.key, g.matcher, g.confidence) == (
+            w.key,
+            w.matcher,
+            w.confidence,
+        ), (filename, route)
+
+
+def test_attribution_agrees_with_scalar(clfs):
+    from licensee_tpu.project_files.license_file import LicenseFile
+
+    rng = random.Random(4)
+    clf = clfs["license"]
+    cases = [
+        (f, c)
+        for f, c in _random_cases(rng, 240)
+        if BatchClassifier.route_for(f) == "license"
+    ]
+    results = clf.classify_blobs(
+        [c for _, c in cases], filenames=[f for f, _ in cases]
+    )
+    checked = 0
+    for (filename, content), r in zip(cases, results):
+        if r.error:
+            continue
+        got = clf.attribution_for(content, filename, r)
+        want = LicenseFile(content, filename).attribution
+        assert got == want, filename
+        checked += 1
+    assert checked >= 50  # the fuzz actually exercised the comparison

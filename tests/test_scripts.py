@@ -86,6 +86,36 @@ def test_vendor_spdx_roundtrip(tmp_path):
     assert copied and _trees_identical(str(out), vendoring.VENDOR_SPDX_DIR)
 
 
+def test_vendor_spdx_include_list_tracks_alternate_dir(tmp_path):
+    """An alternate-dir refresh must grep its OWN choosealicense tree for
+    the spdx-id include list, not the repo default (which would silently
+    skip newly added/removed licenses)."""
+    checkout = tmp_path / "ca"
+    checkout.mkdir()
+    for sub in ("_data", "_licenses"):
+        shutil.copytree(
+            os.path.join(vendoring.VENDOR_LICENSES_DIR, sub),
+            checkout / sub,
+        )
+    dropped = sorted((checkout / "_licenses").iterdir())[0]
+    dropped_id = vendoring.vendored_spdx_ids()[0]
+    dropped.unlink()
+    alt = tmp_path / "alt-ca"
+    vendoring.vendor_licenses(str(checkout), str(alt))
+
+    llx = tmp_path / "llx"
+    shutil.copytree(
+        os.path.join(vendoring.VENDOR_SPDX_DIR, "src"), llx / "src"
+    )
+    out = tmp_path / "alt-spdx"
+    copied = vendoring.vendor_spdx(
+        str(llx), str(out), licenses_vendor_dir=str(alt)
+    )
+    ids = {os.path.basename(p)[:-4] for p in copied}
+    assert dropped_id not in ids
+    assert len(ids) == len(vendoring.vendored_spdx_ids()) - 1
+
+
 def test_vendor_spdx_rejects_partial_checkout(tmp_path):
     import pytest
 
