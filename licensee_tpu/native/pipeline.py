@@ -176,6 +176,10 @@ class NativePipeline:
         lib.pipe_refscan_resolve.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
         ]
+        lib.pipe_profile_dump.restype = ctypes.c_void_p
+        lib.pipe_profile_dump.argtypes = [
+            ctypes.POINTER(ctypes.c_size_t)
+        ]
         lib.pipe_featurize_raw.restype = ctypes.c_int
         lib.pipe_featurize_raw.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
@@ -409,6 +413,24 @@ class NativePipeline:
         Python chain."""
         data = section.encode("utf-8")
         return self._lib.pipe_refscan_resolve(handle, data, len(data))
+
+    def profile_dump(self) -> dict[str, float]:
+        """Accumulated per-pass seconds (diagnostic; empty unless
+        LICENSEE_TPU_PIPE_PROFILE=1 was set at process start)."""
+        n = ctypes.c_size_t()
+        ptr = self._lib.pipe_profile_dump(ctypes.byref(n))
+        if not ptr:
+            return {}
+        try:
+            text = ctypes.string_at(ptr, n.value).decode()
+        finally:
+            self._lib.pipe_free(ptr)
+        out = {}
+        for line in text.splitlines():
+            name, _, secs = line.partition("=")
+            if secs:
+                out[name] = float(secs)
+        return out
 
     def exact_hash(self, wordset) -> bytes:
         """The 16-byte hash pipe_featurize computes, for a Python-side

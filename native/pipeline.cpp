@@ -24,9 +24,11 @@
 // corpus (tests/test_normalize_hashes.py runs this path when built).
 
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <algorithm>
+#include <chrono>
 #include <map>
 #include <memory>
 #include <string>
@@ -239,6 +241,42 @@ std::vector<std::pair<size_t, size_t>> split_lines(const std::string &s) {
 }
 
 // ---------------------------------------------------------------------------
+// Diagnostic pass profiler (LICENSEE_TPU_PIPE_PROFILE=1): accumulates
+// wall seconds per labeled block so "where does the stage-2 floor go"
+// is a measurement, not a guess.  Plain doubles, deliberately not
+// thread-safe — profiling runs are single-threaded by design and the
+// feature costs one branch per pass when disabled.
+
+struct PassProf {
+  static bool enabled() {
+    static bool e = [] {
+      const char *v = std::getenv("LICENSEE_TPU_PIPE_PROFILE");
+      return v && *v && *v != '0';
+    }();
+    return e;
+  }
+  static std::map<std::string, double> &table() {
+    static std::map<std::string, double> t;
+    return t;
+  }
+};
+
+struct PassTimer {
+  const char *name;
+  std::chrono::steady_clock::time_point t0;
+  bool on;
+  explicit PassTimer(const char *n) : name(n), on(PassProf::enabled()) {
+    if (on) t0 = std::chrono::steady_clock::now();
+  }
+  ~PassTimer() {
+    if (on)
+      PassProf::table()[name] += std::chrono::duration<double>(
+                                     std::chrono::steady_clock::now() - t0)
+                                     .count();
+  }
+};
+
+// ---------------------------------------------------------------------------
 // Pipeline handle
 
 struct Pipeline {
@@ -299,7 +337,10 @@ struct Pipeline {
   // Python-downcased stage-1 output.
   std::string stage2(std::string c, Scratch &scr) const {
     bool clean = sc::is_squeezed_clean(c.data(), c.size());
-    c = gsub_pass(*pat("lists"), std::move(c), "- $1", scr, &clean);
+    {
+      PassTimer t("s2.lists");
+      c = gsub_pass(*pat("lists"), std::move(c), "- $1", scr, &clean);
+    }
     // gsub(/http:/, 'https:') and gsub(/&/, 'and') — literal span scans
     // (replacements introduce no spaces, so `clean` is preserved)
     if (c.find('&') != std::string::npos ||
@@ -324,17 +365,25 @@ struct Pipeline {
       r.append(c, i, std::string::npos);
       c = std::move(r);
     }
-    c = sc::dashes(c.data(), c.size());
-    c = sc::quotes(c.data(), c.size());
-    c = sc::hyphenated(c.data(), c.size());
-    c = spelling.run(c.data(), c.size());
+    {
+      PassTimer t("s2.scanners");
+      c = sc::dashes(c.data(), c.size());
+      c = sc::quotes(c.data(), c.size());
+      c = sc::hyphenated(c.data(), c.size());
+      c = spelling.run(c.data(), c.size());
+    }
     // span_markup needs one of [_*~] somewhere (same gate rationale as
     // stage1: skipping a pass that cannot match is behavior-identical)
     if (sc::find_byte4(c.data(), c.data() + c.size(), '_', '*', '~', '~') !=
-        c.data() + c.size())
+        c.data() + c.size()) {
+      PassTimer t("s2.span_markup");
       c = gsub_pass(*pat("span_markup"), std::move(c), "$1", scr, &clean);
-    c = gsub_pass(*pat("bullet"), std::move(c), "\n\n- ", scr, &clean);
-    c = gsub_pass(*pat("bullet_join"), std::move(c), ")(", scr, &clean);
+    }
+    {
+      PassTimer t("s2.bullet");
+      c = gsub_pass(*pat("bullet"), std::move(c), "\n\n- ", scr, &clean);
+      c = gsub_pass(*pat("bullet_join"), std::move(c), ")(", scr, &clean);
+    }
 
     // strip methods (content_helper.rb:89-105), in order.  bom's pattern
     // is \A\s*<BOM>, so the gate IS the match condition: leading space
@@ -364,14 +413,22 @@ struct Pipeline {
     if (contains(c, "unlicense")) {
       c = plain_strip(*pat("unlicense_info"), std::move(c), scr, &clean);
     }
-    c = gsub_pass(*pat("border_markup"), std::move(c), "$1", scr, &clean);
-    c = strip_loop(*pat("title"), std::move(c), scr, &clean);
-    c = plain_strip(*pat("version"), std::move(c), scr, &clean);
-    c = plain_strip(*pat("url"), std::move(c), scr, &clean);
-    c = strip_loop(*pat("strip_copyright"), std::move(c), scr, &clean);
-    c = strip_loop(*pat("title"), std::move(c), scr, &clean);
-    if (has_byte(c, '>'))
+    {
+      PassTimer t("s2.border_markup");
+      c = gsub_pass(*pat("border_markup"), std::move(c), "$1", scr, &clean);
+    }
+    {
+      PassTimer t("s2.title_strips");
+      c = strip_loop(*pat("title"), std::move(c), scr, &clean);
+      c = plain_strip(*pat("version"), std::move(c), scr, &clean);
+      c = plain_strip(*pat("url"), std::move(c), scr, &clean);
+      c = strip_loop(*pat("strip_copyright"), std::move(c), scr, &clean);
+      c = strip_loop(*pat("title"), std::move(c), scr, &clean);
+    }
+    if (has_byte(c, '>')) {
+      PassTimer t("s2.block_markup");
       c = plain_strip(*pat("block_markup"), std::move(c), scr, &clean);
+    }
     c = plain_strip(*pat("developed_by"), std::move(c), scr, &clean);
     size_t eot;
     // the pattern's literal core; subject is already downcased here
@@ -632,15 +689,26 @@ static int featurize_ascii_core(Pipeline *pl, Vocab *vocab, const char *data,
                                 int32_t *out, uint8_t *hash_out) {
   std::string in(data, len);
   int32_t flags = 0;
-  if (search(*pl->pat("copyright_full"), in, scr)) flags |= 1;
-  if (search(*pl->pat("cc_false_positive"), in, scr)) flags |= 2;
+  {
+    PassTimer t("prefilters");
+    if (search(*pl->pat("copyright_full"), in, scr)) flags |= 1;
+    if (search(*pl->pat("cc_false_positive"), in, scr)) flags |= 2;
+  }
   out[2] = flags;
 
-  std::string c = pl->stage1(std::move(in), scr);
+  std::string c;
+  {
+    PassTimer t("stage1");
+    c = pl->stage1(std::move(in), scr);
+  }
   sc::downcase_ascii(c.data(), c.size());  // pure ASCII by precondition
-  c = pl->stage2(std::move(c), scr);
+  {
+    PassTimer t("stage2");
+    c = pl->stage2(std::move(c), scr);
+  }
   if (scr.err) return 3;  // resource failure: caller falls back to Python
 
+  PassTimer t_ws("wordset_vocab");
   std::vector<uint64_t> hashes;
   std::vector<sc::Slice> uniq = sc::wordset_unique(c.data(), c.size(), &hashes);
   std::memset(bits_out, 0, vocab->n_lanes * sizeof(uint32_t));
@@ -879,6 +947,31 @@ int pipe_refscan_min(void *h, const char *data, size_t len) {
   }
   pcre2_match_data_free_8(md);
   return best;
+}
+
+// Dump the accumulated pass-profiler rows as "name=seconds\n" lines
+// (malloc'd; caller pipe_free's).  Empty unless LICENSEE_TPU_PIPE_PROFILE
+// was set before the first pass ran.
+char *pipe_profile_dump(size_t *out_len) {
+  std::string s;
+  for (const auto &kv : PassProf::table()) {
+    // %.9g via snprintf_l-free path: std::to_string honors LC_NUMERIC
+    // (a comma decimal point would break the Python float() parse)
+    char num[64];
+    std::snprintf(num, sizeof num, "%.9g", kv.second);
+    for (char *d = num; *d; ++d)
+      if (*d == ',') *d = '.';  // belt: normalize any locale comma
+    s += kv.first + "=" + num + "\n";
+  }
+  char *buf = static_cast<char *>(std::malloc(s.size() + 1));
+  if (!buf) {
+    *out_len = 0;
+    return nullptr;
+  }
+  std::memcpy(buf, s.data(), s.size());
+  buf[s.size()] = 0;
+  *out_len = s.size();
+  return buf;
 }
 
 // Hash a '\0'-joined unique-token blob (Python-side template wordsets, any

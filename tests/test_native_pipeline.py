@@ -212,6 +212,46 @@ def test_resource_limit_fails_over_to_python(monkeypatch):
         assert (r.key, r.matcher) == ("mit", "exact")
 
 
+def test_profile_dump_off_by_default():
+    """The pass profiler (LICENSEE_TPU_PIPE_PROFILE) must cost nothing
+    and report nothing unless enabled at process start; the enabled
+    path is exercised by a subprocess so this process stays clean."""
+    import json
+    import subprocess
+    import sys
+
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    clf = BatchClassifier(pad_batch_to=8, mesh=None)
+    if clf._nat is None:
+        pytest.skip("native pipeline unavailable")
+    clf.classify_blobs([b"some words to featurize"])
+    assert clf._nat.profile_dump() == {}
+
+    code = (
+        "import json\n"
+        "from licensee_tpu.kernels.batch import BatchClassifier\n"
+        "clf = BatchClassifier(pad_batch_to=8, mesh=None)\n"
+        "clf.classify_blobs([b'some words to featurize here'])\n"
+        "print(json.dumps(clf._nat.profile_dump()))\n"
+    )
+    result = subprocess.run(
+        [sys.executable, "-c", code],
+        env={
+            **os.environ,
+            "LICENSEE_TPU_PIPE_PROFILE": "1",
+            "JAX_PLATFORMS": "cpu",
+        },
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    prof = json.loads(result.stdout.strip().splitlines()[-1])
+    assert {"stage1", "stage2", "wordset_vocab"} <= set(prof)
+    assert all(v >= 0 for v in prof.values())
+
+
 def test_differential_fuzz_native_vs_python():
     """Seeded random documents mixing everything the normalization
     pipeline reacts to (markdown, bullets, quotes/dashes, varietal
