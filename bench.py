@@ -478,10 +478,14 @@ def bench_host_model(
     target = 10_000_000 / 60
     amdahl_ceiling = 1 / serial_pb if serial_pb else float("inf")
     # one process cannot beat 1/serial_pb no matter the cores — but the
-    # multi-host path (parallel/distributed.py) stripes the manifest AND
-    # the writer, so each of H hosts carries its own serial section:
-    # H >= target/amdahl hosts, each with parallel_pb*target/H cores
-    hosts = max(1, int(np.ceil(target / amdahl_ceiling)))
+    # distributed path (parallel/distributed.py) stripes the manifest
+    # AND the writer per PROCESS, and processes can share one machine
+    # (LICENSEE_TPU_COORDINATOR=localhost, each owning a chip subset).
+    # So the north star's single v5e-8 host runs P >= target/amdahl
+    # processes, each with parallel_pb*target/P cores — e.g. 5 processes
+    # x ~14 cores fits the v5e-8 host's 224 vCPUs (ct5lp-hightpu-8t)
+    # with chips split 2/2/2/1/1.
+    procs = max(1, int(np.ceil(target / amdahl_ceiling)))
     model = {
         "serial_us_per_blob": round(serial_pb * 1e6, 1),
         "parallel_us_per_blob": round(parallel_pb * 1e6, 1),
@@ -493,8 +497,12 @@ def bench_host_model(
             if amdahl_ceiling > target
             else None
         ),
-        "striped_hosts_needed_10M_60s": hosts,
-        "cores_per_striped_host": round(parallel_pb * target / hosts + 1, 1),
+        # processes, not hosts: they may share one machine (see above)
+        "striped_processes_needed_10M_60s": procs,
+        "cores_per_striped_process": round(
+            parallel_pb * target / procs + 1, 1
+        ),
+        "total_cores_needed_10M_60s": round(parallel_pb * target + procs, 1),
     }
     return {
         "files": n_files,

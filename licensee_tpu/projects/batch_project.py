@@ -33,10 +33,13 @@ Pipeline split per blob: parallel (worker threads: read+featurize)
 serial fraction 6.4%.  Amdahl: one process caps at ~37k files/s no
 matter the core count, so 10M files / 60s (167k files/s) is NOT a
 single-process target: it takes >=5 manifest-striped processes
-(parallel/distributed.py stripes the writer too — each host carries
-its own serial section), e.g. 5 hosts x ~14 cores.  bench.py prints
-the live model (serial_fraction, amdahl ceiling, striped-host count)
-under details.host_model on every run.
+(parallel/distributed.py stripes the writer too — each process
+carries its own serial section).  Processes may share one machine:
+the north-star v5e-8 host runs 5 processes x ~14 cores (~70 of the
+ct5lp-hightpu-8t's 224 vCPUs), chips split across processes via
+LICENSEE_TPU_COORDINATOR=localhost.  bench.py prints the live model
+(serial_fraction, amdahl ceiling, striped-process count) under
+details.host_model on every run.
 """
 
 from __future__ import annotations
