@@ -533,8 +533,20 @@ def build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--batch-size", type=int, default=4096)
     batch.add_argument("--workers", type=int, default=None,
                        help="Featurization worker threads (default: cpu count)")
+    def nonneg(kind):
+        # fail the typo in argparse, not after a 50M-line manifest loads
+        def parse(value):
+            v = kind(value)
+            if not (v >= 0):  # rejects negatives AND NaN
+                raise argparse.ArgumentTypeError(
+                    f"must be >= 0, got {value!r}"
+                )
+            return v
+
+        return parse
+
     batch.add_argument(
-        "--featurize-procs", type=int, default=0, metavar="N",
+        "--featurize-procs", type=nonneg(int), default=0, metavar="N",
         help=(
             "Featurize in N worker PROCESSES instead of threads (GIL "
             "insurance for hosts where the native pipeline is absent and "
@@ -545,7 +557,7 @@ def build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--stats", action="store_true",
                        help="Print run stats + per-stage timers to stderr")
     batch.add_argument(
-        "--progress", type=float, default=0, metavar="SECS",
+        "--progress", type=nonneg(float), default=0, metavar="SECS",
         help=(
             "With --output: emit a JSON progress line (rows done, "
             "files/sec, dedupe hits) to stderr at most every SECS "

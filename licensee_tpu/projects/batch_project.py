@@ -402,6 +402,10 @@ class BatchProject:
         # --featurize-procs N: produce batches in N worker PROCESSES
         # instead of threads (see the _mp_* machinery above)
         self.featurize_procs = int(featurize_procs or 0)
+        if self.featurize_procs < 0:
+            raise ValueError(
+                f"featurize_procs must be >= 0, got {featurize_procs!r}"
+            )
         # --progress SECS: emit a JSON progress line to stderr at most
         # every SECS seconds while run() streams (a 50M-file scan should
         # not be a black box for an hour); 0 disables

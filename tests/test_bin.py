@@ -104,3 +104,43 @@ def test_version():
     proc = run_bin("version")
     assert proc.returncode == 0
     assert proc.stdout.strip() == licensee_tpu.__version__
+
+
+def test_batch_detect_auto_flags_through_real_bin(tmp_path):
+    """The round-4 flag surface (--mode auto, --attribution, --closest,
+    --progress) through the REAL executable: argparse wiring, JSONL on
+    stdout, heartbeats+stats on stderr."""
+    with open(
+        os.path.join(fixture_path("mit"), "LICENSE.txt"), "rb"
+    ) as f:
+        mit = f.read()
+    (tmp_path / "LICENSE").write_bytes(mit)
+    (tmp_path / "main.c").write_text("int main(void){return 0;}\n")
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{tmp_path / 'LICENSE'}\n{tmp_path / 'main.c'}\n")
+    out = tmp_path / "out.jsonl"
+    proc = run_bin(
+        "batch-detect", str(manifest), "--mode", "auto", "--attribution",
+        "--closest", "2", "--progress", "100", "--output", str(out),
+        "--stats",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows[0]["key"] == "mit"
+    assert rows[0]["attribution"] == "Copyright (c) 2016 Ben Balter"
+    assert rows[1]["key"] is None
+    stats = json.loads(proc.stderr.strip().splitlines()[-1])
+    assert stats["routed"] == {"license": 1, "none": 1}
+
+    # bad values are rejected in argparse BEFORE the manifest loads
+    # (exit 2, usage + clean error line, never a traceback)
+    for bad in (["--progress", "-1"], ["--featurize-procs", "-2"]):
+        proc = run_bin(
+            "batch-detect", str(manifest), *bad, "--output", str(out)
+        )
+        assert proc.returncode == 2
+        assert any(
+            "error:" in l and "must be >= 0" in l
+            for l in proc.stderr.splitlines()
+        ), proc.stderr[:400]
+        assert "Traceback" not in proc.stderr
