@@ -543,6 +543,8 @@ def build_parser() -> argparse.ArgumentParser:
                 )
             return v
 
+        # argparse embeds the callable's name in "invalid ... value"
+        parse.__name__ = f"non-negative {kind.__name__}"
         return parse
 
     batch.add_argument(

@@ -400,12 +400,13 @@ class BatchProject:
         # Raw contents ride the pipeline tuples only when enabled.
         self.attribution = attribution
         # --featurize-procs N: produce batches in N worker PROCESSES
-        # instead of threads (see the _mp_* machinery above)
-        self.featurize_procs = int(featurize_procs or 0)
-        if self.featurize_procs < 0:
+        # instead of threads (see the _mp_* machinery above).  Validate
+        # BEFORE int() truncation: -0.9 must not slip through as 0.
+        if not (featurize_procs is None or featurize_procs >= 0):
             raise ValueError(
                 f"featurize_procs must be >= 0, got {featurize_procs!r}"
             )
+        self.featurize_procs = int(featurize_procs or 0)
         # --progress SECS: emit a JSON progress line to stderr at most
         # every SECS seconds while run() streams (a 50M-file scan should
         # not be a black box for an hour); 0 disables

@@ -366,10 +366,19 @@ struct Pipeline {
       c = std::move(r);
     }
     {
-      PassTimer t("s2.scanners");
+      PassTimer t("s2.sc.dashes");
       c = sc::dashes(c.data(), c.size());
+    }
+    {
+      PassTimer t("s2.sc.quotes");
       c = sc::quotes(c.data(), c.size());
+    }
+    {
+      PassTimer t("s2.sc.hyphenated");
       c = sc::hyphenated(c.data(), c.size());
+    }
+    {
+      PassTimer t("s2.sc.spelling");
       c = spelling.run(c.data(), c.size());
     }
     // span_markup needs one of [_*~] somewhere (same gate rationale as
