@@ -630,15 +630,16 @@ def bench_tp_width(arrays_full, features_full, rates_full: dict) -> dict:
 
 
 def bench_end_to_end_1m(n_files: int = 1_000_000) -> dict:
-    """Opt-in (LICENSEE_TPU_BENCH_1M=1 or argv '1m'): a >=1M-file run
-    with a realistic duplicate distribution, a mid-run kill (torn tail
-    included) + resume, and the full stage breakdown (BASELINE.md
-    config 3).
+    """At-scale license run: a dup-heavy manifest with a mid-run kill
+    (torn tail included) + resume, and the full stage breakdown
+    (BASELINE.md config 3).  Runs at 200k entries in the DEFAULT bench
+    (so the driver artifact carries an at-scale row); the full >=1M
+    shape stays opt-in (LICENSEE_TPU_BENCH_1M=1 or argv '1m').
 
-    Disk shape: 1M manifest ENTRIES over ~10k distinct files (hardlinked
-    path aliases would dodge the read stage; distinct paths to the same
-    few contents is the honest license-corpus shape: ~200 unique texts,
-    zipf-ish repeat counts, ~1% unique tails)."""
+    Disk shape: n_files manifest ENTRIES over ~n/100 distinct files
+    (hardlinked path aliases would dodge the read stage; distinct paths
+    to the same few contents is the honest license-corpus shape: ~200
+    unique texts, zipf-ish repeat counts, ~1% unique tails)."""
     import os
     import tempfile
 
@@ -659,7 +660,7 @@ def bench_end_to_end_1m(n_files: int = 1_000_000) -> dict:
                 f.write(hdr + body)
             popular.append(p)
         uniques = []
-        for i in range(10_000):
+        for i in range(max(2000, n_files // 100)):
             body = bodies[i % len(bodies)]
             p = os.path.join(tmpdir, f"uniq_{i}")
             with open(p, "w", encoding="utf-8") as f:
@@ -716,10 +717,10 @@ def bench_end_to_end_1m(n_files: int = 1_000_000) -> dict:
 
 
 def bench_end_to_end_1m_auto(n_files: int = 1_000_000) -> dict:
-    """Opt-in companion to bench_end_to_end_1m: the BASELINE.md config-5
-    shape — a >=1M-entry MIXED manifest (~70% source files no table
-    routes, the rest LICENSE/README/package spread) through ONE
-    `--mode auto` pass.  The unrouted majority must cost a basename
+    """Companion to bench_end_to_end_1m: the BASELINE.md config-5
+    shape — a MIXED manifest (~70% source files no table routes, the
+    rest LICENSE/README/package spread) through ONE `--mode auto` pass
+    (200k entries by default; >=1M opt-in).  The unrouted majority must cost a basename
     scan and nothing else (never read), which is exactly what this
     measures."""
     import os
@@ -867,6 +868,71 @@ def bench_agreement(n_blobs: int = 512) -> dict:
     }
 
 
+# the round driver records only the last ~2 KB of bench stdout; round 4's
+# single fat JSON line outgrew that window and the official artifact
+# recorded no numbers at all.  The final printed line is therefore
+# byte-budgeted: bounded scalar summaries only, with the open-ended
+# per-row blobs written to BENCH_DETAILS.json instead.
+HEADLINE_BYTE_BUDGET = 1500
+
+
+def make_headline(
+    metric: str, value: float, vs_baseline: float, details: dict
+) -> dict:
+    """Compact headline dict for the one driver-recorded stdout line.
+
+    Every field is a bounded scalar (or a small fixed-key dict of
+    them) so the serialized line stays under HEADLINE_BYTE_BUDGET no
+    matter what the full details blob grows to;
+    tests/test_bench_contract.py pins the budget against a
+    fully-populated details dict."""
+
+    def fps(row):
+        return row.get("files_per_sec") if row else None
+
+    agreement = details.get("scalar_agreement") or {}
+    at_scale = details.get("end_to_end_1m") or {}
+    at_auto = details.get("end_to_end_1m_auto") or {}
+    return {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": "files/sec/chip",
+        "vs_baseline": round(vs_baseline, 1),
+        "details": {
+            "batch": details["batch"],
+            "templates": details["templates"],
+            "vocab": details["vocab"],
+            "method": details["method"],
+            "rates": details["rates"],
+            "scalar_cpu_files_per_sec": details[
+                "scalar_cpu_files_per_sec"
+            ],
+            "agreement": agreement.get("agreement"),
+            "agreement_blobs": agreement.get("blobs"),
+            "e2e_files_per_sec": {
+                "unique": fps(details.get("end_to_end")),
+                "dup": fps(details.get("end_to_end_dup")),
+                "readme": fps(details.get("end_to_end_readme")),
+                "package": fps(details.get("end_to_end_package")),
+                "auto": fps(details.get("end_to_end_auto")),
+            },
+            "at_scale_license": {
+                "files": at_scale.get("files"),
+                "resume_files_per_sec": at_scale.get(
+                    "resume_files_per_sec"
+                ),
+                "rows_written": at_scale.get("rows_written"),
+                "resume_ok": at_scale.get("resume_ok"),
+            },
+            "at_scale_auto": {
+                "files": at_auto.get("files"),
+                "files_per_sec": fps(at_auto),
+            },
+            "details_file": "BENCH_DETAILS.json",
+        },
+    }
+
+
 def main() -> None:
     # big batches amortize the per-dispatch latency floor of the TPU
     # tunnel (~4 ms); 256k blobs puts the bench in the throughput regime.
@@ -983,47 +1049,78 @@ def main() -> None:
     )
     agreement = run_safe("agreement", bench_agreement)
 
-    end_to_end_1m = None
-    end_to_end_1m_auto = None
+    # at-scale rows run in the DEFAULT bench at 200k entries (~5-10 s
+    # each at the measured rates) so the driver artifact carries them;
+    # '1m' / LICENSEE_TPU_BENCH_1M=1 upgrades them to the full >=1M shape
     import os as _os
 
+    at_scale_n = 200_000
     if _os.environ.get("LICENSEE_TPU_BENCH_1M") or "1m" in sys.argv[1:]:
-        end_to_end_1m = run_safe("end_to_end_1m", bench_end_to_end_1m)
-        end_to_end_1m_auto = run_safe(
-            "end_to_end_1m_auto", bench_end_to_end_1m_auto
-        )
+        at_scale_n = 1_000_000
+    end_to_end_1m = run_safe(
+        "end_to_end_1m", bench_end_to_end_1m, at_scale_n
+    )
+    end_to_end_1m_auto = run_safe(
+        "end_to_end_1m_auto", bench_end_to_end_1m_auto, at_scale_n
+    )
 
-    result = {
-        "metric": (
-            "LICENSE files/sec/chip, full-SPDX-width template corpus "
-            f"(T={int(arrays_full.bits.shape[0])}, DiceXLA batch)"
-        ),
-        "value": round(device_rate, 1),
-        "unit": "files/sec/chip",
-        "vs_baseline": round(device_rate / scalar_rate, 1),
-        "details": {
-            "batch": n_blobs,
-            "templates": int(arrays_full.bits.shape[0]),
-            "template_source": template_source,
-            "vocab": corpus_full.vocab_size,
-            "method": best_method,
-            "rates": {k: round(v, 1) for k, v in rates_full.items()},
-            "rates_t47": {k: round(v, 1) for k, v in rates_t47.items()},
-            "scalar_cpu_files_per_sec": round(scalar_rate, 1),
-            "end_to_end": end_to_end,
-            "end_to_end_dup": end_to_end_dup,
-            "end_to_end_readme": end_to_end_readme,
-            "end_to_end_package": end_to_end_package,
-            "end_to_end_auto": end_to_end_auto,
-            "host_model": host_model,
-            "reference_fallback": reference_fallback,
-            "tp_width": tp_width,
-            "scalar_agreement": agreement,
-            "end_to_end_1m": end_to_end_1m,
-            "end_to_end_1m_auto": end_to_end_1m_auto,
-        },
+    details = {
+        "batch": n_blobs,
+        "templates": int(arrays_full.bits.shape[0]),
+        "template_source": template_source,
+        "vocab": corpus_full.vocab_size,
+        "method": best_method,
+        "rates": {k: round(v, 1) for k, v in rates_full.items()},
+        "rates_t47": {k: round(v, 1) for k, v in rates_t47.items()},
+        "scalar_cpu_files_per_sec": round(scalar_rate, 1),
+        "end_to_end": end_to_end,
+        "end_to_end_dup": end_to_end_dup,
+        "end_to_end_readme": end_to_end_readme,
+        "end_to_end_package": end_to_end_package,
+        "end_to_end_auto": end_to_end_auto,
+        "host_model": host_model,
+        "reference_fallback": reference_fallback,
+        "tp_width": tp_width,
+        "scalar_agreement": agreement,
+        "end_to_end_1m": end_to_end_1m,
+        "end_to_end_1m_auto": end_to_end_1m_auto,
     }
-    print(json.dumps(result))
+    metric = (
+        "LICENSE files/sec/chip, full-SPDX-width template corpus "
+        f"(T={int(arrays_full.bits.shape[0])}, DiceXLA batch)"
+    )
+    headline = make_headline(
+        metric, device_rate, device_rate / scalar_rate, details
+    )
+    details_path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "BENCH_DETAILS.json"
+    )
+    with open(details_path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"headline": headline, "details": details}, f, indent=1
+        )
+        f.write("\n")
+    line = json.dumps(headline, separators=(",", ":"))
+    if len(line.encode()) > HEADLINE_BYTE_BUDGET:
+        # never abort after a multi-minute run: an over-budget line
+        # degrades to the minimal headline (always tiny) instead of
+        # recreating round 4's lost-artifact failure
+        print(
+            f"bench: headline {len(line.encode())}B over budget; "
+            "shrinking (see BENCH_DETAILS.json)",
+            file=sys.stderr,
+        )
+        line = json.dumps(
+            {
+                "metric": headline["metric"],
+                "value": headline["value"],
+                "unit": headline["unit"],
+                "vs_baseline": headline["vs_baseline"],
+                "details": {"details_file": "BENCH_DETAILS.json"},
+            },
+            separators=(",", ":"),
+        )
+    print(line)
 
 
 if __name__ == "__main__":
