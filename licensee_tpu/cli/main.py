@@ -237,20 +237,7 @@ def cmd_help(args) -> int:
             return int(exc.code or 0)
         return 0
     print("Licensee commands:")
-    sub_actions = next(
-        a
-        for a in args.parser._actions
-        if isinstance(a, argparse._SubParsersAction)
-    )
-    for choice in args.parser._subparsers._group_actions[0].choices:
-        help_text = next(
-            (
-                c.help
-                for c in sub_actions._choices_actions
-                if c.dest == choice
-            ),
-            "",
-        )
+    for choice, help_text in COMMANDS:
         print(f"  licensee-tpu {choice:<24} # {help_text}")
     return 0
 
@@ -400,6 +387,20 @@ def cmd_batch_detect(args) -> int:
     return 0
 
 
+# the one command table: build_parser() wires each entry into argparse
+# and cmd_help() prints it — no argparse-private introspection (the
+# Thor-style listing of /root/reference/bin/licensee:10-43)
+COMMANDS = (
+    ("detect", "Detect the license of the given project"),
+    ("diff", "Compare license text to a known license"),
+    ("license-path", "Path to the project's license file"),
+    ("version", "Print the version"),
+    ("help", "Describe available commands"),
+    ("batch-detect", "Classify a manifest of files on the TPU batch path"),
+)
+_COMMAND_HELP = dict(COMMANDS)
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="licensee-tpu", description="Detect the license of a project"
@@ -418,7 +419,7 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--remote", action="store_true")
         p.add_argument("--ref", default=None)
 
-    detect = sub.add_parser("detect", help="Detect the license of the given project")
+    detect = sub.add_parser("detect", help=_COMMAND_HELP["detect"])
     add_common(detect)
     detect.add_argument("--json", action="store_true")
     detect.add_argument(
@@ -428,24 +429,24 @@ def build_parser() -> argparse.ArgumentParser:
     detect.add_argument("--diff", action="store_true")
     detect.set_defaults(func=cmd_detect)
 
-    diff = sub.add_parser("diff", help="Compare license text to a known license")
+    diff = sub.add_parser("diff", help=_COMMAND_HELP["diff"])
     add_common(diff)
     diff.add_argument("--license", default=None)
     diff.set_defaults(func=cmd_diff)
 
-    lp = sub.add_parser("license-path", help="Path to the project's license file")
+    lp = sub.add_parser("license-path", help=_COMMAND_HELP["license-path"])
     add_common(lp)
     lp.set_defaults(func=cmd_license_path)
 
-    version = sub.add_parser("version", help="Print the version")
+    version = sub.add_parser("version", help=_COMMAND_HELP["version"])
     version.set_defaults(func=cmd_version)
 
-    help_cmd = sub.add_parser("help", help="Describe available commands")
+    help_cmd = sub.add_parser("help", help=_COMMAND_HELP["help"])
     help_cmd.add_argument("topic", nargs="?", default=None)
     help_cmd.set_defaults(func=cmd_help, parser=parser)
 
     batch = sub.add_parser(
-        "batch-detect", help="Classify a manifest of files on the TPU batch path"
+        "batch-detect", help=_COMMAND_HELP["batch-detect"]
     )
     batch.add_argument("manifest", help="File with one path per line")
     batch.add_argument(
@@ -570,6 +571,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="Write a jax.profiler trace to DIR")
     batch.set_defaults(func=cmd_batch_detect)
 
+    # the COMMANDS table and the registered subcommands must not drift:
+    # `help` prints from the table, the parser dispatches from argparse
+    if set(sub.choices) != {name for name, _ in COMMANDS}:
+        raise AssertionError(
+            f"COMMANDS out of sync with parser: {sorted(sub.choices)} "
+            f"vs {[name for name, _ in COMMANDS]}"
+        )
     return parser
 
 

@@ -156,3 +156,29 @@ def test_scripts_run_as_executables(tmp_path):
     )
     assert result.returncode == 0, result.stderr
     assert _trees_identical(str(out), vendoring.VENDOR_LICENSES_DIR)
+
+
+def test_lint_is_green():
+    """script/lint (the rubocop slot of script/cibuild) passes on the
+    shipped tree — keeps the one-command CI gate green by construction."""
+    result = subprocess.run(
+        [sys.executable, os.path.join(vendoring.REPO_ROOT, "script", "lint")],
+        cwd=vendoring.REPO_ROOT, capture_output=True, text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_cibuild_exists_and_is_wired():
+    """script/cibuild is the documented one-command gate (reference
+    script/cibuild:5-9: rspec + rubocop + gem build).  Running it here
+    would recurse into pytest; assert the contract instead: executable,
+    and staging pytest + lint + wheel build in that order."""
+    path = os.path.join(vendoring.REPO_ROOT, "script", "cibuild")
+    assert os.access(path, os.X_OK)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    assert text.startswith("#!/bin/sh")
+    assert "set -e" in text
+    assert text.index("pytest") < text.index("script/lint") < text.index(
+        "-m build"
+    )
