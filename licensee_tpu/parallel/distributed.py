@@ -27,6 +27,17 @@ Environment contract (all three must be set to opt in):
 On TPU pod slices where the runtime provides cluster metadata,
 ``jax.distributed.initialize()`` auto-detects instead; call
 ``maybe_initialize`` with ``auto=True`` env ``LICENSEE_TPU_DISTRIBUTED=auto``.
+
+Co-located processes (one host, chips split per process) additionally set
+
+* ``LICENSEE_TPU_VISIBLE_CHIPS`` — comma list of this process's chip ids
+
+which ``apply_visible_chips`` translates, BEFORE the backend initializes,
+into the PJRT TPU visibility var (``TPU_VISIBLE_DEVICES``) and — for the
+CPU rehearsal of the same launch — a matching virtual host-device count.
+This is the v5e-8 north-star shape (the scaling-model ADR in
+projects/batch_project.py): >=4 manifest-striped processes sharing the
+host, each with its own chip subset and its own local data mesh.
 """
 
 from __future__ import annotations
@@ -34,6 +45,120 @@ from __future__ import annotations
 import os
 
 _initialized = False
+_chips_applied: list[str] | None = None
+
+
+def apply_visible_chips(env=None) -> list[str] | None:
+    """Restrict THIS process to its chip subset (idempotent).
+
+    Reads ``LICENSEE_TPU_VISIBLE_CHIPS`` (e.g. ``"4,5"``) and exports the
+    visibility the runtime actually honors:
+
+    * ``TPU_VISIBLE_DEVICES`` for the PJRT TPU plugin (real chips);
+    * ``--xla_force_host_platform_device_count=<n>`` so a CPU run of the
+      same launch line rehearses an n-device local mesh per process.
+
+    Must run before the jax backend initializes — visibility cannot
+    change after; raises RuntimeError if a backend is already live.
+    Returns the chip list, or None when the env var is unset."""
+    global _chips_applied
+    env = os.environ if env is None else env
+    spec = env.get("LICENSEE_TPU_VISIBLE_CHIPS")
+    if spec is None:
+        return None
+    chips = [c.strip() for c in spec.split(",") if c.strip()]
+    if not chips:
+        raise ValueError(
+            f"LICENSEE_TPU_VISIBLE_CHIPS={spec!r}: no chip ids"
+        )
+    if _chips_applied is not None:
+        if chips != _chips_applied:
+            raise RuntimeError(
+                f"LICENSEE_TPU_VISIBLE_CHIPS changed after apply: "
+                f"{_chips_applied} -> {chips}"
+            )
+        return chips
+    import sys
+
+    if "jax" in sys.modules:  # best-effort live-backend guard
+        try:
+            from jax._src import xla_bridge
+
+            live = bool(xla_bridge._backends)
+        except Exception:  # noqa: BLE001 — private API may move
+            live = False
+        if live:
+            raise RuntimeError(
+                "LICENSEE_TPU_VISIBLE_CHIPS set but the jax backend is "
+                "already initialized; set it before the first device use"
+            )
+    want = ",".join(chips)
+    have = os.environ.get("TPU_VISIBLE_DEVICES")
+    if have is not None and have != want:
+        # refuse loudly: a stale/wrapper-set value silently winning over
+        # the requested subset would leave co-located ranks contending
+        # for the same chips with no diagnostic
+        raise RuntimeError(
+            f"TPU_VISIBLE_DEVICES={have!r} conflicts with "
+            f"LICENSEE_TPU_VISIBLE_CHIPS={spec!r}; unset one"
+        )
+    os.environ["TPU_VISIBLE_DEVICES"] = want
+    # CPU rehearsal: LICENSEE_TPU_VISIBLE_CHIPS is authoritative for the
+    # virtual local-device count — rewrite a leaked count (test harnesses
+    # commonly export one) instead of silently keeping it
+    import re
+
+    flag = f"--xla_force_host_platform_device_count={len(chips)}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    _export_colocated_tpu_vars(env, chips)
+    _chips_applied = chips
+    return chips
+
+
+def _export_colocated_tpu_vars(env, chips: list[str]) -> None:
+    """Best-effort libtpu co-location vars for N processes sharing one
+    REAL TPU host.
+
+    ``TPU_VISIBLE_DEVICES`` alone is not enough for libtpu to split one
+    host's chips across processes — it also wants per-process ports, the
+    full address list, a task id, and the topology bounds.  When the
+    multi-process contract is present alongside the chip split (which
+    implies co-location on this host), derive what is derivable and pass
+    the topology bounds through from ``LICENSEE_TPU_PROCESS_BOUNDS`` /
+    ``LICENSEE_TPU_CHIPS_PER_PROCESS_BOUNDS`` (topology-dependent; the
+    v5e-8 4x2-chip split is documented in the README).  setdefault
+    everywhere: an operator who exports the TPU_* vars directly wins.
+    CI exercises the CPU rehearsal of this launch; the real-host var set
+    is exported on the documented contract but this repo's environment
+    (one tunneled chip) cannot validate libtpu's acceptance of it."""
+    n = env.get("LICENSEE_TPU_NUM_PROCESSES")
+    rank = env.get("LICENSEE_TPU_PROCESS_ID")
+    if not n or rank is None:
+        return
+    n_i, rank_i = int(n), int(rank)
+    base = int(env.get("LICENSEE_TPU_PROCESS_PORT_BASE", "8476"))
+    os.environ.setdefault("TPU_PROCESS_PORT", str(base + rank_i))
+    os.environ.setdefault(
+        "TPU_PROCESS_ADDRESSES",
+        ",".join(f"localhost:{base + i}" for i in range(n_i)),
+    )
+    os.environ.setdefault("CLOUD_TPU_TASK_ID", str(rank))
+    for src, dst in (
+        ("LICENSEE_TPU_PROCESS_BOUNDS", "TPU_PROCESS_BOUNDS"),
+        (
+            "LICENSEE_TPU_CHIPS_PER_PROCESS_BOUNDS",
+            "TPU_CHIPS_PER_PROCESS_BOUNDS",
+        ),
+    ):
+        if env.get(src):
+            os.environ.setdefault(dst, env[src])
 
 
 def maybe_initialize(env=None) -> tuple[int, int]:
@@ -43,6 +168,9 @@ def maybe_initialize(env=None) -> tuple[int, int]:
     multi-host environment is configured."""
     global _initialized
     env = os.environ if env is None else env
+
+    if not _initialized:
+        apply_visible_chips(env)
 
     coord = env.get("LICENSEE_TPU_COORDINATOR")
     auto = env.get("LICENSEE_TPU_DISTRIBUTED") == "auto"

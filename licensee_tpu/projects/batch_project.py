@@ -34,11 +34,18 @@ serial fraction 6.4%.  Amdahl: one process caps at ~37k files/s no
 matter the core count, so 10M files / 60s (167k files/s) is NOT a
 single-process target: it takes >=5 manifest-striped processes
 (parallel/distributed.py stripes the writer too — each process
-carries its own serial section).  Processes may share one machine:
+carries its own serial section).  Processes share one machine:
 the north-star v5e-8 host runs 5 processes x ~14 cores (~70 of the
 ct5lp-hightpu-8t's 224 vCPUs), chips split across processes via
-LICENSEE_TPU_COORDINATOR=localhost.  bench.py prints the live model
-(serial_fraction, amdahl ceiling, striped-process count) under
+LICENSEE_TPU_COORDINATOR=localhost plus per-rank
+LICENSEE_TPU_VISIBLE_CHIPS (parallel/distributed.py
+apply_visible_chips).  Status r5: EXERCISED (CPU rehearsal) — the
+2-process cluster test gives each child its own chip subset and a
+real 2-device local data mesh through the sharded scorer
+(tests/test_distributed.py); README documents the v5e-8 launch line
+incl. the libtpu co-location vars (exported per contract; real
+multi-chip hardware is not available to this build env).  bench.py prints the live
+model (serial_fraction, amdahl ceiling, striped-process count) under
 details.host_model on every run.
 """
 

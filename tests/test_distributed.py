@@ -69,16 +69,26 @@ CHILD = textwrap.dedent(
 
     from licensee_tpu.parallel.distributed import maybe_initialize
 
+    # the chips-split-per-process recipe: LICENSEE_TPU_VISIBLE_CHIPS (set
+    # by the launcher per rank) gives THIS process its chip subset; on
+    # CPU the same plumbing rehearses it as a virtual local device count,
+    # so each child builds a real >=2-device local data mesh and scores
+    # its stripe through the sharded scorer
     process_index, process_count = maybe_initialize()
     assert process_count == 2, process_count
+    n_chips = len(os.environ["LICENSEE_TPU_VISIBLE_CHIPS"].split(","))
+    assert len(jax.local_devices()) == n_chips, jax.local_devices()
 
     from licensee_tpu.projects.batch_project import BatchProject
 
     with open(sys.argv[1], encoding="utf-8") as f:
         paths = [line.strip() for line in f if line.strip()]
     mode = sys.argv[3] if len(sys.argv) > 3 else "license"
-    project = BatchProject(paths, batch_size=4, mesh=None, mode=mode)
+    project = BatchProject(paths, batch_size=4, mesh="auto", mode=mode)
     assert project.process_index == process_index
+    mesh = project.classifier.mesh
+    if mode != "package":  # package mode is host-only by design
+        assert mesh is not None and mesh.shape["data"] == n_chips, mesh
     stats = project.run(sys.argv[2], resume=True)
     print(json.dumps({{"rank": process_index, "total": stats.total,
                        "routed": stats.routed}}))
@@ -101,7 +111,12 @@ def _run_cluster(manifest: str, output: str, port: int, mode="license"):
             "LICENSEE_TPU_COORDINATOR": f"127.0.0.1:{port}",
             "LICENSEE_TPU_NUM_PROCESSES": "2",
             "LICENSEE_TPU_PROCESS_ID": str(rank),
+            # chips split per process: rank 0 gets chips 0-1, rank 1
+            # gets 2-3 (on CPU this becomes 2 virtual local devices per
+            # child — the v5e-8 co-located-process launch, rehearsed)
+            "LICENSEE_TPU_VISIBLE_CHIPS": "0,1" if rank == 0 else "2,3",
         }
+        env.pop("XLA_FLAGS", None)  # the child derives its own count
         procs.append(
             subprocess.Popen(
                 [sys.executable, "-c", CHILD, manifest, output, mode],
@@ -244,3 +259,102 @@ def test_from_manifest_file_materializes_only_the_stripe(tmp_path):
 
     single = BatchProject.from_manifest_file(str(manifest), mesh=None)
     assert single.paths == p0.paths + p1.paths
+
+
+# -- per-process chip visibility (the chips-split-per-process recipe) --
+
+def test_apply_visible_chips_unset_is_noop():
+    from licensee_tpu.parallel import distributed
+
+    assert distributed.apply_visible_chips(env={}) is None
+
+
+def test_apply_visible_chips_rejects_empty_and_live_backend():
+    from licensee_tpu.parallel import distributed
+
+    with pytest.raises(ValueError):
+        distributed.apply_visible_chips(
+            env={"LICENSEE_TPU_VISIBLE_CHIPS": " , "}
+        )
+    # this test process has a live CPU backend (conftest) and no prior
+    # successful apply: setting chips now must refuse loudly, not
+    # silently fail to take effect
+    if distributed._chips_applied is None:
+        import jax
+
+        jax.devices()  # ensure the backend really is live
+        with pytest.raises(RuntimeError):
+            distributed.apply_visible_chips(
+                env={"LICENSEE_TPU_VISIBLE_CHIPS": "0"}
+            )
+
+
+def test_apply_visible_chips_exports_runtime_vars():
+    """In a fresh interpreter the env var becomes TPU_VISIBLE_DEVICES +
+    a matching CPU virtual-device count, and jax sees exactly that many
+    local devices."""
+    child = textwrap.dedent(
+        """
+        import json, os, sys
+        sys.path.insert(0, %r)
+        from licensee_tpu.parallel.distributed import apply_visible_chips
+
+        # a conflicting pre-set TPU_VISIBLE_DEVICES must refuse loudly
+        os.environ["TPU_VISIBLE_DEVICES"] = "9"
+        try:
+            apply_visible_chips()
+        except RuntimeError:
+            pass
+        else:
+            raise AssertionError("conflict not refused")
+        del os.environ["TPU_VISIBLE_DEVICES"]
+
+        # a leaked virtual-device count is rewritten, not kept
+        os.environ["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=8"
+        )
+        chips = apply_visible_chips()
+        assert chips == ["4", "5", "6"], chips
+        assert os.environ["TPU_VISIBLE_DEVICES"] == "4,5,6"
+        assert "device_count=3" in os.environ["XLA_FLAGS"], (
+            os.environ["XLA_FLAGS"]
+        )
+        assert apply_visible_chips() == chips  # idempotent
+
+        # the libtpu co-location set (real-host contract)
+        assert os.environ["TPU_PROCESS_PORT"] == "8477"
+        assert os.environ["TPU_PROCESS_ADDRESSES"] == (
+            "localhost:8476,localhost:8477"
+        )
+        assert os.environ["CLOUD_TPU_TASK_ID"] == "1"
+        assert os.environ["TPU_PROCESS_BOUNDS"] == "1,2,1"
+        assert os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "3,1,1"
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps({"n_local": len(jax.local_devices())}))
+        """
+        % REPO
+    )
+    env = {
+        **os.environ,
+        "LICENSEE_TPU_VISIBLE_CHIPS": "4,5,6",
+        "LICENSEE_TPU_NUM_PROCESSES": "2",
+        "LICENSEE_TPU_PROCESS_ID": "1",
+        "LICENSEE_TPU_PROCESS_BOUNDS": "1,2,1",
+        "LICENSEE_TPU_CHIPS_PER_PROCESS_BOUNDS": "3,1,1",
+    }
+    for k in ("XLA_FLAGS", "TPU_VISIBLE_DEVICES", "TPU_PROCESS_PORT",
+              "TPU_PROCESS_ADDRESSES", "CLOUD_TPU_TASK_ID",
+              "TPU_PROCESS_BOUNDS", "TPU_CHIPS_PER_PROCESS_BOUNDS",
+              "LICENSEE_TPU_COORDINATOR"):
+        env.pop(k, None)
+    result = subprocess.run(
+        [sys.executable, "-c", child], env=env, capture_output=True,
+        text=True, cwd=REPO, timeout=120,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert json.loads(result.stdout.strip().splitlines()[-1]) == {
+        "n_local": 3
+    }

@@ -179,6 +179,14 @@ def test_cibuild_exists_and_is_wired():
         text = f.read()
     assert text.startswith("#!/bin/sh")
     assert "set -e" in text
-    assert text.index("pytest") < text.index("script/lint") < text.index(
-        "-m build"
+    # order the real invocations, not the header comment
+    code = "\n".join(
+        line
+        for line in text.splitlines()
+        if not line.lstrip().startswith("#")
+    )
+    assert (
+        code.index("python -m pytest")
+        < code.index("python script/lint")
+        < code.index("python -m build")
     )
