@@ -142,6 +142,14 @@ def _export_colocated_tpu_vars(env, chips: list[str]) -> None:
     rank = env.get("LICENSEE_TPU_PROCESS_ID")
     if not n or rank is None:
         return
+    # chips-split + a REMOTE coordinator = a hybrid multi-host layout
+    # this derivation cannot describe (the address list below would name
+    # every global rank as localhost); in that layout the operator
+    # exports the TPU_* vars per host directly
+    coord = env.get("LICENSEE_TPU_COORDINATOR", "")
+    coord_host = coord.rsplit(":", 1)[0] if coord else ""
+    if coord_host not in ("", "localhost", "127.0.0.1", "::1"):
+        return
     n_i, rank_i = int(n), int(rank)
     base = int(env.get("LICENSEE_TPU_PROCESS_PORT_BASE", "8476"))
     os.environ.setdefault("TPU_PROCESS_PORT", str(base + rank_i))

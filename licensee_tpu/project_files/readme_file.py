@@ -4,6 +4,16 @@ Parity target: `lib/licensee/project_files/readme_file.rb` — filename
 scores, the header lookbehind/lookahead content regex (markdown `#`, rdoc
 `=`, and underlined headers), and the Reference matcher appended to the
 LicenseFile chain.
+
+Perf ADR (r5): the readme route's top featurize item was the one-shot
+CONTENT_REGEX (~55us/blob on full-text sections — lazy `(.*?)` with a
+line-anchored lookahead evaluated per character); license_content now
+runs its two halves as linear scans behind a `licen` substring pre-check
+(~4-8us typical), differential-pinned to the one-shot form in
+tests/test_file_scoring.py.  Readme e2e: 7.4k -> ~9.7k files/s solo;
+the remainder is the native featurize crossing on full-body sections —
+the same floor as the license route (host-model ADR,
+projects/batch_project.py).
 """
 
 from __future__ import annotations
@@ -21,25 +31,36 @@ _SCORES = [
 _TITLE = r"licen[sc]e:?"
 _UNDERLINE = r"\n[-=]+"
 
+# the two halves of the section extraction, shared between the one-shot
+# CONTENT_REGEX (the documented Ruby-parity form) and the staged fast
+# path below — single source so a parity fix cannot diverge them
+_HEADING_SRC = (
+    r"(?:[\#=]+\s" + _TITLE + r"\s*[\#=]*|" + _TITLE + _UNDERLINE + r")"
+)
+_NEXT_SRC = r"(?:[\#=]+|[^\n]+" + _UNDERLINE + r")"
+
 CONTENT_REGEX = rb(
-    r"^"
-    r"(?:"
-    r"[\#=]+\s" + _TITLE + r"\s*[\#=]*"
-    r"|" + _TITLE + _UNDERLINE +
-    r")$"
+    r"^" + _HEADING_SRC + r"$"
     r"(.*?)"
-    r"(?=^"
-    r"(?:"
-    r"[\#=]+"
-    r"|"
-    r"[^\n]+" + _UNDERLINE +
-    r")"
-    r"|"
-    r"\Z"
-    r")",
+    r"(?=^" + _NEXT_SRC + r"|\Z)",
     i=True,
     m=True,
 )
+
+# license_content runs CONTENT_REGEX's two halves as separate scans:
+# the one-shot regex pays its lazy `(.*?)` + line-anchored lookahead at
+# every character of a full-text license section (~55us/blob on 10KB
+# bodies — the top featurize item of the readme route, bench r4), while
+# heading-search + next-section-search are two linear C scans (~5us).
+# Equivalence with the one-shot form (pinned differentially by
+# tests/test_file_scoring.py::
+# test_readme_license_content_matches_one_shot_regex): re.search stops
+# at the FIRST heading position, where the remainder `(.*?)(?=NEXT|\Z)`
+# always succeeds and lazily stops exactly at the first NEXT match after
+# the heading (or end-of-text) —
+# i.e. content[heading.end() : next.start() or len].
+_HEADING_REGEX = rb(r"^" + _HEADING_SRC + r"$", i=True)
+_NEXT_SECTION_REGEX = rb(r"^" + _NEXT_SRC, i=True)
 
 
 class ReadmeFile(LicenseFile):
@@ -60,5 +81,15 @@ class ReadmeFile(LicenseFile):
     def license_content(content: str | None) -> str | None:
         if content is None:
             return None
-        m = CONTENT_REGEX.search(content)
-        return ruby_strip(m.group(1)) if m else None
+        if "licen" not in content.lower():
+            # every heading the regex accepts contains licen[sc]e; the
+            # substring scan is ~10x cheaper than the regex scan for the
+            # no-section majority of a real README corpus (and `licen`
+            # needs no Unicode-lowercase subtleties: re.A is on anyway)
+            return None
+        m = _HEADING_REGEX.search(content)
+        if m is None:
+            return None
+        nxt = _NEXT_SECTION_REGEX.search(content, m.end())
+        section = content[m.end() : nxt.start() if nxt else len(content)]
+        return ruby_strip(section)

@@ -185,3 +185,47 @@ def test_cc_false_positive_regex():
         "Creative Commons Attribution-NonCommercial 4.0", "LICENSE.txt"
     )
     assert cc.potential_false_positive
+
+
+def test_readme_license_content_matches_one_shot_regex():
+    """license_content runs CONTENT_REGEX's halves as two linear scans
+    (plus a `licen` substring pre-check) for speed; this differential
+    pins it byte-equal to the one-shot regex over adversarial header
+    shapes (readme_file.rb:6-16 is the semantic source)."""
+    import random
+
+    from licensee_tpu.project_files.readme_file import CONTENT_REGEX
+    from licensee_tpu.rubytext import ruby_strip
+
+    def one_shot(content):
+        m = CONTENT_REGEX.search(content)
+        return ruby_strip(m.group(1)) if m else None
+
+    shapes = [
+        "# T\n\n## License\n\nMIT.\n\n## Usage\n\nrun\n",
+        "License\n-------\nbody here\nNext\n====\nx\n",
+        "= License =\nrdoc body\n= Next\n",
+        "## LICENCE:\ntext",
+        "## License",
+        "## License\n",
+        "no section at all\n",
+        "#License\nnot a heading (no space)\n",
+        "underlined\n--\n## license ##\ntail\nMore\n==\n",
+        "## License\n\n" + "word " * 3000 + "\n## End\n",
+        "licence:\n-\nbody\n",
+        "\n\n## license\n\n\n\n",
+        "## License\ntail with no terminator",
+        "intro\nLicense\n=\nA\nB\n--\nC\n",
+    ]
+    rng = random.Random(7)
+    toks = [
+        "## License\n", "License\n---\n", "body text\n", "# H\n",
+        "====\n", "word word\n", "\n", "x\n--\n", "= license\n",
+        "licence:?\n", "## L ##\n", "LiCeNsE\n===\n",
+    ]
+    shapes += [
+        "".join(rng.choice(toks) for _ in range(rng.randint(0, 12)))
+        for _ in range(500)
+    ]
+    for s in shapes:
+        assert ReadmeFile.license_content(s) == one_shot(s), repr(s[:80])

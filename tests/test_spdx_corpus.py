@@ -150,3 +150,109 @@ def test_cli_batch_detect_spdx_corpus(tmp_path, capsys):
     assert rc == 0
     row = json.loads(capsys.readouterr().out.strip())
     assert row["key"] == "mit"
+
+
+# -- the real upstream checkout layout (VERDICT r4 item 6) --
+#
+# github.com/spdx/license-list-XML lays out: license XMLs directly in
+# src/, exception XMLs in src/exceptions/, plus non-XML repo furniture
+# (schema, DOCS, .github).  The ingest contract is: compile every
+# license XML in src/, and ONLY those — the exceptions subtree and the
+# furniture must not leak into the template pool.
+
+def _upstream_shaped_checkout(tmp_path):
+    import os
+    import shutil
+
+    checkout = tmp_path / "license-list-XML"
+    src = checkout / "src"
+    src.mkdir(parents=True)
+    # real license XMLs (the vendored mirror IS upstream bytes)
+    for name in ("MIT.xml", "Apache-2.0.xml", "GPL-3.0.xml"):
+        shutil.copy(
+            os.path.join(vendor_paths.SPDX_DIR, name), src / name
+        )
+    # synthetic-but-schema-valid licenses fill the pool the way a full
+    # checkout would (the environment has no egress for the real ~600)
+    from licensee_tpu.corpus.spdx_synth import synth_spdx_dir
+
+    synth_spdx_dir(str(tmp_path / "synth"), 12)
+    for name in os.listdir(tmp_path / "synth"):
+        target = src / name
+        if not target.exists():
+            shutil.copy(tmp_path / "synth" / name, target)
+    # the exceptions subtree: same schema, must NOT be ingested
+    exceptions = src / "exceptions"
+    exceptions.mkdir()
+    shutil.copy(
+        os.path.join(vendor_paths.SPDX_DIR, "MIT.xml"),
+        exceptions / "Autoconf-exception-3.0.xml",
+    )
+    # repo furniture around src/
+    (checkout / "DOCS.md").write_text("# docs\n")
+    (checkout / "schema").mkdir()
+    (checkout / "schema" / "ListedLicense.xsd").write_text("<xsd/>\n")
+    (src / "README.md").write_text("not xml\n")
+    (src / "invalid.xml").write_text("<unclosed\n")  # malformed: skipped
+    return checkout
+
+
+def test_upstream_checkout_layout_compiles(tmp_path):
+    checkout = _upstream_shaped_checkout(tmp_path)
+    templates = load_spdx_dir(str(checkout / "src"))
+    keys = {t.key for t in templates}
+    assert {"mit", "apache-2.0", "gpl-3.0"} <= keys
+    assert len(templates) >= 14  # 3 real + >=11 synth fill
+    # the exceptions distractor and furniture stayed out
+    assert not any("exception" in t.key for t in templates)
+
+    corpus = spdx_corpus(str(checkout / "src"))
+    assert corpus.n_templates == len(templates)
+
+    # the README recipe's agreement step: every template's own rendered
+    # text classifies back to its key through the batch device path
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    clf = BatchClassifier(
+        corpus=corpus, pad_batch_to=32, mesh=None, method="popcount"
+    )
+    blobs = [t.content for t in templates[:16]]
+    results = clf.classify_blobs(blobs, prefilter=False)
+    got = [r.key for r in results]
+    want = [t.key for t in templates[:16]]
+    assert got == want, list(zip(got, want))
+
+
+def test_spdx_corpus_cli_over_checkout(tmp_path, capsys):
+    """`batch-detect --corpus <checkout>/src` — the CLI end of the
+    recipe (README 'Corpus refresh')."""
+    import json
+    import os
+
+    from licensee_tpu.cli.main import main
+
+    checkout = _upstream_shaped_checkout(tmp_path)
+    blob = tmp_path / "LICENSE"
+    mit = next(
+        t
+        for t in load_spdx_dir(str(checkout / "src"))
+        if t.key == "mit"
+    )
+    blob.write_text(
+        mit.content.replace(
+            "<copyright holders>", "Example Org"
+        )
+    )
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(str(blob) + "\n")
+    rc = main(
+        [
+            "batch-detect", str(manifest),
+            "--corpus", str(checkout / "src"),
+            "--method", "popcount", "--mesh", "none",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    row = json.loads(out.strip().splitlines()[-1])
+    assert row["key"] == "mit"
