@@ -7,7 +7,7 @@ classifying millions of candidate files against the template corpus.
 
 from __future__ import annotations
 
-__version__ = "0.1.0"
+__version__ = "1.0.0"
 
 # Over which percent a match is considered a match by default
 # (reference: lib/licensee.rb:21)

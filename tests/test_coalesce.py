@@ -213,3 +213,50 @@ def test_merge_prepared_empty_and_singleton(clf):
     q = _prepare(clf, ["x"], prefilter=False, preset=[row])
     merged = clf.merge_prepared([q, q])
     assert merged.todo == [] and merged.bits.shape[0] == 0
+
+
+def test_attribution_rides_coalesced_device_rows(tmp_path):
+    """--attribution on rows that reach the device (dice-matched, not
+    prefiltered) and finish through a merged multi-batch group: the
+    write loop must still find each row's raw content for the regex."""
+    mit = fixture_contents("mit/LICENSE.txt")
+    paths = []
+    for i in range(12):
+        d = tmp_path / f"r{i}"
+        d.mkdir()
+        p = d / "LICENSE"
+        if i % 4 == 0:
+            # one device row per 4-row batch: unique one-word tail ->
+            # no dedupe, no exact prefilter, still >= 98% dice
+            p.write_text(mit + f"\nzyxtail{i}")
+        else:
+            # exact-prefiltered on host: keeps each batch's todo sparse
+            # so the gather buffer accumulates MULTIPLE batches
+            p.write_text(mit)
+        paths.append(str(p))
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(
+        paths, batch_size=4, workers=1, inflight=1,
+        attribution=True, coalesce_batches=3,
+    )
+    group_sizes = []
+    orig = project.classifier.merge_prepared
+
+    def spying(group):
+        group_sizes.append(len(group))
+        return orig(group)
+
+    project.classifier.merge_prepared = spying
+    project.run(str(out), resume=False)
+    # the scenario under test really happened: a merged MULTI-batch group
+    assert any(g >= 2 for g in group_sizes), group_sizes
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["path"] for r in rows] == paths
+    dice_rows = [r for i, r in enumerate(rows) if i % 4 == 0]
+    assert all(
+        r["key"] == "mit" and r["matcher"] == "dice" for r in dice_rows
+    )
+    assert all(r["key"] == "mit" for r in rows)
+    assert all(
+        r["attribution"] == "Copyright (c) 2016 Ben Balter" for r in rows
+    )
