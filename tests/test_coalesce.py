@@ -260,3 +260,66 @@ def test_attribution_rides_coalesced_device_rows(tmp_path):
     assert all(
         r["attribution"] == "Copyright (c) 2016 Ben Balter" for r in rows
     )
+
+
+@pytest.mark.slow
+def test_coalesced_pipeline_differential_random_manifests(tmp_path):
+    """Property: for ANY manifest, run() with coalescing (cap 8) writes
+    byte-identical JSONL to run() with coalescing disabled (cap 1).
+    Random mix of modes, duplicate densities, readmes/packages/sources,
+    unreadable paths, and attribution."""
+    import random
+
+    rng = random.Random(20260730)
+    mit = fixture_contents("mit/LICENSE.txt")
+    gpl = fixture_contents("gpl-3.0_markdown/LICENSE.md")
+
+    # a pool of on-disk files covering every route
+    pool = []
+    pooldir = tmp_path / "pool"
+    pooldir.mkdir()
+    for i in range(40):
+        kind = rng.randrange(6)
+        d = pooldir / f"d{i}"
+        d.mkdir()
+        if kind == 0:
+            p = d / "LICENSE"
+            p.write_text(mit + (f"\nzz{i}" if rng.random() < 0.5 else ""))
+        elif kind == 1:
+            p = d / "LICENSE.md"
+            p.write_text(gpl if rng.random() < 0.7 else f"no license {i}")
+        elif kind == 2:
+            p = d / "README.md"
+            body = (
+                "## License\n\nReleased under the MIT License.\n"
+                if rng.random() < 0.5
+                else "## Usage\n\nnothing here\n"
+            )
+            p.write_text(f"# P{i}\n\n" + body)
+        elif kind == 3:
+            p = d / "package.json"
+            p.write_text('{"license": "Apache-2.0"}')
+        elif kind == 4:
+            p = d / f"mod{i}.c"
+            p.write_text(f"int f{i}(void);\n")
+        else:
+            p = d / "LICENSE"  # never created -> read_error row
+        pool.append(str(p))
+
+    for trial, mode in enumerate(("license", "auto", "readme")):
+        entries = [rng.choice(pool) for _ in range(120)]
+        outs = []
+        for cap in (1, 8):
+            out = tmp_path / f"out_{mode}_{cap}.jsonl"
+            project = BatchProject(
+                entries,
+                batch_size=8,
+                workers=2,
+                mode=mode,
+                attribution=(mode != "readme"),
+                coalesce_batches=cap,
+                dedupe=(trial != 1),
+            )
+            project.run(str(out), resume=False)
+            outs.append(out.read_text())
+        assert outs[0] == outs[1], f"mode={mode}: coalesced diverged"
