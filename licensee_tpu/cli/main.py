@@ -316,6 +316,7 @@ def cmd_batch_detect(args) -> int:
             attribution=args.attribution,
             featurize_procs=args.featurize_procs,
             progress_every=args.progress,
+            coalesce_batches=args.coalesce_batches,
             **kwargs,
         )
     except OSError as exc:
@@ -534,19 +535,22 @@ def build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--batch-size", type=int, default=4096)
     batch.add_argument("--workers", type=int, default=None,
                        help="Featurization worker threads (default: cpu count)")
-    def nonneg(kind):
+    def bounded(kind, lo):
         # fail the typo in argparse, not after a 50M-line manifest loads
         def parse(value):
             v = kind(value)
-            if not (v >= 0):  # rejects negatives AND NaN
+            if not (v >= lo):  # rejects out-of-range AND NaN
                 raise argparse.ArgumentTypeError(
-                    f"must be >= 0, got {value!r}"
+                    f"must be >= {lo}, got {value!r}"
                 )
             return v
 
         # argparse embeds the callable's name in "invalid ... value"
-        parse.__name__ = f"non-negative {kind.__name__}"
+        parse.__name__ = f">={lo} {kind.__name__}"
         return parse
+
+    def nonneg(kind):
+        return bounded(kind, 0)
 
     batch.add_argument(
         "--featurize-procs", type=nonneg(int), default=0, metavar="N",
@@ -555,6 +559,15 @@ def build_parser() -> argparse.ArgumentParser:
             "insurance for hosts where the native pipeline is absent and "
             "thread scaling disappoints; bit-identical output, resume "
             "unchanged).  Threads win when the native pipeline is up"
+        ),
+    )
+    batch.add_argument(
+        "--coalesce-batches", type=bounded(int, 1), default=32, metavar="N",
+        help=(
+            "How many produced batches may wait while their sparse "
+            "device rows (dedupe-heavy manifests) accumulate into full "
+            "device chunks — amortizes the per-dispatch round trip; 1 "
+            "disables coalescing (default 32)"
         ),
     )
     batch.add_argument("--stats", action="store_true",

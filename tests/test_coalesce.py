@@ -323,3 +323,29 @@ def test_coalesced_pipeline_differential_random_manifests(tmp_path):
             project.run(str(out), resume=False)
             outs.append(out.read_text())
         assert outs[0] == outs[1], f"mode={mode}: coalesced diverged"
+
+
+def test_cli_coalesce_batches_flag(tmp_path):
+    from licensee_tpu.cli.main import main
+
+    mit = fixture_contents("mit/LICENSE.txt")
+    for i in range(3):
+        d = tmp_path / f"c{i}"
+        d.mkdir()
+        (d / "LICENSE").write_text(mit)
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(
+        "\n".join(str(tmp_path / f"c{i}" / "LICENSE") for i in range(3)) + "\n"
+    )
+    out = tmp_path / "out.jsonl"
+    rc = main([
+        "batch-detect", str(manifest), "--output", str(out),
+        "--coalesce-batches", "4", "--mesh", "none", "--no-resume",
+    ])
+    assert rc == 0
+    assert len(out.read_text().splitlines()) == 3
+    # validation at the argparse layer, before any manifest loads
+    with pytest.raises(SystemExit):
+        main([
+            "batch-detect", str(manifest), "--coalesce-batches", "0",
+        ])
