@@ -351,12 +351,22 @@ def cmd_batch_detect(args) -> int:
                     file=sys.stderr,
                 )
                 return 1
+            from licensee_tpu.projects.batch_project import (
+                ResumeConfigError,
+            )
+
             try:
                 stats = project.run(args.output, resume=not args.no_resume)
             except OSError as exc:
                 print(
                     f"error: batch run I/O failure: {exc}", file=sys.stderr
                 )
+                return 1
+            except ResumeConfigError as exc:
+                # a resume whose mode/corpus/threshold differs from the
+                # run that wrote the output (the .meta.json sidecar);
+                # any other ValueError keeps its traceback — it's a bug
+                print(f"error: {exc}", file=sys.stderr)
                 return 1
         else:
             # the shared route -> read -> classify -> attribute pass

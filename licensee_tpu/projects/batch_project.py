@@ -65,6 +65,11 @@ import numpy as np
 import licensee_tpu
 from licensee_tpu.kernels.batch import BlobResult
 
+class ResumeConfigError(ValueError):
+    """A resume whose row-shaping config (mode/corpus/threshold/closest/
+    attribution) differs from the run that wrote the output file."""
+
+
 # placeholder for a row that duplicates an earlier row of the SAME batch:
 # prepare_batch skips it like any preset row, and run() replaces it with
 # the original's finished result before anything reads it.  The error
@@ -528,6 +533,64 @@ class BatchProject:
             cache=self._dedupe_cache if self.dedupe else None,
         ))
 
+    def _run_config(self) -> dict:
+        """Everything that changes the CONTENT of an output row.
+
+        Written beside the output as ``<output>.meta.json`` so a resumed
+        run can prove it is appending rows of the same shape — resuming a
+        ``--mode license`` file with ``--mode package`` (or a different
+        corpus, threshold, closest-K, or attribution setting) would
+        silently mix incompatible rows in one file otherwise."""
+        import hashlib
+
+        corpus = self.classifier.corpus
+        corpus_id = None
+        if corpus is not None:  # package mode is host-only, corpus-free
+            corpus_id = {
+                "templates": corpus.n_templates,
+                "vocab": len(corpus.vocab),
+                "keys_sha1": hashlib.sha1(
+                    "\n".join(corpus.keys).encode(), usedforsecurity=False
+                ).hexdigest(),
+            }
+        return {
+            "mode": self.mode,
+            "corpus": corpus_id,
+            "threshold": self.threshold,
+            "closest": self.classifier.closest,
+            "attribution": self.attribution,
+        }
+
+    def _check_resume_config(self, output: str, resume: bool) -> dict:
+        """Refuse a resume whose config would produce different rows.
+
+        Returns the config dict; the caller writes it to the sidecar
+        AFTER the output file is opened (so a crash can never leave a
+        fresh sidecar describing stale rows — at worst an empty/truncated
+        output sits beside the previous sidecar, and the stale sidecar
+        then refuses in the safe direction)."""
+        meta_path = f"{output}.meta.json"
+        config = self._run_config()
+        if resume and os.path.exists(output) and os.path.exists(meta_path):
+            with open(meta_path, encoding="utf-8") as f:
+                try:
+                    prior = json.load(f)
+                except json.JSONDecodeError:
+                    prior = None  # torn sidecar: rewritten by this run
+            if prior is not None and prior != config:
+                diffs = [
+                    k
+                    for k in config
+                    if prior.get(k) != config[k]
+                ]
+                raise ResumeConfigError(
+                    f"cannot resume {output!r}: this run's configuration "
+                    f"differs from the one that wrote it ({', '.join(diffs)}"
+                    f" changed — {meta_path}); rerun with matching "
+                    "settings, a fresh --output, or --no-resume"
+                )
+        return config
+
     def run(self, output: str, resume: bool = True) -> BatchStats:
         if self.process_count > 1:
             from licensee_tpu.parallel.distributed import shard_output_path
@@ -535,6 +598,7 @@ class BatchProject:
             output = shard_output_path(
                 output, self.process_index, self.process_count
             )
+        run_config = self._check_resume_config(output, resume)
         done = 0
         if resume and os.path.exists(output):
             done = self._resume_point(output)
@@ -561,6 +625,11 @@ class BatchProject:
         else:
             pool = ThreadPoolExecutor(max_workers=self.workers)
         with pool, open(output, mode, encoding="utf-8") as out:
+            # sidecar AFTER the output open/truncate: see
+            # _check_resume_config for the crash-window rationale
+            with open(f"{output}.meta.json", "w", encoding="utf-8") as f:
+                json.dump(run_config, f)
+                f.write("\n")
             futures: deque = deque()
 
             def submit_next() -> None:

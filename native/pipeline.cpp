@@ -353,15 +353,24 @@ struct Pipeline {
       const char *http = static_cast<const char *>(
           memmem(base, c.size(), "http:", 5));
       if (amp || http) {
+        // kAbsent = "definitively not in the remaining tail" (sticky:
+        // the subject never mutates, so a failed scan never repeats);
+        // nullptr = "consumed, position unknown — rescan once".  A live
+        // cached hit is always at/after i: neither needle can sit
+        // inside the other's replaced span ("http:" has no '&' and
+        // vice versa), so consuming one never invalidates the other.
+        const char *kAbsent = base + c.size();
+        if (!amp) amp = kAbsent;
+        if (!http) http = kAbsent;
         std::string r;
         r.reserve(c.size() + 16);
         size_t i = 0;
-        // cached-or-rescan: a cached hit at/after i is still valid (the
-        // subject never mutates); a consumed hit is nulled by its branch
-        auto resolve = [&](const char *cached, auto rescan) {
-          const char *p =
-              cached && cached >= base + i ? cached : rescan();
-          return p ? static_cast<size_t>(p - base) : c.size();
+        auto resolve = [&](const char *&cached, auto rescan) -> size_t {
+          if (cached == nullptr) {
+            cached = rescan();
+            if (cached == nullptr) cached = kAbsent;
+          }
+          return static_cast<size_t>(cached - base);
         };
         while (i < c.size()) {
           size_t a = resolve(amp, [&] {
@@ -372,15 +381,13 @@ struct Pipeline {
             return static_cast<const char *>(
                 memmem(base + i, c.size() - i, "http:", 5));
           });
-          amp = a < c.size() ? base + a : nullptr;
-          http = h < c.size() ? base + h : nullptr;
           size_t next = a < h ? a : h;
           if (next >= c.size()) break;
           r.append(c, i, next - i);
           if (a < h) {
             r += "and";
             i = next + 1;
-            amp = nullptr;  // consumed; re-scan from the new tail
+            amp = nullptr;  // consumed; re-scan once from the new tail
           } else {
             r += "https:";
             i = next + 5;

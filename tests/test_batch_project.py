@@ -6,7 +6,7 @@ import os
 import pytest
 
 from licensee_tpu.projects.batch_project import BatchProject
-from tests.conftest import FIXTURES_DIR, fixture_path
+from tests.conftest import FIXTURES_DIR, fixture_contents, fixture_path
 
 
 def manifest_paths():
@@ -340,3 +340,81 @@ def test_dedupe_key_carries_filename_dispatch(tmp_path):
     project2 = BatchProject(paths, batch_size=1, workers=1, inflight=1)
     project2.run(str(tmp_path / "out2.jsonl"), resume=False)
     assert project2.stats.dedupe_hits >= 1  # names differ, dispatch same
+
+
+# -- the resume-compatibility sidecar (<output>.meta.json) --
+
+def test_resume_config_mismatch_is_refused(tmp_path):
+    """Resuming an output written under a different mode/config must
+    fail loudly instead of silently mixing incompatible rows."""
+    mit = fixture_contents("mit/LICENSE.txt")
+    p = tmp_path / "LICENSE"
+    p.write_text(mit)
+    paths = [str(p)] * 4
+    out = tmp_path / "out.jsonl"
+    BatchProject(paths[:2], batch_size=2, workers=1).run(
+        str(out), resume=False
+    )
+    assert (tmp_path / "out.jsonl.meta.json").exists()
+
+    # same config resumes fine (and re-writes the sidecar)
+    BatchProject(paths, batch_size=2, workers=1).run(str(out), resume=True)
+    assert len(out.read_text().splitlines()) == 4
+
+    # different mode: refused, output untouched
+    before = out.read_text()
+    with pytest.raises(ValueError, match="mode"):
+        BatchProject(
+            paths, batch_size=2, workers=1, mode="package", mesh=None
+        ).run(str(out), resume=True)
+    assert out.read_text() == before
+
+    # different threshold: refused too
+    with pytest.raises(ValueError, match="threshold"):
+        BatchProject(paths, batch_size=2, workers=1, threshold=90.0).run(
+            str(out), resume=True
+        )
+
+    # resume=False overwrites both output and sidecar
+    BatchProject(
+        paths[:2], batch_size=2, workers=1, threshold=90.0
+    ).run(str(out), resume=False)
+    assert len(out.read_text().splitlines()) == 2
+
+
+def test_resume_without_sidecar_is_accepted(tmp_path):
+    """Outputs from before the sidecar existed (or with a deleted
+    sidecar) must keep resuming — the check is best-effort."""
+    import os
+
+    mit = fixture_contents("mit/LICENSE.txt")
+    p = tmp_path / "LICENSE"
+    p.write_text(mit)
+    out = tmp_path / "out.jsonl"
+    BatchProject([str(p)] * 2, batch_size=2, workers=1).run(
+        str(out), resume=False
+    )
+    os.unlink(tmp_path / "out.jsonl.meta.json")
+    BatchProject([str(p)] * 4, batch_size=2, workers=1).run(
+        str(out), resume=True
+    )
+    assert len(out.read_text().splitlines()) == 4
+    assert (tmp_path / "out.jsonl.meta.json").exists()  # re-written
+
+
+def test_resume_mismatch_cli_error(tmp_path, capsys):
+    from licensee_tpu.cli.main import main
+
+    mit = fixture_contents("mit/LICENSE.txt")
+    (tmp_path / "LICENSE").write_text(mit)
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(str(tmp_path / "LICENSE") + "\n")
+    out = tmp_path / "out.jsonl"
+    rc = main(["batch-detect", str(manifest), "--output", str(out),
+               "--mesh", "none"])
+    assert rc == 0
+    rc = main(["batch-detect", str(manifest), "--output", str(out),
+               "--mesh", "none", "--mode", "auto"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "cannot resume" in err and "mode" in err
