@@ -123,7 +123,8 @@ def test_worker_state_roundtrip():
     try:
         chunk = [fixture_path("mit/LICENSE.txt")]
         (paths, read_errs, keys, preset, dup_of, routes, prepared,
-         contents, _times) = bp._mp_produce(chunk, "license", True, False)
+         contents, pre_rows,
+         _times) = bp._mp_produce(chunk, "license", True, False)
         assert paths == chunk
         assert read_errs == [False]
         assert keys[0] is not None
