@@ -349,3 +349,33 @@ def test_cli_coalesce_batches_flag(tmp_path):
         main([
             "batch-detect", str(manifest), "--coalesce-batches", "0",
         ])
+
+
+def test_merged_group_spanning_multiple_device_chunks():
+    """A coalesced group whose todo rows exceed pad_batch_to must split
+    into several padded chunks and still scatter correctly."""
+    clf = BatchClassifier(pad_batch_to=4)
+    mit = fixture_contents("mit/LICENSE.txt")
+    gpl = fixture_contents("gpl-3.0_markdown/LICENSE.md")
+    batches = [
+        [mit + f" a{i}", gpl + f" b{i}", f"plain words {i} " * 30]
+        for i in range(3)
+    ]  # 9 todo rows -> 3 chunks of pad 4
+    want = [
+        [r.key for r in clf.classify_blobs(b, prefilter=False)]
+        for b in batches
+    ]
+    prepared = [
+        clf.prepare_batch(b, prefilter=False) for b in batches
+    ]
+    for p in prepared:
+        p.compact_features()
+    merged = clf.merge_prepared(prepared)
+    assert len(merged.todo) == 9 > clf.pad_batch_to
+    outs = clf.dispatch_chunks(merged)
+    assert len(outs) == 3  # ceil(9 / 4) padded chunks
+    clf.finish_chunks(merged, outs, 98.0)
+    BatchClassifier.scatter_merged(prepared, merged)
+    got = [[r.key for r in p.results] for p in prepared]
+    assert got == want
+    assert want[0][0] == "mit" and want[0][1] == "gpl-3.0"
