@@ -1,7 +1,10 @@
-"""Command-line interface: detect / diff / license-path / version / batch-detect.
+"""Command-line interface: detect / diff / license-path / version /
+batch-detect / serve.
 
 Parity target: `bin/licensee` + `lib/licensee/commands/*.rb` (Thor CLI).
 `batch-detect` is new: the TPU batch path over a manifest of files.
+`serve` is new: the persistent online micro-batching worker (JSONL over
+stdio or a Unix socket, serve/).
 """
 
 from __future__ import annotations
@@ -249,21 +252,10 @@ def cmd_batch_detect(args) -> int:
     --output, the full pipelined BatchProject runs: featurization worker
     threads, double-buffered device dispatch, resume-on-restart, and
     per-stage timers (--stats)."""
-    kwargs = {}
-    if args.corpus and args.corpus != "vendored":
-        from licensee_tpu.corpus.spdx import spdx_corpus
-
-        try:
-            corpus = spdx_corpus(None if args.corpus == "spdx" else args.corpus)
-        except OSError as exc:
-            print(f"error: cannot load corpus {args.corpus!r}: {exc}",
-                  file=sys.stderr)
-            return 1
-        if not corpus.n_templates:
-            print(f"error: no license templates found in {args.corpus!r}",
-                  file=sys.stderr)
-            return 1
-        kwargs["corpus"] = corpus
+    kwargs, err = _load_corpus(args.corpus)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
     if not os.path.exists(args.manifest):
         print(
             f"error: cannot read manifest: {args.manifest!r} not found",
@@ -398,6 +390,93 @@ def cmd_batch_detect(args) -> int:
     return 0
 
 
+def _load_corpus(corpus_arg: str):
+    """Resolve a --corpus value to (kwargs-with-corpus | error message).
+    Shared by batch-detect and serve."""
+    kwargs = {}
+    if corpus_arg and corpus_arg != "vendored":
+        from licensee_tpu.corpus.spdx import spdx_corpus
+
+        try:
+            corpus = spdx_corpus(None if corpus_arg == "spdx" else corpus_arg)
+        except OSError as exc:
+            return None, f"cannot load corpus {corpus_arg!r}: {exc}"
+        if not corpus.n_templates:
+            return None, f"no license templates found in {corpus_arg!r}"
+        kwargs["corpus"] = corpus
+    return kwargs, None
+
+
+def cmd_serve(args) -> int:
+    """The online serving worker: a persistent micro-batching front end
+    over the device scorer (serve/scheduler.py).  Speaks newline-
+    delimited JSON on stdin/stdout, or on a Unix domain socket with
+    --socket (one session per connection, one shared cache/batcher).
+    The `{"op": "stats"}` verb dumps scheduler/cache/latency counters."""
+    from licensee_tpu.serve.server import selftest, serve_stdio, serve_unix
+
+    if args.selftest:
+        return selftest()
+
+    kwargs, err = _load_corpus(args.corpus)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    mesh = None
+    if args.mesh and args.mesh != "none":
+        if args.mesh == "auto":
+            mesh = "auto"
+        else:
+            try:
+                parts = [int(p) for p in args.mesh.split(",")]
+                mesh = (parts[0], parts[1] if len(parts) > 1 else 1)
+            except ValueError:
+                print(f"error: bad --mesh {args.mesh!r} (want DATA[,MODEL])",
+                      file=sys.stderr)
+                return 1
+    buckets = None
+    if args.buckets:
+        try:
+            buckets = tuple(int(b) for b in args.buckets.split(","))
+        except ValueError:
+            print(f"error: bad --buckets {args.buckets!r} (want N,N,...)",
+                  file=sys.stderr)
+            return 1
+
+    from licensee_tpu.serve.scheduler import MicroBatcher
+
+    try:
+        batcher = MicroBatcher(
+            method=args.method,
+            mode=args.mode,
+            mesh=mesh,
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            queue_depth=args.queue_depth,
+            cache_entries=args.cache_entries,
+            deadline_ms=args.deadline_ms,
+            threshold=args.confidence,
+            buckets=buckets,
+            **kwargs,
+        )
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    try:
+        if args.socket:
+            print(f"serving on {args.socket}", file=sys.stderr)
+            serve_unix(batcher, args.socket)
+        else:
+            serve_stdio(batcher)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        batcher.close()
+        if args.stats:
+            print(json.dumps(batcher.stats()), file=sys.stderr)
+    return 0
+
+
 # the one command table: build_parser() wires each entry into argparse
 # and cmd_help() prints it — no argparse-private introspection (the
 # Thor-style listing of /root/reference/bin/licensee:10-43)
@@ -408,6 +487,7 @@ COMMANDS = (
     ("version", "Print the version"),
     ("help", "Describe available commands"),
     ("batch-detect", "Classify a manifest of files on the TPU batch path"),
+    ("serve", "Run the online micro-batching classification worker"),
 )
 _COMMAND_HELP = dict(COMMANDS)
 
@@ -594,6 +674,110 @@ def build_parser() -> argparse.ArgumentParser:
                        help="Write a jax.profiler trace to DIR")
     batch.set_defaults(func=cmd_batch_detect)
 
+    serve = sub.add_parser("serve", help=_COMMAND_HELP["serve"])
+    serve.add_argument(
+        "--socket", default=None, metavar="PATH",
+        help=(
+            "Serve on a Unix domain socket (one JSONL session per "
+            "connection, shared cache); default is one session on "
+            "stdin/stdout"
+        ),
+    )
+    serve.add_argument(
+        "--mode", default="license",
+        choices=["license", "readme", "package", "auto"],
+        help=(
+            "Which matcher chain requests run (same semantics as "
+            "batch-detect; 'auto' routes each request by its filename)"
+        ),
+    )
+    serve.add_argument(
+        "--corpus", default="vendored",
+        help=(
+            "Template pool: 'vendored' (default), 'spdx', or a path to "
+            "an SPDX license-list-XML src/ directory"
+        ),
+    )
+    serve.add_argument(
+        "--method", default="auto",
+        choices=["auto", "popcount", "matmul", "pallas", "pallas-mxu"],
+        help="Device scoring path (same as batch-detect)",
+    )
+    serve.add_argument(
+        "--mesh", default=None, metavar="DATA[,MODEL]",
+        help=(
+            "Device mesh for the scorer ('auto' = all visible devices "
+            "data-parallel; default: single device — bucket shapes are "
+            "rounded up to the data axis)"
+        ),
+    )
+    serve.add_argument(
+        "--max-batch", type=bounded(int, 1), default=256, metavar="N",
+        help=(
+            "Flush a micro-batch as soon as N Dice-bound requests are "
+            "waiting (default 256)"
+        ),
+    )
+    serve.add_argument(
+        "--max-delay-ms", type=nonneg(float), default=5.0, metavar="MS",
+        help=(
+            "Flush a PARTIAL micro-batch once its oldest request has "
+            "waited MS milliseconds — the latency bound (default 5)"
+        ),
+    )
+    serve.add_argument(
+        "--queue-depth", type=bounded(int, 1), default=1024, metavar="N",
+        help=(
+            "Bounded admission queue: a request arriving with N "
+            "Dice-bound rows already waiting is rejected with "
+            "retry_after instead of buffered (default 1024)"
+        ),
+    )
+    serve.add_argument(
+        "--cache-entries", type=nonneg(int), default=65536, metavar="N",
+        help=(
+            "Content-hash LRU result cache capacity; 0 disables "
+            "(default 65536)"
+        ),
+    )
+    serve.add_argument(
+        "--deadline-ms", type=nonneg(float), default=0.0, metavar="MS",
+        help=(
+            "Default per-request deadline: a request still queued after "
+            "MS milliseconds answers deadline_exceeded instead of "
+            "occupying a device slot; 0 = none (per-request "
+            "deadline_ms overrides)"
+        ),
+    )
+    serve.add_argument(
+        "--buckets", default=None, metavar="N,N,...",
+        help=(
+            "Padded device batch shapes (ascending); each compiles "
+            "once and partial flushes pad to the smallest fitting "
+            "bucket (default: a x4 ladder up to --max-batch)"
+        ),
+    )
+    serve.add_argument(
+        "--confidence", type=float, default=None, metavar="N",
+        help=(
+            "Minimum Dice confidence for a match (default: the global "
+            f"threshold, {licensee_tpu.CONFIDENCE_THRESHOLD})"
+        ),
+    )
+    serve.add_argument(
+        "--stats", action="store_true",
+        help="Dump the final stats JSON to stderr at shutdown",
+    )
+    serve.add_argument(
+        "--selftest", action="store_true",
+        help=(
+            "Run an in-process end-to-end session (exact prefilter, "
+            "Dice micro-batch, cache hit, stats verb) and exit 0/1 — "
+            "the CI smoke"
+        ),
+    )
+    serve.set_defaults(func=cmd_serve)
+
     # the COMMANDS table and the registered subcommands must not drift:
     # `help` prints from the table, the parser dispatches from argparse
     if set(sub.choices) != {name for name, _ in COMMANDS}:
@@ -607,7 +791,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "-h", "--help"}
+    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

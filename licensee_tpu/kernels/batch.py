@@ -877,16 +877,31 @@ class BatchClassifier:
         self.finish_chunks(prepared, outs, threshold)
         return prepared.results  # type: ignore[return-value]
 
-    def dispatch_chunks(self, prepared: PreparedBatch):
+    def dispatch_chunks(self, prepared: PreparedBatch, pad_to: int | None = None):
         """Launch device scoring for the ``todo`` rows in fixed-size padded
         chunks.  The returned device outputs are lazy (JAX dispatch is
         asynchronous): the host featurizes the next batch while the device
-        scores this one; finish_chunks() synchronizes."""
+        scores this one; finish_chunks() synchronizes.
+
+        ``pad_to`` overrides the chunk shape for this dispatch — the
+        online micro-batcher (serve/scheduler.py) pads each flush to the
+        smallest fitting BUCKET so a 3-row deadline flush doesn't pay a
+        4096-row padded batch.  Each distinct shape jit-compiles once
+        and is reused forever after (the bucket list is fixed), so the
+        steady state never recompiles per request."""
         if prepared.todo and self._fn is None:
             raise RuntimeError(
                 "device=False classifier cannot dispatch (featurize "
                 "workers only prepare batches)"
             )
+        if pad_to is not None:
+            if pad_to < 1:
+                raise ValueError(f"pad_to must be >= 1, got {pad_to!r}")
+            if self.mesh is not None and pad_to % self.mesh.shape["data"]:
+                raise ValueError(
+                    f"pad_to={pad_to} is not divisible by the data axis "
+                    f"({self.mesh.shape['data']})"
+                )
         bits, n_words, lengths, cc_fp, todo = (
             prepared.bits,
             prepared.n_words,
@@ -895,7 +910,7 @@ class BatchClassifier:
             prepared.todo,
         )
         outs = []
-        B = self.pad_batch_to
+        B = int(pad_to) if pad_to is not None else self.pad_batch_to
         for start in range(0, len(todo), B):
             chunk = todo[start : start + B]
             # compacted batches store only the todo rows: row j <-> todo[j]

@@ -51,7 +51,6 @@ details.host_model on every run.
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import sys
@@ -65,185 +64,26 @@ import numpy as np
 import licensee_tpu
 from licensee_tpu.kernels.batch import BlobResult
 
+# The produce-stage core (route + read + dedupe-key + prefilter +
+# featurize + row rendering) is shared with the online serving path —
+# serve/featurize.py holds the one implementation, so the offline and
+# online chains cannot drift.  The private aliases keep this module's
+# long-standing names (tests and the _mp_* workers use them).
+from licensee_tpu.serve.featurize import (
+    IN_BATCH_DUP as _IN_BATCH_DUP,
+    UNROUTED as _UNROUTED,
+    json_str as _json_str,
+    jsonl_row as _jsonl_row,
+    produce_batch as _produce_batch,
+    read_capped as _read_capped,
+)
+
+__all__ = ["BatchProject", "BatchStats", "ResumeConfigError"]
+
+
 class ResumeConfigError(ValueError):
     """A resume whose row-shaping config (mode/corpus/threshold/closest/
     attribution) differs from the run that wrote the output file."""
-
-
-# placeholder for a row that duplicates an earlier row of the SAME batch:
-# prepare_batch skips it like any preset row, and run() replaces it with
-# the original's finished result before anything reads it.  The error
-# marker makes an accidental leak visible instead of silent.
-_IN_BATCH_DUP = BlobResult(None, None, 0.0, error="in_batch_dup_unresolved")
-
-# the shared row for --mode auto entries no filename table scores: the
-# file is never read, never hashed, never featurized (find_files drops
-# score-0 names before load_file, project.rb:111-124).  Finished results
-# are never mutated, so one frozen instance serves every such row.
-_UNROUTED = BlobResult(None, None, 0.0)
-
-
-def _read_capped(path: str) -> bytes | None:
-    """Read at most 64 KiB — the MAX_LICENSE_SIZE cap (git_project.rb:53);
-    None on any OS error (the caller reports a read_error row).  The one
-    read policy for every ingestion path."""
-    try:
-        with open(path, "rb") as f:
-            return f.read(64 * 1024)
-    except OSError:
-        return None
-
-
-@functools.lru_cache(maxsize=4096)
-def _json_str(s: str | None) -> str:
-    """json.dumps memoized per distinct value: keys and matcher names
-    come from a small fixed pool, so the 10M-row writer pays the real
-    escaping logic once per unique string instead of per row."""
-    return "null" if s is None else json.dumps(s)
-
-
-def _jsonl_row(path: str, result, error: str | None) -> str:
-    """One output row as JSON, ~4x faster than json.dumps(dict).
-
-    json.dumps in the 10M-row writer loop is a real serial cost (~9 us a
-    row); the confidence is a float whose repr IS its JSON form, and the
-    key/matcher strings are escape-memoized, so only the path (and the
-    rare error) pays a real dumps."""
-    row = (
-        f'{{"path": {json.dumps(path)}, "key": {_json_str(result.key)}, '
-        f'"matcher": {_json_str(result.matcher)}, '
-        f'"confidence": {result.confidence!r}'
-    )
-    if result.closest is not None:
-        inner = ", ".join(
-            f"[{_json_str(k)}, {c!r}]" for k, c in result.closest
-        )
-        row += f', "closest": [{inner}]'
-    if result.attribution is not None:
-        row += f', "attribution": {json.dumps(result.attribution)}'
-    if error is not None:
-        row += f', "error": {json.dumps(error)}'
-    return row + "}"
-
-
-def _produce_batch(
-    classifier, chunk, mode, dedupe, attribution, cache=None
-):
-    """The produce stage, shared by the thread path (live ``cache``) and
-    the worker-process path (``cache=None`` — the cross-batch cache
-    lives in the parent, which applies it on receipt).
-
-    In auto mode the filename routes FIRST: a manifest entry no score
-    table claims skips the read, the hash, and the device entirely — on
-    a 50M mixed manifest the unrecognized majority costs one regex scan
-    of the basename and nothing else."""
-    import hashlib
-
-    from licensee_tpu.kernels.batch import BatchClassifier
-
-    filenames = [os.path.basename(p) for p in chunk]
-    routes: list | None = None
-    if mode == "auto":
-        routes = [BatchClassifier.route_for(f) for f in filenames]
-    t0 = time.perf_counter()
-    contents = [
-        _read_capped(p)
-        if routes is None or routes[i] is not None
-        else b""
-        for i, p in enumerate(chunk)
-    ]
-    t1 = time.perf_counter()
-    keys: list = [None] * len(chunk)
-    preset: list = [None] * len(chunk)
-    dup_of: dict[int, int] = {}
-    if routes is not None:
-        for i, route in enumerate(routes):
-            if route is None:
-                preset[i] = _UNROUTED
-    if dedupe:
-        if attribution:
-            from licensee_tpu.project_files.license_file import (
-                COPYRIGHT_NAME_REGEX,
-            )
-        first_seen: dict = {}
-        for i, c in enumerate(contents):
-            if c is None or preset[i] is not None:
-                continue
-            route = routes[i] if routes is not None else mode
-            # package: the whole matcher table reads the filename;
-            # license/readme: only the HTML gate does.  The route is
-            # part of the key, so a mixed manifest never shares a
-            # cached result across chains.  With --attribution on, the
-            # copyright? filename gate (project_file.rb:94) also feeds
-            # the result, so its bit joins the key — COPYRIGHT and
-            # LICENSE holding identical bytes attribute differently and
-            # must not share a cache slot.
-            if route == "package":
-                dispatch = (route, filenames[i])
-            else:
-                dispatch = (route, BatchClassifier._is_html(filenames[i]))
-                if attribution:
-                    dispatch += (
-                        bool(COPYRIGHT_NAME_REGEX.search(filenames[i])),
-                    )
-            # usedforsecurity=False: a cache key, not crypto — and
-            # FIPS-mode OpenSSL would otherwise refuse sha1 entirely
-            keys[i] = (
-                dispatch,
-                hashlib.sha1(c, usedforsecurity=False).digest(),
-            )
-            if cache is not None:
-                preset[i] = cache.get(keys[i])
-            if preset[i] is None:
-                # in-batch dedupe: repeats of a key first seen in THIS
-                # batch are featurized/scored once and copied after
-                # finish (no cross-batch pipeline lag)
-                j = first_seen.setdefault(keys[i], i)
-                if j != i:
-                    dup_of[i] = j
-                    preset[i] = _IN_BATCH_DUP
-    prepared = classifier.prepare_batch(
-        [c if c is not None else b"" for c in contents],
-        filenames=filenames,
-        preset=preset,
-        routes=routes,
-    )
-    # pre-render JSONL for rows whose result is already FINAL here (cache
-    # hits and unrouted rows — the preset non-dup rows): their ~1us/row
-    # of row formatting moves off the writer's serial section and onto
-    # the parallel produce workers.  A preset row can never be a read
-    # error (unreadable paths stay preset=None; unrouted paths are never
-    # read) and never carries an error result (the cache only stores
-    # clean rows), so the line is exactly what the write loop would emit.
-    pre_rows: list | None = None
-    for i, p in enumerate(preset):
-        if p is not None and p is not _IN_BATCH_DUP:
-            if pre_rows is None:
-                pre_rows = [None] * len(chunk)
-            pre_rows[i] = _jsonl_row(chunk[i], p, None)
-    t2 = time.perf_counter()
-    read_errs = [c is None for c in contents]
-    if attribution:
-        # keep raw contents ONLY for rows that can still need the
-        # attribution regex (license/readme route, not already finished
-        # as unmatched, not a preset/dup row) — in process mode every
-        # kept row is pickled parent-ward, up to 64 KiB each
-        kept = []
-        for i, c in enumerate(contents):
-            route = routes[i] if routes is not None else mode
-            r = prepared.results[i]
-            need = (
-                route in ("license", "readme")
-                and preset[i] is None
-                and (r is None or (r.key is not None and not r.error))
-            )
-            kept.append(c if need else None)
-        contents = kept
-    return (
-        read_errs, keys, preset, dup_of, routes, prepared,
-        contents if attribution else None, pre_rows,
-        (t1 - t0, t2 - t1),
-    )
 
 
 # -- process-pool featurization (--featurize-procs) --

@@ -1,0 +1,52 @@
+"""Online serving subsystem: dynamic micro-batching over the device
+scorer, with a content-hash result cache and explicit backpressure.
+
+The offline path (projects/batch_project.py) consumes a pre-built
+manifest in strict order; this package is the long-running front end
+that accepts requests AS THEY ARRIVE, runs the host prefilter chain at
+admission, coalesces Dice-bound blobs into padded bucket-shaped device
+batches (compiled shapes are reused, never recompiled per request), and
+answers with bounded latency:
+
+  serve.featurize   — the shared featurize/prefilter core (also used by
+                      the offline pipeline, so the chains cannot drift)
+  serve.cache       — content-hash LRU result cache (hits/misses/
+                      evictions)
+  serve.stats       — bounded-reservoir latency percentiles per stage
+  serve.scheduler   — request queue + micro-batcher: max_batch /
+                      max_delay_ms flush, bucket padding, per-request
+                      deadlines, queue-full rejection with retry_after,
+                      host scalar Dice fallback on device failure
+  serve.server      — newline-delimited-JSON transport over stdio and a
+                      Unix domain socket, plus the `stats` control verb
+                      (the `licensee-tpu serve` CLI command)
+
+Imports are lazy (PEP 562): ``import licensee_tpu.serve`` stays cheap;
+the heavy classifier machinery loads only when a symbol is touched.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "MicroBatcher": "licensee_tpu.serve.scheduler",
+    "QueueFullError": "licensee_tpu.serve.scheduler",
+    "ServeRequest": "licensee_tpu.serve.scheduler",
+    "ResultCache": "licensee_tpu.serve.cache",
+    "LatencyStats": "licensee_tpu.serve.stats",
+    "serve_stdio": "licensee_tpu.serve.server",
+    "serve_unix": "licensee_tpu.serve.server",
+    "selftest": "licensee_tpu.serve.server",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

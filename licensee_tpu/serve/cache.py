@@ -1,0 +1,93 @@
+"""Content-hash LRU result cache for the serving path.
+
+Real-world license traffic is overwhelmingly duplicate blobs (bench r05:
+dup-heavy streams classify ~8x faster end-to-end than unique ones purely
+from dedupe), so the serving front end answers repeats from this cache
+without touching featurization or the device.  Keys are the SAME
+(dispatch, content-sha1) tuples the offline dedupe cache uses
+(serve/featurize.py content_key), so a hit is exact — classification is
+a pure function of content + dispatch — never approximate.
+
+LRU, not FIFO like the offline cache: a server runs for weeks and its
+working set drifts (trending repos change), so recency matters; the
+offline pipeline's one-pass manifest scan has no such drift.  Stored
+results are frozen copies (tuple ``closest``) exactly like the offline
+cache — a cached object is handed out many times and must never be
+mutated by a later annotation pass.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import replace
+
+
+class ResultCache:
+    """Thread-safe LRU of content-key -> BlobResult with hit/miss/
+    eviction counters."""
+
+    def __init__(self, capacity: int = 65536):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {capacity!r}")
+        self.capacity = int(capacity)
+        self._data: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key, record_miss: bool = True):
+        """``record_miss=False`` marks a RE-probe (the scheduler checks
+        again under its lock to close the put/unregister race): a hit
+        still counts, but the initial probe already recorded the miss."""
+        with self._lock:
+            result = self._data.get(key)
+            if result is None:
+                if record_miss:
+                    self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return result
+
+    def put(self, key, result) -> None:
+        """Insert a CLEAN result (the callers never cache error rows —
+        same policy as the offline dedupe cache)."""
+        if self.capacity == 0:
+            return
+        frozen = replace(
+            result,
+            closest=(
+                tuple(result.closest)
+                if result.closest is not None
+                else None
+            ),
+        )
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+            elif len(self._data) >= self.capacity:
+                self._data.popitem(last=False)
+                self.evictions += 1
+            self._data[key] = frozen
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def stats(self) -> dict:
+        with self._lock:
+            hits, misses = self.hits, self.misses
+            return {
+                "entries": len(self._data),
+                "capacity": self.capacity,
+                "hits": hits,
+                "misses": misses,
+                "evictions": self.evictions,
+                "hit_rate": (
+                    round(hits / (hits + misses), 4)
+                    if hits + misses
+                    else None
+                ),
+            }

@@ -1,0 +1,243 @@
+"""Shared blob featurize + prefilter helpers — ONE implementation for
+the offline manifest pipeline (projects/batch_project.py) and the online
+serving path (serve/scheduler.py), so the two can never drift.
+
+Everything here was factored out of BatchProject's produce stage: the
+capped read policy, the route-aware dispatch/content cache key, the
+batch produce core (route + read + dedupe + prefilter + featurize), the
+memoized JSONL row renderer, and the single-request twin
+``featurize_request`` that the micro-batcher calls at admission time.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import time
+
+from licensee_tpu.kernels.batch import BatchClassifier, BlobResult
+
+# placeholder for a row that duplicates an earlier row of the SAME batch:
+# prepare_batch skips it like any preset row, and the pipeline replaces it
+# with the original's finished result before anything reads it.  The error
+# marker makes an accidental leak visible instead of silent.
+IN_BATCH_DUP = BlobResult(None, None, 0.0, error="in_batch_dup_unresolved")
+
+# the shared row for --mode auto entries no filename table scores: the
+# file is never read, never hashed, never featurized (find_files drops
+# score-0 names before load_file, project.rb:111-124).  Finished results
+# are never mutated, so one frozen instance serves every such row.
+UNROUTED = BlobResult(None, None, 0.0)
+
+
+def read_capped(path: str) -> bytes | None:
+    """Read at most 64 KiB — the MAX_LICENSE_SIZE cap (git_project.rb:53);
+    None on any OS error (the caller reports a read_error row).  The one
+    read policy for every ingestion path."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(64 * 1024)
+    except OSError:
+        return None
+
+
+@functools.lru_cache(maxsize=4096)
+def json_str(s: str | None) -> str:
+    """json.dumps memoized per distinct value: keys and matcher names
+    come from a small fixed pool, so the 10M-row writer pays the real
+    escaping logic once per unique string instead of per row."""
+    return "null" if s is None else json.dumps(s)
+
+
+def jsonl_row(path: str, result, error: str | None) -> str:
+    """One output row as JSON, ~4x faster than json.dumps(dict).
+
+    json.dumps in the 10M-row writer loop is a real serial cost (~9 us a
+    row); the confidence is a float whose repr IS its JSON form, and the
+    key/matcher strings are escape-memoized, so only the path (and the
+    rare error) pays a real dumps."""
+    row = (
+        f'{{"path": {json.dumps(path)}, "key": {json_str(result.key)}, '
+        f'"matcher": {json_str(result.matcher)}, '
+        f'"confidence": {result.confidence!r}'
+    )
+    if result.closest is not None:
+        inner = ", ".join(
+            f"[{json_str(k)}, {c!r}]" for k, c in result.closest
+        )
+        row += f', "closest": [{inner}]'
+    if result.attribution is not None:
+        row += f', "attribution": {json.dumps(result.attribution)}'
+    if error is not None:
+        row += f', "error": {json.dumps(error)}'
+    return row + "}"
+
+
+def dispatch_key(
+    route: str, filename: str | None, attribution: bool = False
+):
+    """The filename-dependent part of a result-cache key.
+
+    Classification is a pure function of the content plus exactly this
+    dispatch: in package mode the whole matcher table reads the
+    filename; in license/readme mode only the HTML gate does.  With
+    attribution on, the copyright? filename gate (project_file.rb:94)
+    also feeds the result, so its bit joins the key — COPYRIGHT and
+    LICENSE holding identical bytes attribute differently and must not
+    share a cache slot.  Used by BOTH the offline dedupe cache and the
+    serve result cache, so their hit semantics are one definition."""
+    if route == "package":
+        return (route, filename)
+    key = (route, BatchClassifier._is_html(filename))
+    if attribution:
+        from licensee_tpu.project_files.license_file import (
+            COPYRIGHT_NAME_REGEX,
+        )
+
+        key += (
+            bool(COPYRIGHT_NAME_REGEX.search(filename))
+            if filename
+            else False,
+        )
+    return key
+
+
+def content_key(
+    route: str,
+    filename: str | None,
+    content: bytes,
+    attribution: bool = False,
+):
+    """The full result-cache key: (dispatch, content hash).
+
+    usedforsecurity=False: a cache key, not crypto — and FIPS-mode
+    OpenSSL would otherwise refuse sha1 entirely."""
+    return (
+        dispatch_key(route, filename, attribution),
+        hashlib.sha1(content, usedforsecurity=False).digest(),
+    )
+
+
+def produce_batch(
+    classifier, chunk, mode, dedupe, attribution, cache=None
+):
+    """The produce stage, shared by the thread path (live ``cache``) and
+    the worker-process path (``cache=None`` — the cross-batch cache
+    lives in the parent, which applies it on receipt).
+
+    In auto mode the filename routes FIRST: a manifest entry no score
+    table claims skips the read, the hash, and the device entirely — on
+    a 50M mixed manifest the unrecognized majority costs one regex scan
+    of the basename and nothing else."""
+    filenames = [os.path.basename(p) for p in chunk]
+    routes: list | None = None
+    if mode == "auto":
+        routes = [BatchClassifier.route_for(f) for f in filenames]
+    t0 = time.perf_counter()
+    contents = [
+        read_capped(p)
+        if routes is None or routes[i] is not None
+        else b""
+        for i, p in enumerate(chunk)
+    ]
+    t1 = time.perf_counter()
+    keys: list = [None] * len(chunk)
+    preset: list = [None] * len(chunk)
+    dup_of: dict[int, int] = {}
+    if routes is not None:
+        for i, route in enumerate(routes):
+            if route is None:
+                preset[i] = UNROUTED
+    if dedupe:
+        first_seen: dict = {}
+        for i, c in enumerate(contents):
+            if c is None or preset[i] is not None:
+                continue
+            route = routes[i] if routes is not None else mode
+            keys[i] = content_key(route, filenames[i], c, attribution)
+            if cache is not None:
+                preset[i] = cache.get(keys[i])
+            if preset[i] is None:
+                # in-batch dedupe: repeats of a key first seen in THIS
+                # batch are featurized/scored once and copied after
+                # finish (no cross-batch pipeline lag)
+                j = first_seen.setdefault(keys[i], i)
+                if j != i:
+                    dup_of[i] = j
+                    preset[i] = IN_BATCH_DUP
+    prepared = classifier.prepare_batch(
+        [c if c is not None else b"" for c in contents],
+        filenames=filenames,
+        preset=preset,
+        routes=routes,
+    )
+    # pre-render JSONL for rows whose result is already FINAL here (cache
+    # hits and unrouted rows — the preset non-dup rows): their ~1us/row
+    # of row formatting moves off the writer's serial section and onto
+    # the parallel produce workers.  A preset row can never be a read
+    # error (unreadable paths stay preset=None; unrouted paths are never
+    # read) and never carries an error result (the cache only stores
+    # clean rows), so the line is exactly what the write loop would emit.
+    pre_rows: list | None = None
+    for i, p in enumerate(preset):
+        if p is not None and p is not IN_BATCH_DUP:
+            if pre_rows is None:
+                pre_rows = [None] * len(chunk)
+            pre_rows[i] = jsonl_row(chunk[i], p, None)
+    t2 = time.perf_counter()
+    read_errs = [c is None for c in contents]
+    if attribution:
+        # keep raw contents ONLY for rows that can still need the
+        # attribution regex (license/readme route, not already finished
+        # as unmatched, not a preset/dup row) — in process mode every
+        # kept row is pickled parent-ward, up to 64 KiB each
+        kept = []
+        for i, c in enumerate(contents):
+            route = routes[i] if routes is not None else mode
+            r = prepared.results[i]
+            need = (
+                route in ("license", "readme")
+                and preset[i] is None
+                and (r is None or (r.key is not None and not r.error))
+            )
+            kept.append(c if need else None)
+        contents = kept
+    return (
+        read_errs, keys, preset, dup_of, routes, prepared,
+        contents if attribution else None, pre_rows,
+        (t1 - t0, t2 - t1),
+    )
+
+
+def featurize_request(
+    classifier,
+    content: bytes | str,
+    filename: str | None = None,
+    route: str | None = None,
+):
+    """One online request through route -> prefilter -> featurize — the
+    single-blob twin of ``produce_batch`` the micro-batcher calls at
+    admission time.
+
+    Returns a size-1 PreparedBatch: ``results[0]`` is a finished
+    BlobResult when a host stage answered (Copyright/Exact prefilter, a
+    package matcher, an unrouted filename, a README with no license
+    section, a featurize error) and None when the row is Dice-bound —
+    its feature arrays are device-ready and the scheduler coalesces it
+    into the next micro-batch via merge_prepared.  The same
+    first-match-wins chain as the offline path, because it IS the same
+    code (classifier.prepare_batch)."""
+    if route is None and classifier.mode == "auto":
+        route = BatchClassifier.route_for(filename)
+        if route is None:
+            prepared = classifier.prepare_batch(
+                [b""], filenames=[filename], preset=[UNROUTED],
+                routes=[None],
+            )
+            return prepared
+    routes = [route] if classifier.mode == "auto" else None
+    return classifier.prepare_batch(
+        [content], filenames=[filename], routes=routes
+    )

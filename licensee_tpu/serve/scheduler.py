@@ -1,0 +1,595 @@
+"""Dynamic micro-batching scheduler: the online front end of the device
+scorer.
+
+Requests arrive one at a time (JSONL transport, serve/server.py); the
+device wants fixed-shape padded batches (compiled once per shape).  The
+MicroBatcher bridges the two:
+
+  admission (caller's thread)
+    route -> content-hash cache probe -> host prefilter + featurize
+    (serve/featurize.py — the SAME chain as the offline pipeline).
+    Cache hits and host-finished rows (Copyright/Exact, package
+    matchers, unrouted filenames) answer immediately; only Dice-bound
+    rows ever occupy a queue slot.  A full queue rejects WITH
+    ``retry_after`` instead of buffering unboundedly — explicit
+    backpressure beats silent latency collapse.
+
+  scheduling (one background thread)
+    Dice-bound rows coalesce until either ``max_batch`` rows are
+    waiting (flush reason "full") or the OLDEST row has waited
+    ``max_delay_ms`` (flush reason "deadline" — bounded latency for a
+    partial batch).  The gathered rows merge via the kernels/batch.py
+    packers (merge_prepared) and dispatch padded to the smallest
+    fitting BUCKET shape, so the set of compiled device shapes is the
+    fixed bucket list, never per-request.
+
+  degradation
+    A request whose own deadline expired while queued answers
+    ``deadline_exceeded`` instead of occupying a device slot; a device
+    dispatch that raises falls back to the host scalar Dice chain
+    (matchers/dice.py — reference semantics) so verdicts keep flowing
+    while the device is sick.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import licensee_tpu
+from licensee_tpu.kernels.batch import BlobResult
+from licensee_tpu.serve.cache import ResultCache
+from licensee_tpu.serve.featurize import (
+    UNROUTED,
+    content_key,
+    featurize_request,
+)
+from licensee_tpu.serve.stats import StageStats
+
+STAGES = ("featurize", "queue_wait", "device", "total")
+
+
+class BatcherClosedError(RuntimeError):
+    """submit() after close(): with no scheduler left to flush, a
+    queued request would hang its waiter forever — refuse instead."""
+
+
+class QueueFullError(RuntimeError):
+    """Admission refused: the bounded queue is full.  ``retry_after``
+    (seconds) estimates when a slot should free up — the transport
+    surfaces it so a well-behaved client backs off instead of
+    hammering."""
+
+    def __init__(self, retry_after: float):
+        self.retry_after = retry_after
+        super().__init__(
+            f"queue full; retry after {retry_after:.3f}s"
+        )
+
+
+@dataclass
+class ServeRequest:
+    """One in-flight request.  ``result`` is a BlobResult once ``done``
+    is set; ``cached`` marks a content-hash cache hit."""
+
+    content: bytes
+    filename: str | None
+    route: str | None
+    request_id: object = None
+    deadline: float | None = None  # absolute perf_counter seconds
+    created: float = 0.0
+    enqueued_at: float = 0.0
+    prepared: object = None  # size-1 PreparedBatch while Dice-bound
+    cache_key: object = None
+    result: BlobResult | None = None
+    cached: bool = False
+    # concurrent duplicates of this request (same content key, admitted
+    # while this one was still in flight): they ride this row's device
+    # slot and inherit its result — the online twin of the offline
+    # pipeline's in-batch dedupe
+    followers: list = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+
+    def wait(self, timeout: float | None = None) -> BlobResult:
+        if not self.done.wait(timeout):
+            raise TimeoutError("request not finished")
+        return self.result
+
+
+class MicroBatcher:
+    """Request queue + dynamic micro-batcher over a BatchClassifier.
+
+    ``classifier`` defaults to a fresh single-device BatchClassifier;
+    pass one to share a warmed-up compiled scorer.  ``buckets`` is the
+    ascending tuple of padded device shapes; by default a x4 geometric
+    ladder up to ``max_batch`` (each bucket compiles once, the ladder
+    keeps pad waste under 4x for any batch size)."""
+
+    def __init__(
+        self,
+        classifier=None,
+        *,
+        corpus=None,
+        method: str = "auto",
+        mode: str = "license",
+        mesh=None,
+        max_batch: int = 256,
+        max_delay_ms: float = 5.0,
+        queue_depth: int = 1024,
+        cache_entries: int = 65536,
+        deadline_ms: float = 0.0,
+        threshold: float | None = None,
+        buckets: tuple[int, ...] | None = None,
+        start: bool = True,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch!r}")
+        if not (max_delay_ms >= 0):  # rejects negatives AND NaN
+            raise ValueError(
+                f"max_delay_ms must be >= 0, got {max_delay_ms!r}"
+            )
+        if queue_depth < 1:
+            raise ValueError(
+                f"queue_depth must be >= 1, got {queue_depth!r}"
+            )
+        if classifier is None:
+            from licensee_tpu.kernels.batch import BatchClassifier
+
+            classifier = BatchClassifier(
+                corpus=corpus,
+                method=method,
+                mode=mode,
+                mesh=mesh,
+                pad_batch_to=max_batch,
+            )
+        self.classifier = classifier
+        self.mode = classifier.mode
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self.queue_depth = int(queue_depth)
+        self.deadline_ms = float(deadline_ms)
+        self.threshold = (
+            licensee_tpu.confidence_threshold()
+            if threshold is None
+            else float(threshold)
+        )
+        self.cache = ResultCache(cache_entries)
+        self.buckets = self._resolve_buckets(buckets)
+        self.stats_stages = StageStats(STAGES)
+        self._queue: deque[ServeRequest] = deque()
+        # content key -> the queued primary request: a duplicate
+        # arriving while its twin is still queued attaches as a
+        # follower instead of occupying a second device slot (the cache
+        # only learns a result at flush time, so without this every
+        # duplicate inside one flush window would re-score)
+        self._inflight: dict = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._running = False
+        self._paused = False
+        self._closed = False
+        self._batch_ewma: float | None = None  # seconds per device batch
+        self._counters = {
+            "submitted": 0,
+            "completed": 0,
+            "cache_hits": 0,
+            "coalesced": 0,
+            "prefiltered": 0,
+            "unrouted": 0,
+            "device_batches": 0,
+            "device_rows": 0,
+            "padded_rows": 0,
+            "rejected": 0,
+            "expired": 0,
+            "fallbacks": 0,
+        }
+        self._flush_reasons = {"full": 0, "deadline": 0, "drain": 0}
+        self._bucket_counts: dict[int, int] = {}
+        self._thread: threading.Thread | None = None
+        if start:
+            self.start()
+
+    def _resolve_buckets(self, buckets) -> tuple[int, ...]:
+        if buckets is None:
+            ladder = []
+            b = 8
+            while b < self.max_batch:
+                ladder.append(b)
+                b *= 4
+            ladder.append(self.max_batch)
+            buckets = ladder
+        out = sorted({int(b) for b in buckets})
+        if not out or out[0] < 1:
+            raise ValueError(f"buckets must be positive, got {buckets!r}")
+        if out[-1] < self.max_batch:
+            # a full flush must fit the largest bucket
+            out.append(self.max_batch)
+        mesh = self.classifier.mesh
+        if mesh is not None:
+            # a padded dispatch must divide across the data axis
+            # (max_batch included — an indivisible top bucket would turn
+            # every full flush into a permanent scalar fallback)
+            n_data = mesh.shape["data"]
+            out = sorted({-(-b // n_data) * n_data for b in out})
+        return tuple(out)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that fits n rows (the largest bucket is
+        >= max_batch, and a flush never gathers more than max_batch)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        with self._cond:
+            if self._running:
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, name="micro-batcher", daemon=True
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        """Stop accepting, drain the queue (every queued request still
+        answers), and join the scheduler thread."""
+        with self._cond:
+            self._closed = True  # later submits raise instead of hanging
+            if not self._running:
+                # never started: drain synchronously
+                leftovers = list(self._queue)
+                self._queue.clear()
+            else:
+                leftovers = None
+                self._running = False
+                self._cond.notify_all()
+        if leftovers is not None:
+            while leftovers:
+                self._flush(leftovers[: self.max_batch], "drain")
+                leftovers = leftovers[self.max_batch :]
+            return
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def pause(self) -> None:
+        """Stop draining the queue (admission continues until it
+        fills).  Operational valve — and the deterministic way for
+        tests to exercise the backpressure path."""
+        with self._cond:
+            self._paused = True
+            self._cond.notify_all()
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    # -- admission --
+
+    def submit(
+        self,
+        content: bytes | str,
+        filename: str | None = None,
+        request_id=None,
+        deadline_ms: float | None = None,
+    ) -> ServeRequest:
+        """Admit one request.  Returns a ServeRequest whose ``done``
+        event fires when ``result`` is set — immediately for cache hits
+        and host-finished rows.  Raises QueueFullError when the bounded
+        queue cannot take another Dice-bound row."""
+        t0 = time.perf_counter()
+        raw = (
+            content
+            if isinstance(content, bytes)
+            else str(content).encode("utf-8", errors="ignore")
+        )
+        filename = os.path.basename(filename) if filename else None
+        route = (
+            self.classifier.route_for(filename)
+            if self.mode == "auto"
+            else self.mode
+        )
+        req = ServeRequest(
+            content=raw,
+            filename=filename,
+            route=route,
+            request_id=request_id,
+            created=t0,
+        )
+        ms = self.deadline_ms if deadline_ms is None else deadline_ms
+        if ms and ms > 0:
+            req.deadline = t0 + ms / 1000.0
+        with self._lock:
+            self._counters["submitted"] += 1
+        if route is None:
+            # auto mode, a filename no score table claims: answered
+            # without reading a byte, same as the offline path
+            with self._lock:
+                self._counters["unrouted"] += 1
+            return self._finish_local(req, UNROUTED, t0)
+        key = content_key(route, filename, raw)
+        cached = self.cache.get(key)
+        if cached is not None:
+            with self._lock:
+                self._counters["cache_hits"] += 1
+            req.cached = True
+            return self._finish_local(req, cached, t0)
+        req.cache_key = key
+        # early coalesce: a duplicate of a QUEUED request skips even
+        # featurization — it inherits the primary's verdict at flush
+        with self._cond:
+            primary = self._inflight.get(key)
+            if primary is not None:
+                primary.followers.append(req)
+                self._counters["coalesced"] += 1
+                return req
+        prepared = featurize_request(
+            self.classifier, raw, filename,
+            route if self.mode == "auto" else None,
+        )
+        self.stats_stages.record("featurize", time.perf_counter() - t0)
+        req.prepared = prepared
+        host_result = prepared.results[0]
+        if host_result is not None:
+            # prefiltered (Copyright/Exact), package-matched, featurize
+            # error, or a README with no license section: never occupies
+            # a device slot
+            if not host_result.error:
+                self.cache.put(key, host_result)
+            with self._lock:
+                self._counters["prefiltered"] += 1
+            return self._finish_local(req, host_result, t0)
+        late = None
+        with self._cond:
+            primary = self._inflight.get(key)
+            if primary is not None:
+                # a twin was enqueued while this thread featurized
+                primary.followers.append(req)
+                self._counters["coalesced"] += 1
+                return req
+            # the flush loop caches a result BEFORE unregistering its
+            # request, so "not in _inflight" + this re-probe together
+            # leave no window where a duplicate misses both
+            late = self.cache.get(key, record_miss=False)
+            if late is None:
+                if self._closed:
+                    raise BatcherClosedError("batcher is closed")
+                if len(self._queue) >= self.queue_depth:
+                    self._counters["rejected"] += 1
+                    raise QueueFullError(self._estimate_retry_after())
+                req.enqueued_at = time.perf_counter()
+                self._queue.append(req)
+                self._inflight[key] = req
+                self._cond.notify_all()
+        if late is not None:
+            with self._lock:
+                self._counters["cache_hits"] += 1
+            req.cached = True
+            return self._finish_local(req, late, t0)
+        return req
+
+    def classify(
+        self,
+        content: bytes | str,
+        filename: str | None = None,
+        timeout: float | None = 60.0,
+    ) -> BlobResult:
+        """Blocking convenience: submit + wait."""
+        return self.submit(content, filename).wait(timeout)
+
+    def _finish_local(self, req, result, t0) -> ServeRequest:
+        req.result = result
+        with self._lock:
+            self._counters["completed"] += 1
+        self.stats_stages.record("total", time.perf_counter() - t0)
+        req.done.set()
+        return req
+
+    def _estimate_retry_after(self) -> float:
+        """How long until a queue slot frees: batches ahead x the EWMA
+        device-batch service time, plus one flush delay.  Called with
+        the lock held."""
+        per_batch = self._batch_ewma or self.max_delay or 0.005
+        batches_ahead = max(
+            1, math.ceil(len(self._queue) / self.max_batch)
+        )
+        return round(batches_ahead * per_batch + self.max_delay, 3)
+
+    # -- the scheduler thread --
+
+    def _loop(self) -> None:
+        while True:
+            batch: list[ServeRequest] = []
+            reason = "drain"
+            with self._cond:
+                while self._running and (
+                    self._paused or not self._queue
+                ):
+                    self._cond.wait()
+                if not self._running and not self._queue:
+                    return
+                while self._running and not self._paused:
+                    if len(self._queue) >= self.max_batch:
+                        reason = "full"
+                        break
+                    wait = (
+                        self._queue[0].enqueued_at
+                        + self.max_delay
+                        - time.perf_counter()
+                    )
+                    if wait <= 0:
+                        reason = "deadline"
+                        break
+                    self._cond.wait(wait)
+                if self._paused and self._running:
+                    continue
+                n = min(self.max_batch, len(self._queue))
+                for _ in range(n):
+                    batch.append(self._queue.popleft())
+            if batch:
+                self._flush(batch, reason)
+
+    def _flush(self, batch: list[ServeRequest], reason: str) -> None:
+        t0 = time.perf_counter()
+
+        def unexpired(r: ServeRequest) -> bool:
+            return r.deadline is None or t0 <= r.deadline
+
+        # a row is scored if ANY of its members (primary or coalesced
+        # followers) can still use the verdict — a follower with a
+        # longer (or no) deadline must not inherit its twin's expiry
+        live: list[ServeRequest] = []
+        for req in batch:
+            self.stats_stages.record(
+                "queue_wait", t0 - (req.enqueued_at or req.created)
+            )
+            with self._lock:
+                alive = unexpired(req) or any(
+                    unexpired(f) for f in req.followers
+                )
+            if alive:
+                live.append(req)
+        if live:
+            group = [r.prepared for r in live]
+            n = sum(len(p.todo) for p in group)
+            bucket = self.bucket_for(n)
+            clf = self.classifier
+            try:
+                merged = clf.merge_prepared(group)
+                outs = clf.dispatch_chunks(merged, pad_to=bucket)
+                clf.finish_chunks(merged, outs, self.threshold)
+                clf.scatter_merged(group, merged)
+                for req in live:
+                    req.result = req.prepared.results[0]
+            except Exception:  # noqa: BLE001 — device failure containment
+                with self._lock:
+                    self._counters["fallbacks"] += len(live)
+                for req in live:
+                    req.result = self._scalar_fallback(req)
+            dt = time.perf_counter() - t0
+            self.stats_stages.record("device", dt)
+            with self._lock:
+                self._counters["device_batches"] += 1
+                self._counters["device_rows"] += n
+                self._counters["padded_rows"] += bucket - n
+                self._flush_reasons[reason] += 1
+                self._bucket_counts[bucket] = (
+                    self._bucket_counts.get(bucket, 0) + 1
+                )
+                self._batch_ewma = (
+                    dt
+                    if self._batch_ewma is None
+                    else 0.8 * self._batch_ewma + 0.2 * dt
+                )
+        done_t = time.perf_counter()
+        for req in batch:
+            # rows nobody could score kept result=None; scored rows
+            # carry the device (or fallback) verdict
+            scored = req.result
+            if (
+                scored is not None
+                and not scored.error
+                and req.cache_key is not None
+            ):
+                self.cache.put(req.cache_key, scored)
+            # unregister BEFORE signalling: once the key leaves
+            # _inflight no new follower can attach, so the snapshot
+            # below is complete
+            with self._lock:
+                if self._inflight.get(req.cache_key) is req:
+                    del self._inflight[req.cache_key]
+                followers = list(req.followers)
+                self._counters["completed"] += 1 + len(followers)
+            for member in (req, *followers):
+                if scored is not None and unexpired(member):
+                    # followers inherit the verdict (identical content
+                    # key => identical classification) and count as
+                    # deduplicated answers, like cache hits
+                    member.result = scored
+                    member.cached = member is not req
+                else:
+                    member.result = BlobResult(
+                        None, None, 0.0, error="deadline_exceeded"
+                    )
+                    with self._lock:
+                        self._counters["expired"] += 1
+                self.stats_stages.record("total", done_t - member.created)
+                member.done.set()
+
+    def _scalar_fallback(self, req: ServeRequest) -> BlobResult:
+        """Reference-semantics host path for one Dice-bound request —
+        the graceful-degradation answer when the device dispatch
+        raised.  Copyright/Exact already had their turn at admission,
+        so only Dice (and the readme Reference fallback) run here.
+        Scores come from the scalar matcher over the vendored pool, the
+        same chain `licensee-tpu detect` walks."""
+        from licensee_tpu.matchers import Dice
+        from licensee_tpu.project_files.license_file import LicenseFile
+
+        section = None
+        if req.prepared is not None and req.prepared.sections:
+            section = req.prepared.sections[0]
+        text = section if section is not None else req.content
+        try:
+            ranked = Dice(
+                LicenseFile(text, req.filename or "LICENSE")
+            ).matches_by_similarity
+            if ranked and ranked[0][1] >= self.threshold:
+                lic, sim = ranked[0]
+                return BlobResult(lic.key, "dice", float(sim))
+            if section is not None:
+                lic = self.classifier._reference_match(section)
+                if lic is not None:
+                    return BlobResult(lic.key, "reference", 90.0)
+            return BlobResult(None, None, 0.0)
+        except Exception as exc:  # noqa: BLE001 — per-request containment
+            return BlobResult(
+                None, None, 0.0, error=f"fallback_error: {exc}"
+            )
+
+    # -- observability --
+
+    def stats(self) -> dict:
+        """The JSON the `stats` control verb dumps: scheduler counters,
+        flush reasons, bucket histogram, cache counters, and per-stage
+        latency percentiles."""
+        with self._lock:
+            counters = dict(self._counters)
+            counters["queue_depth_now"] = len(self._queue)
+            flush = dict(self._flush_reasons)
+            bucket_counts = {
+                str(k): v for k, v in sorted(self._bucket_counts.items())
+            }
+        return {
+            "scheduler": {
+                **counters,
+                "flush": flush,
+                "buckets": bucket_counts,
+            },
+            "cache": self.cache.stats(),
+            "latency_ms": self.stats_stages.snapshot(),
+            "config": {
+                "mode": self.mode,
+                "max_batch": self.max_batch,
+                "max_delay_ms": self.max_delay * 1000.0,
+                "queue_depth": self.queue_depth,
+                "cache_entries": self.cache.capacity,
+                "deadline_ms": self.deadline_ms,
+                "buckets": list(self.buckets),
+                "threshold": self.threshold,
+            },
+        }

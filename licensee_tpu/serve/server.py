@@ -1,0 +1,318 @@
+"""Newline-delimited-JSON transports for the micro-batcher, plus the
+``stats`` control verb and the CLI selftest.
+
+One request per line; one response line per request, IN REQUEST ORDER
+(a pipe consumer can zip its input to the output without ids, and ids
+are still echoed for clients that want them).  Ordering costs nothing:
+a reader thread admits requests as fast as they arrive (so the batcher
+coalesces them), while a writer thread blocks only on the OLDEST
+in-flight request — completed younger requests queue behind it.
+
+Request lines:
+  {"content": "...", "id": ..., "filename": ..., "deadline_ms": ...}
+  {"content_b64": "...", ...}        # raw bytes, base64
+  {"op": "stats", "id": ...}         # dump scheduler/cache/latency JSON
+Response lines:
+  {"id": ..., "key": ..., "matcher": ..., "confidence": ..., "cached": ...}
+  {"id": ..., "error": "queue_full", "retry_after": 1.25}   # backpressure
+  {"id": ..., "stats": {...}}
+
+The same session loop runs over stdio (``licensee-tpu serve``) and over
+a Unix domain socket (``--socket PATH``, one session per connection) —
+the HTTP layer of a later PR sits on the same batcher."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socketserver
+import threading
+from collections import deque
+
+from licensee_tpu.serve.scheduler import MicroBatcher, QueueFullError
+
+
+def _render_result(req) -> dict:
+    row = {"id": req.request_id, **req.result.as_dict()}
+    if req.result.error:
+        row["error"] = req.result.error
+    row["cached"] = req.cached
+    return row
+
+
+class _Session:
+    """One transport session: parse lines, admit requests, emit ordered
+    responses via a writer thread."""
+
+    def __init__(self, batcher: MicroBatcher, write_line):
+        self.batcher = batcher
+        self._write_line = write_line
+        self._pending: deque = deque()  # ("req", ServeRequest) | ("raw", dict)
+        self._cond = threading.Condition()
+        self._closed = False
+        self.requests = 0
+        self.responses = 0
+        self._writer = threading.Thread(
+            target=self._drain, name="serve-writer", daemon=True
+        )
+        self._writer.start()
+
+    def _emit(self, kind, payload) -> None:
+        with self._cond:
+            self._pending.append((kind, payload))
+            self._cond.notify_all()
+
+    def _drain(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending and self._closed:
+                    return
+                kind, payload = self._pending.popleft()
+            if kind == "req":
+                payload.done.wait()
+                row = _render_result(payload)
+            elif kind == "stats":
+                # snapshot at WRITE time, not parse time: every earlier
+                # request in the stream has answered by now, so the verb
+                # reports "stats as of this point in the session"
+                row = {"id": payload, "stats": self.batcher.stats()}
+            else:
+                row = payload
+            try:
+                self._write_line(json.dumps(row))
+            except (OSError, ValueError):
+                return  # peer went away: drop the rest of the session
+            self.responses += 1
+
+    def handle_line(self, line: str) -> None:
+        line = line.strip()
+        if not line:
+            return
+        self.requests += 1
+        try:
+            msg = json.loads(line)
+            if not isinstance(msg, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as exc:
+            self._emit(
+                "raw", {"id": None, "error": f"bad_request: {exc}"}
+            )
+            return
+        rid = msg.get("id")
+        op = msg.get("op")
+        if op == "stats":
+            self._emit("stats", rid)
+            return
+        if op is not None:
+            self._emit(
+                "raw", {"id": rid, "error": f"bad_request: unknown op {op!r}"}
+            )
+            return
+        if "content_b64" in msg:
+            try:
+                content = base64.b64decode(msg["content_b64"])
+            except (ValueError, TypeError) as exc:
+                self._emit(
+                    "raw", {"id": rid, "error": f"bad_request: {exc}"}
+                )
+                return
+        else:
+            content = msg.get("content")
+            if not isinstance(content, str):
+                self._emit(
+                    "raw",
+                    {
+                        "id": rid,
+                        "error": "bad_request: missing 'content' "
+                        "(or 'content_b64') string",
+                    },
+                )
+                return
+        # client-controlled fields are type-checked HERE: a malformed
+        # value must cost its sender one error line, never the server
+        filename = msg.get("filename")
+        if filename is not None and not isinstance(filename, str):
+            self._emit(
+                "raw",
+                {"id": rid, "error": "bad_request: filename must be a string"},
+            )
+            return
+        deadline_ms = msg.get("deadline_ms")
+        if deadline_ms is not None and (
+            isinstance(deadline_ms, bool)
+            or not isinstance(deadline_ms, (int, float))
+            or not deadline_ms >= 0  # rejects negatives AND NaN
+        ):
+            self._emit(
+                "raw",
+                {
+                    "id": rid,
+                    "error": "bad_request: deadline_ms must be a "
+                    "non-negative number",
+                },
+            )
+            return
+        try:
+            req = self.batcher.submit(
+                content,
+                filename=filename,
+                request_id=rid,
+                deadline_ms=deadline_ms,
+            )
+        except QueueFullError as exc:
+            self._emit(
+                "raw",
+                {
+                    "id": rid,
+                    "error": "queue_full",
+                    "retry_after": exc.retry_after,
+                },
+            )
+            return
+        except Exception as exc:  # noqa: BLE001 — session containment
+            # a week-long worker answers an error row and keeps serving;
+            # it never lets one request tear the session (or process) down
+            self._emit(
+                "raw", {"id": rid, "error": f"internal_error: {exc}"}
+            )
+            return
+        self._emit("req", req)
+
+    def finish(self) -> None:
+        """EOF: let the writer drain every pending response, then stop."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._writer.join()
+
+
+def serve_session(batcher: MicroBatcher, lines, write_line) -> dict:
+    """Run one session: ``lines`` is an iterable of request lines,
+    ``write_line(str)`` emits one response line.  Returns counts."""
+    session = _Session(batcher, write_line)
+    try:
+        for line in lines:
+            session.handle_line(line)
+    finally:
+        session.finish()
+    return {"requests": session.requests, "responses": session.responses}
+
+
+def serve_stdio(batcher: MicroBatcher, stdin=None, stdout=None) -> dict:
+    """The pipe transport: JSONL in on stdin, JSONL out on stdout."""
+    import sys
+
+    stdin = sys.stdin if stdin is None else stdin
+    stdout = sys.stdout if stdout is None else stdout
+    lock = threading.Lock()
+
+    def write_line(line: str) -> None:
+        with lock:
+            stdout.write(line + "\n")
+            stdout.flush()
+
+    return serve_session(batcher, stdin, write_line)
+
+
+class UnixServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
+    """One JSONL session per connection, all sharing one batcher (and
+    therefore one cache and one device pipeline)."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, path: str, batcher: MicroBatcher):
+        if os.path.exists(path):
+            os.unlink(path)  # a stale socket from a dead server
+        self.batcher = batcher
+        super().__init__(path, _UnixHandler)
+
+
+class _UnixHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        lock = threading.Lock()
+
+        def write_line(line: str) -> None:
+            with lock:
+                self.wfile.write(line.encode("utf-8") + b"\n")
+                self.wfile.flush()
+
+        lines = (raw.decode("utf-8", errors="replace") for raw in self.rfile)
+        serve_session(self.server.batcher, lines, write_line)
+
+
+def serve_unix(batcher: MicroBatcher, path: str) -> None:
+    """Serve forever on a Unix domain socket (Ctrl-C to stop)."""
+    with UnixServer(path, batcher) as server:
+        try:
+            server.serve_forever(poll_interval=0.2)
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def selftest(verbose: bool = True) -> int:
+    """End-to-end smoke of the whole serving stack on this host's
+    devices (CPU-safe): exact prefilter, a Dice-scored micro-batch
+    (deadline flush — the session is 3 requests, far under max_batch),
+    a content-hash cache hit, and the stats verb, all through the real
+    JSONL session loop.  Returns 0 on success — the CI gate and the
+    `licensee-tpu serve --selftest` command."""
+    import io
+    import re
+
+    from licensee_tpu.corpus.license import License
+
+    body = re.sub(
+        r"\[(\w+)\]", "example", License.find("mit").content or ""
+    )
+    variant = body + "\nzqxa zqxb\n"
+    session_lines = [
+        json.dumps({"id": 1, "content": body, "filename": "LICENSE"}),
+        json.dumps({"id": 2, "content": variant, "filename": "LICENSE"}),
+        json.dumps({"id": 3, "content": variant, "filename": "LICENSE"}),
+        json.dumps({"id": 4, "op": "stats"}),
+    ]
+    out = io.StringIO()
+    with MicroBatcher(max_batch=64, max_delay_ms=10.0) as batcher:
+        counts = serve_session(
+            batcher, session_lines, lambda line: out.write(line + "\n")
+        )
+    rows = [json.loads(line) for line in out.getvalue().splitlines()]
+    problems = []
+    if counts != {"requests": 4, "responses": 4}:
+        problems.append(f"bad session counts: {counts}")
+    else:
+        by_id = {r["id"]: r for r in rows}
+        if (by_id[1].get("key"), by_id[1].get("matcher")) != ("mit", "exact"):
+            problems.append(f"exact prefilter: {by_id[1]}")
+        if (by_id[2].get("key"), by_id[2].get("matcher")) != ("mit", "dice"):
+            problems.append(f"dice micro-batch: {by_id[2]}")
+        if by_id[2] != {**by_id[3], "id": 2, "cached": False}:
+            problems.append(f"cache hit disagrees: {by_id[3]} vs {by_id[2]}")
+        if not by_id[3].get("cached"):
+            problems.append(f"duplicate not cached: {by_id[3]}")
+        stats = by_id[4].get("stats") or {}
+        sched = stats.get("scheduler") or {}
+        if sched.get("device_batches") != 1 or sched.get("device_rows") != 1:
+            problems.append(f"scheduler counters: {sched}")
+        # the duplicate deduplicated either way: a cache hit (flush won
+        # the race) or an in-flight coalesce (the duplicate arrived
+        # inside the same flush window) — both answer without a second
+        # device row
+        deduped = sched.get("cache_hits", 0) + sched.get("coalesced", 0)
+        if deduped != 1:
+            problems.append(f"duplicate not deduplicated: {sched}")
+    if verbose:
+        summary = {
+            "selftest": "ok" if not problems else "FAIL",
+            "problems": problems,
+            "responses": len(rows),
+        }
+        print(json.dumps(summary))
+    return 0 if not problems else 1

@@ -61,6 +61,17 @@ def _fat_details() -> dict:
         "end_to_end_readme": dict(e2e),
         "end_to_end_package": dict(e2e),
         "end_to_end_auto": dict(e2e),
+        "serve_path": {
+            "requests": 99_999_999,
+            "uncached_rps": 99_999_999.9,
+            "cached_rps": 99_999_999.9,
+            "cache_hits": 99_999_999,
+            "device_batches": 99_999_999,
+            "bucket_counts": {str(b): 99_999_999 for b in
+                              (8, 32, 128, 256)},
+            "p50_ms": 99999.999,
+            "p99_ms": 99999.999,
+        },
         "host_model": {"z" * 30: 9.9 for _ in range(1)},
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
@@ -111,6 +122,7 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["at_scale_license"]["rows_written"] == 1_000_000
     assert d["at_scale_auto"]["files_per_sec"] == 8_748_728.9
     assert d["e2e_files_per_sec"]["readme"] == 8_748_728.9
+    assert d["serve_path"]["cached_rps"] == 99_999_999.9
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 
@@ -119,8 +131,9 @@ def test_headline_survives_missing_rows(bench_mod):
     balloon."""
     details = _fat_details()
     for k in ("end_to_end_1m", "end_to_end_1m_auto", "scalar_agreement",
-              "end_to_end_readme"):
+              "end_to_end_readme", "serve_path"):
         details[k] = None
     headline = bench_mod.make_headline("m", 1.0, 1.0, details)
     assert headline["details"]["at_scale_license"]["resume_ok"] is None
     assert headline["details"]["e2e_files_per_sec"]["readme"] is None
+    assert headline["details"]["serve_path"]["cached_rps"] is None

@@ -7,7 +7,7 @@ import pytest
 import yaml
 
 from licensee_tpu.cli.main import main
-from tests.conftest import fixture_path
+from tests.conftest import fixture_contents, fixture_path
 
 
 def run_cli(argv, capsys):
@@ -129,6 +129,39 @@ def test_confidence_flag(capsys):
     )
     assert rc == 0
     licensee_tpu.set_confidence_threshold(licensee_tpu.CONFIDENCE_THRESHOLD)
+
+
+def test_serve_stdin_jsonl_session(capsys, monkeypatch):
+    """The serve smoke: a 4-line JSONL session piped through stdin
+    answers end-to-end on CPU — exact verdicts matching detect, a
+    cache-hit duplicate, and the stats verb."""
+    import io
+
+    mit = fixture_contents("mit/LICENSE.txt")
+    lines = [
+        json.dumps({"id": 1, "content": mit, "filename": "LICENSE.txt"}),
+        json.dumps({"id": 2, "content": mit + "\nzqxcli zqycli\n",
+                    "filename": "LICENSE.txt"}),
+        json.dumps({"id": 3, "content": mit + "\nzqxcli zqycli\n",
+                    "filename": "LICENSE.txt"}),
+        json.dumps({"id": 4, "op": "stats"}),
+    ]
+    monkeypatch.setattr(
+        "sys.stdin", io.StringIO("\n".join(lines) + "\n")
+    )
+    rc, out = run_cli(["serve", "--max-delay-ms", "10"], capsys)
+    assert rc == 0
+    rows = [json.loads(line) for line in out.splitlines()]
+    assert [r["id"] for r in rows] == [1, 2, 3, 4]
+    # the same verdict `detect` prints for the mit fixture
+    assert (rows[0]["key"], rows[0]["matcher"], rows[0]["confidence"]) == (
+        "mit", "exact", 100.0
+    )
+    assert (rows[1]["key"], rows[1]["matcher"]) == ("mit", "dice")
+    assert rows[2]["key"] == "mit" and rows[2]["cached"]
+    sched = rows[3]["stats"]["scheduler"]
+    assert sched["completed"] == 3
+    assert sched["device_rows"] == 1  # the duplicate deduplicated
 
 
 def test_batch_detect_output_preflight(tmp_path, capsys):

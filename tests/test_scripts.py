@@ -187,6 +187,7 @@ def test_cibuild_exists_and_is_wired():
     )
     assert (
         code.index("python -m pytest")
+        < code.index("serve --selftest")
         < code.index("python script/lint")
         < code.index("python -m build")
     )

@@ -1,0 +1,506 @@
+"""The online serving subsystem (licensee_tpu/serve/): micro-batch
+scheduling, content-hash result cache, backpressure, deadlines, device
+fallback, and the JSONL transports.  All CPU-only and fast — the
+scheduler's clocks are monotonic and every wait has a generous bound.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+import time
+
+import pytest
+
+from licensee_tpu.kernels.batch import BatchClassifier
+from licensee_tpu.serve.cache import ResultCache
+from licensee_tpu.serve.scheduler import MicroBatcher, QueueFullError
+from licensee_tpu.serve.server import (
+    UnixServer,
+    _Session,
+    serve_session,
+)
+from licensee_tpu.serve.stats import LatencyStats
+from tests.conftest import fixture_contents
+
+
+@pytest.fixture(scope="module")
+def clf():
+    return BatchClassifier(pad_batch_to=16, mesh=None)
+
+
+@pytest.fixture(scope="module")
+def mit_body():
+    from licensee_tpu.corpus.license import License
+
+    return re.sub(r"\[(\w+)\]", "example", License.find("mit").content)
+
+
+def dice_blob(mit_body: str, tag: str) -> str:
+    """A unique Dice-bound blob: the MIT body plus a couple of noise
+    words — defeats the Exact wordset prefilter but stays above the
+    confidence threshold, so the row must cross the device."""
+    return f"{mit_body}\nzqx{tag} zqy{tag}\n"
+
+
+# -- scheduler core --
+
+
+def test_prefilter_answers_without_device(clf, mit_body):
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        result = b.classify(mit_body, "LICENSE")
+        assert (result.key, result.matcher) == ("mit", "exact")
+        stats = b.stats()["scheduler"]
+        assert stats["prefiltered"] == 1
+        assert stats["device_batches"] == 0
+
+
+def test_deadline_flush_fires_with_partial_batch(clf, mit_body):
+    """3 requests against max_batch=64: the flush can only come from the
+    max_delay deadline, and it must carry all three rows in ONE device
+    batch."""
+    with MicroBatcher(
+        classifier=clf, max_batch=64, max_delay_ms=40.0, buckets=(4, 64),
+        start=False,
+    ) as b:
+        reqs = [
+            b.submit(dice_blob(mit_body, f"d{i}"), "LICENSE")
+            for i in range(3)
+        ]
+        b.start()  # all 3 queued: exactly one deadline flush can fire
+        results = [r.wait(60.0) for r in reqs]
+        assert all(r.key == "mit" and r.matcher == "dice" for r in results)
+        stats = b.stats()["scheduler"]
+        assert stats["device_batches"] == 1
+        assert stats["device_rows"] == 3
+        assert stats["flush"]["deadline"] == 1
+        assert stats["flush"]["full"] == 0
+
+
+def test_full_batch_flushes_without_waiting(clf, mit_body):
+    with MicroBatcher(
+        classifier=clf, max_batch=2, max_delay_ms=10_000.0, start=False,
+        buckets=(2,),
+    ) as b:
+        reqs = [
+            b.submit(dice_blob(mit_body, f"f{i}"), "LICENSE")
+            for i in range(2)
+        ]
+        b.start()
+        t0 = time.perf_counter()
+        for r in reqs:
+            r.wait(60.0)
+        # flushed on "full", not after the 10-second delay bound
+        assert time.perf_counter() - t0 < 9.0
+        assert b.stats()["scheduler"]["flush"]["full"] == 1
+
+
+def test_bucket_padding_picks_smallest_fitting_shape(clf, mit_body):
+    with MicroBatcher(
+        classifier=clf, max_batch=16, max_delay_ms=10_000.0,
+        buckets=(4, 16), start=False,
+    ) as b:
+        reqs = [
+            b.submit(dice_blob(mit_body, f"b{i}"), "LICENSE")
+            for i in range(3)
+        ]
+        b.start()
+        for r in reqs:
+            r.wait(60.0)
+        stats = b.stats()["scheduler"]
+    assert stats["buckets"] == {"4": 1}
+    assert stats["padded_rows"] == 1  # 3 rows padded to the 4-bucket
+
+
+def test_bucket_ladder_defaults_and_mesh_rounding(clf):
+    b = MicroBatcher(classifier=clf, max_batch=256, start=False)
+    try:
+        assert b.buckets == (8, 32, 128, 256)
+        assert b.bucket_for(1) == 8
+        assert b.bucket_for(9) == 32
+        assert b.bucket_for(256) == 256
+    finally:
+        b.close()
+
+
+def test_cache_hit_skips_device_dispatch(clf, mit_body):
+    blob = dice_blob(mit_body, "cache")
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        first = b.submit(blob, "LICENSE")
+        r1 = first.wait(60.0)
+        assert (r1.key, r1.matcher) == ("mit", "dice")
+        batches_before = b.stats()["scheduler"]["device_batches"]
+        second = b.submit(blob, "LICENSE")
+        r2 = second.wait(60.0)
+        assert second.cached and not first.cached
+        assert (r2.key, r2.matcher, r2.confidence) == (
+            r1.key, r1.matcher, r1.confidence
+        )
+        stats = b.stats()
+        assert stats["scheduler"]["device_batches"] == batches_before
+        assert stats["scheduler"]["cache_hits"] == 1
+        assert stats["cache"]["hits"] == 1
+
+
+def test_concurrent_duplicates_coalesce_to_one_device_row(clf, mit_body):
+    blob = dice_blob(mit_body, "dup")
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), start=False
+    ) as b:
+        a = b.submit(blob, "LICENSE")
+        c = b.submit(blob, "LICENSE")
+        b.start()
+        ra, rc = a.wait(60.0), c.wait(60.0)
+        assert (ra.key, rc.key) == ("mit", "mit")
+        assert rc.confidence == ra.confidence
+        assert c.cached  # answered without its own device slot
+        stats = b.stats()["scheduler"]
+        assert stats["device_rows"] == 1
+        assert stats["coalesced"] == 1
+
+
+def test_bucket_rounding_covers_max_batch_on_a_mesh():
+    """Every bucket — including the implicitly appended max_batch —
+    must divide across the mesh data axis, or full flushes would raise
+    in dispatch_chunks and degrade to the scalar fallback forever."""
+
+    class _FakeMesh:
+        shape = {"data": 8}
+
+    class _FakeClf:
+        mesh = _FakeMesh()
+        mode = "license"
+
+    b = MicroBatcher(
+        classifier=_FakeClf(), max_batch=100, buckets=(16, 30),
+        start=False,
+    )
+    try:
+        assert b.buckets == (16, 32, 104)
+        assert all(bucket % 8 == 0 for bucket in b.buckets)
+        assert b.buckets[-1] >= b.max_batch
+    finally:
+        b.close()
+
+
+def test_follower_outlives_expired_primary(clf, mit_body):
+    """A coalesced duplicate with no deadline must get the verdict even
+    when its primary's own deadline lapsed in the queue."""
+    blob = dice_blob(mit_body, "heir")
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), start=False
+    ) as b:
+        doomed = b.submit(blob, "LICENSE", deadline_ms=5.0)
+        heir = b.submit(blob, "LICENSE")  # coalesces onto doomed's row
+        time.sleep(0.05)  # doomed's deadline lapses; heir has none
+        b.start()
+        assert doomed.wait(60.0).error == "deadline_exceeded"
+        verdict = heir.wait(60.0)
+        assert (verdict.key, verdict.matcher) == ("mit", "dice")
+        assert heir.cached
+        stats = b.stats()["scheduler"]
+        assert stats["expired"] == 1
+        assert stats["device_rows"] == 1
+
+
+def test_submit_after_close_raises(clf, mit_body):
+    from licensee_tpu.serve.scheduler import BatcherClosedError
+
+    b = MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,))
+    b.close()
+    with pytest.raises(BatcherClosedError):
+        b.submit(dice_blob(mit_body, "dead"), "LICENSE")
+
+
+def test_full_queue_rejects_with_retry_after(clf, mit_body):
+    with MicroBatcher(
+        classifier=clf, queue_depth=2, max_delay_ms=5.0, buckets=(4,),
+        start=False,
+    ) as b:
+        reqs = [
+            b.submit(dice_blob(mit_body, f"q{i}"), "LICENSE")
+            for i in range(2)
+        ]
+        with pytest.raises(QueueFullError) as exc_info:
+            b.submit(dice_blob(mit_body, "q-overflow"), "LICENSE")
+        assert exc_info.value.retry_after > 0
+        assert b.stats()["scheduler"]["rejected"] == 1
+        # the queued requests still answer once the scheduler drains
+        b.start()
+        assert all(r.wait(60.0).key == "mit" for r in reqs)
+
+
+def test_per_request_deadline_expires_in_queue(clf, mit_body):
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), start=False
+    ) as b:
+        doomed = b.submit(
+            dice_blob(mit_body, "late"), "LICENSE", deadline_ms=5.0
+        )
+        time.sleep(0.05)  # let the deadline lapse while queued
+        b.start()
+        result = doomed.wait(60.0)
+        assert result.error == "deadline_exceeded"
+        assert result.key is None
+        assert b.stats()["scheduler"]["expired"] == 1
+
+
+def test_device_failure_falls_back_to_scalar_dice(clf, mit_body):
+    blob = dice_blob(mit_body, "fb")
+    # the device-path verdict, for comparison (fresh content so neither
+    # call can hit the other's cache)
+    expected = clf.classify_blobs([blob])[0]
+    assert (expected.key, expected.matcher) == ("mit", "dice")
+
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        original = b.classifier.dispatch_chunks
+
+        def broken(*args, **kwargs):
+            raise RuntimeError("injected device failure")
+
+        b.classifier.dispatch_chunks = broken
+        try:
+            result = b.classify(blob, "LICENSE")
+        finally:
+            b.classifier.dispatch_chunks = original
+        assert (result.key, result.matcher) == ("mit", "dice")
+        assert result.confidence == expected.confidence
+        assert b.stats()["scheduler"]["fallbacks"] == 1
+        # the fallback verdict is clean, so it was cached like any other
+        again = b.classify(blob, "LICENSE")
+        assert again.confidence == expected.confidence
+        assert b.stats()["scheduler"]["cache_hits"] == 1
+
+
+def test_auto_mode_routes_and_skips_unscored_filenames(mit_body):
+    auto = BatchClassifier(pad_batch_to=16, mesh=None, mode="auto")
+    with MicroBatcher(classifier=auto, max_delay_ms=5.0) as b:
+        licensed = b.classify(mit_body, "LICENSE")
+        assert (licensed.key, licensed.matcher) == ("mit", "exact")
+        unrouted = b.classify(mit_body, "main.c")
+        assert (unrouted.key, unrouted.matcher) == (None, None)
+        stats = b.stats()["scheduler"]
+        assert stats["unrouted"] == 1
+
+
+def test_serve_verdicts_match_offline_chain(clf):
+    """Acceptance: serving answers == the batch/detect chain's answers
+    for real fixture licenses (same code path by construction, but this
+    pins it end-to-end)."""
+    fixtures = [
+        ("mit/LICENSE.txt", "LICENSE.txt"),
+        ("bsd-2-author/LICENSE", "LICENSE"),
+        ("cc-by-nd/LICENSE", "LICENSE"),
+    ]
+    contents = [fixture_contents(path) for path, _ in fixtures]
+    offline = clf.classify_blobs(
+        contents, filenames=[name for _, name in fixtures]
+    )
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        for content, (_, name), expected in zip(contents, fixtures, offline):
+            got = b.classify(content, name)
+            assert (got.key, got.matcher, got.confidence) == (
+                expected.key, expected.matcher, expected.confidence
+            )
+
+
+# -- cache + stats units --
+
+
+def test_result_cache_lru_and_counters():
+    from licensee_tpu.kernels.batch import BlobResult
+
+    cache = ResultCache(capacity=2)
+    r = BlobResult("mit", "dice", 99.0, closest=[("isc", 88.0)])
+    cache.put("a", r)
+    cache.put("b", r)
+    assert cache.get("a").key == "mit"  # touches "a": LRU order b, a
+    cache.put("c", r)  # evicts "b"
+    assert cache.get("b") is None
+    assert cache.get("a") is not None and cache.get("c") is not None
+    stats = cache.stats()
+    assert stats["entries"] == 2
+    assert stats["evictions"] == 1
+    assert stats["hits"] == 3 and stats["misses"] == 1
+    # stored results are frozen copies: the caller's list is not aliased
+    assert isinstance(cache.get("a").closest, tuple)
+    assert cache.get("a") is not r
+
+
+def test_result_cache_zero_capacity_disables():
+    from licensee_tpu.kernels.batch import BlobResult
+
+    cache = ResultCache(capacity=0)
+    cache.put("a", BlobResult("mit", "dice", 99.0))
+    assert cache.get("a") is None
+    assert len(cache) == 0
+
+
+def test_latency_stats_percentiles():
+    ls = LatencyStats(capacity=100)
+    for ms in range(1, 101):  # 1..100 ms
+        ls.record(ms / 1000.0)
+    snap = ls.snapshot()
+    assert snap["count"] == 100
+    assert snap["p50_ms"] == 50.0
+    assert snap["p95_ms"] == 95.0
+    assert snap["p99_ms"] == 99.0
+    assert snap["max_ms"] == 100.0
+    empty = LatencyStats().snapshot()
+    assert empty["count"] == 0 and empty["p99_ms"] is None
+
+
+# -- transports --
+
+
+def _session_lines(rows):
+    return [json.dumps(r) for r in rows]
+
+
+def test_session_answers_in_request_order(clf, mit_body):
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        out: list[str] = []
+        counts = serve_session(
+            b,
+            _session_lines(
+                [
+                    {"id": "dice-1", "content": dice_blob(mit_body, "s1"),
+                     "filename": "LICENSE"},
+                    {"id": "exact-2", "content": mit_body,
+                     "filename": "LICENSE"},
+                    {"id": "stats-3", "op": "stats"},
+                    {"id": "bad-4", "op": "nope"},
+                ]
+            ),
+            out.append,
+        )
+    assert counts == {"requests": 4, "responses": 4}
+    rows = [json.loads(line) for line in out]
+    assert [r["id"] for r in rows] == ["dice-1", "exact-2", "stats-3", "bad-4"]
+    assert (rows[0]["key"], rows[0]["matcher"]) == ("mit", "dice")
+    assert (rows[1]["key"], rows[1]["matcher"]) == ("mit", "exact")
+    # the stats verb snapshots AFTER every earlier request answered
+    assert rows[2]["stats"]["scheduler"]["completed"] == 2
+    assert rows[2]["stats"]["latency_ms"]["total"]["count"] == 2
+    assert rows[3]["error"].startswith("bad_request")
+
+
+def test_session_surfaces_backpressure(clf, mit_body):
+    b = MicroBatcher(
+        classifier=clf, queue_depth=1, max_delay_ms=5.0, buckets=(4,),
+        start=False,
+    )
+    out: list[str] = []
+    session = _Session(b, out.append)
+    session.handle_line(json.dumps(
+        {"id": 1, "content": dice_blob(mit_body, "bp1"),
+         "filename": "LICENSE"}
+    ))
+    session.handle_line(json.dumps(
+        {"id": 2, "content": dice_blob(mit_body, "bp2"),
+         "filename": "LICENSE"}
+    ))
+    b.start()  # only now can request 1 answer
+    session.finish()
+    b.close()
+    rows = [json.loads(line) for line in out]
+    assert [r["id"] for r in rows] == [1, 2]
+    assert rows[0]["key"] == "mit"
+    assert rows[1]["error"] == "queue_full"
+    assert rows[1]["retry_after"] > 0
+
+
+def test_session_rejects_malformed_lines(clf, mit_body):
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        out: list[str] = []
+        serve_session(
+            b,
+            [
+                "not json",
+                json.dumps({"id": 7}),
+                json.dumps([1, 2]),
+                json.dumps({"id": 8, "content": "x", "filename": 5}),
+                json.dumps(
+                    {"id": 9, "content": "x", "deadline_ms": "100"}
+                ),
+                json.dumps({"id": 10, "content": "x", "deadline_ms": -1}),
+                # the session survives every bad line above and still
+                # answers a good request
+                json.dumps({"id": 11, "content": mit_body,
+                            "filename": "LICENSE"}),
+            ],
+            out.append,
+        )
+    rows = [json.loads(line) for line in out]
+    assert all("bad_request" in r["error"] for r in rows[:6])
+    assert rows[1]["id"] == 7
+    assert (rows[6]["id"], rows[6]["key"]) == (11, "mit")
+
+
+def test_unix_socket_transport(clf, mit_body, tmp_path):
+    path = str(tmp_path / "serve.sock")
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        server = UnixServer(path, b)
+        thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        thread.start()
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(path)
+                f = s.makefile("rwb")
+                for row in (
+                    {"id": 1, "content": dice_blob(mit_body, "ux"),
+                     "filename": "LICENSE"},
+                    {"id": 2, "content": dice_blob(mit_body, "ux"),
+                     "filename": "LICENSE"},
+                    {"id": 3, "op": "stats"},
+                ):
+                    f.write(json.dumps(row).encode() + b"\n")
+                f.flush()
+                rows = [json.loads(f.readline()) for _ in range(3)]
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+    assert rows[0]["key"] == "mit"
+    assert rows[1]["key"] == "mit" and rows[1]["cached"]
+    sched = rows[2]["stats"]["scheduler"]
+    assert sched["device_rows"] == 1  # the duplicate never hit the device
+
+
+# -- the shared featurize helper (offline/online drift guard) --
+
+
+def test_featurize_request_matches_offline_keys(mit_body):
+    """The serve cache and the offline dedupe cache share one key
+    function; pin the shape so neither can drift silently."""
+    from licensee_tpu.serve.featurize import content_key, dispatch_key
+
+    assert dispatch_key("license", "LICENSE") == ("license", False)
+    assert dispatch_key("license", "license.html") == ("license", True)
+    assert dispatch_key("package", "Cargo.toml") == ("package", "Cargo.toml")
+    key = content_key("license", "LICENSE", b"hello")
+    assert key[0] == ("license", False)
+    assert len(key[1]) == 20  # sha1 digest
+
+    # attribution folds the copyright? filename gate into the key
+    with_attr = dispatch_key("license", "COPYRIGHT", attribution=True)
+    without = dispatch_key("license", "LICENSE", attribution=True)
+    assert with_attr != without
+
+
+def test_batch_project_reexports_shared_helpers():
+    """batch_project's long-standing private names now alias the shared
+    serve/featurize implementations — one definition for both paths."""
+    from licensee_tpu.projects import batch_project
+    from licensee_tpu.serve import featurize
+
+    assert batch_project._produce_batch is featurize.produce_batch
+    assert batch_project._read_capped is featurize.read_capped
+    assert batch_project._jsonl_row is featurize.jsonl_row
+    assert batch_project._IN_BATCH_DUP is featurize.IN_BATCH_DUP
+    assert batch_project._UNROUTED is featurize.UNROUTED
