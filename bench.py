@@ -507,6 +507,12 @@ def bench_host_model(
     return {
         "files": n_files,
         "avg_bytes": total_bytes // n_files,
+        # the ONE number the host-featurize optimization rounds track:
+        # us/blob for the featurize crossing (native when built, the
+        # full prepare path otherwise) — also surfaced in the headline
+        "featurize_us_per_blob": (
+            us(native_s) if native_s is not None else us(prepare_s)
+        ),
         "per_blob_us": {
             "read": us(read_s),
             "sha1_dedupe": us(sha_s),
@@ -942,6 +948,7 @@ def make_headline(
     at_scale = details.get("end_to_end_1m") or {}
     at_auto = details.get("end_to_end_1m_auto") or {}
     serve = details.get("serve_path") or {}
+    hm = details.get("host_model") or {}
     return {
         "metric": metric,
         "value": round(value, 1),
@@ -981,6 +988,14 @@ def make_headline(
                 "uncached_rps": serve.get("uncached_rps"),
                 "cached_rps": serve.get("cached_rps"),
                 "p99_ms": serve.get("p99_ms"),
+            },
+            # the host-featurize trajectory: crossing us/blob and the
+            # single-process Amdahl ceiling it implies
+            "host_model": {
+                "featurize_us_per_blob": hm.get("featurize_us_per_blob"),
+                "amdahl_ceiling_files_per_sec": (
+                    hm.get("scaling_model") or {}
+                ).get("amdahl_ceiling_files_per_sec"),
             },
             "details_file": "BENCH_DETAILS.json",
         },

@@ -576,32 +576,36 @@ class BatchClassifier:
                 fast.append(i)
                 fast_bytes.append(raw)
             if fast:
+                # token bits land zero-copy in the final batch rows: a
+                # full batch writes row i directly, a sparse subset
+                # (preset/dedupe rows interleaved) routes through the
+                # native row map — no staging matrix, no per-blob
+                # copy-out on either shape
                 whole = len(fast) == B
-                sub_bits = (
-                    bits if whole else np.zeros((len(fast), W), np.uint32)
+                rows = (
+                    None if whole else np.asarray(fast, dtype=np.int64)
                 )
                 meta = np.zeros((len(fast), 3), dtype=np.int32)
                 hashes = np.zeros((len(fast), 16), dtype=np.uint8)
                 try:
                     status = self._nat.featurize_batch(
-                        self._nat_vocab, fast_bytes, sub_bits, meta, hashes
+                        self._nat_vocab, fast_bytes, bits, meta, hashes,
+                        rows=rows,
                     )
                 except Exception:  # noqa: BLE001 — whole-batch containment
                     # the per-blob loop below re-does every row with its
                     # own per-blob error containment
                     status = np.full(len(fast), 3, dtype=np.int8)
-                    if whole:
-                        bits[:] = 0
+                    bits[fast] = 0
                 for j, i in enumerate(fast):
                     if status[j] != 0:
+                        bits[i] = 0  # failed-over row: wiped for Python
                         continue  # per-blob fallback below
                     done[i] = 1
                     flags = int(meta[j, 2])
                     if prefilter and flags & 1:
                         results[i] = BlobResult("no-license", "copyright", 100.0)
                         continue
-                    if not whole:
-                        bits[i] = sub_bits[j]
                     if prefilter:
                         h = hashes[j].tobytes()
                         if h in self._exact_hashes:

@@ -190,7 +190,7 @@ class NativePipeline:
         lib.pipe_featurize_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int8),
         ]
@@ -316,6 +316,7 @@ class NativePipeline:
         bits_out: np.ndarray,
         meta_out: np.ndarray,
         hash_out: np.ndarray,
+        rows: np.ndarray | None = None,
     ) -> np.ndarray:
         """One ctypes crossing for a whole batch of RAW byte blobs.
 
@@ -327,16 +328,39 @@ class NativePipeline:
         (n, 16) uint8.  Returns a status array: 0 ok, 2 non-ASCII, 3
         PCRE2 resource limit — non-zero rows must be redone on the
         Unicode-safe Python path.  The GIL is dropped for the whole
-        batch, so featurization worker threads scale across cores."""
+        batch, so featurization worker threads scale across cores.
+
+        ``rows`` (optional int64[n]) maps blob i to its ROW of a larger
+        ``bits_out`` matrix: when the native-eligible blobs are a sparse
+        subset of a batch (preset/dedupe rows interleaved), the token
+        bits are still written zero-copy into the caller-owned final row
+        — no staging matrix, no per-blob copy-out.  ``meta_out`` and
+        ``hash_out`` stay compact (indexed by blob, not row)."""
         n = len(contents)
         status = np.zeros(n, dtype=np.int8)
         if n == 0:
             return status
+        bits_rows = None
+        if rows is not None:
+            rows = np.ascontiguousarray(rows, dtype=np.int64)
+            if rows.shape != (n,):
+                raise ValueError(
+                    f"rows: need int64 shape ({n},), got {rows.shape}"
+                )
+            if len(rows) and (
+                rows.min() < 0 or rows.max() >= bits_out.shape[0]
+            ):
+                raise ValueError(
+                    f"rows: values out of range for bits_out with "
+                    f"{bits_out.shape[0]} rows"
+                )
+            bits_rows = rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
         # the native side writes through raw row-strided pointers — the
         # layout contract must hold even under python -O, so raise, don't
         # assert
+        n_bits_rows = bits_out.shape[0] if rows is not None else n
         for name, arr, dtype, shape in (
-            ("bits_out", bits_out, np.uint32, (n, vocab.n_lanes)),
+            ("bits_out", bits_out, np.uint32, (n_bits_rows, vocab.n_lanes)),
             ("meta_out", meta_out, np.int32, (n, 3)),
             ("hash_out", hash_out, np.uint8, (n, 16)),
         ):
@@ -357,6 +381,7 @@ class NativePipeline:
             datas,
             lens,
             n,
+            bits_rows,
             bits_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             meta_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             hash_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

@@ -1,0 +1,198 @@
+"""Native featurizer parity selftest + microbench (the cibuild smoke).
+
+Asserts, over the full vendored corpus plus adversarial blobs, that the
+fused single-pass native featurizer is BIT-IDENTICAL to the pure-Python
+pipeline on every surface a score can depend on: normalized text,
+content hash, packed wordset bits, |wordset|, normalized length, and the
+prefilter outcomes.  Then reports the featurize crossing in us/blob.
+
+Run as ``python -m licensee_tpu.native.selftest`` (script/cibuild does):
+exit 0 on parity (or when the native library is unavailable — there is
+nothing to diverge from then), exit 1 on any mismatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import time
+
+import numpy as np
+
+
+def adversarial_blobs() -> list[bytes]:
+    """Edge-case blobs: the shapes that historically diverge pipelines."""
+    mit = (
+        b"MIT License\n\nCopyright (c) 2026 Example\n\nPermission is "
+        b"hereby granted, free of charge, to any person obtaining a copy "
+        b"of this software and associated documentation files (the "
+        b'"Software"), to deal in the Software without restriction.\n'
+    )
+    return [
+        b"",
+        b" \t\r\n ",
+        mit,
+        mit.replace(b"\n", b"\r\n"),  # CRLF universal-newline preamble
+        mit.replace(b"\n", b"\r"),  # bare-CR
+        b"\xef\xbb\xbf" + mit,  # BOM (non-ASCII: two-crossing path)
+        # unicode dashes/quotes (non-ASCII fallback + folds)
+        "em—dash – en, ‘curly’ “quotes”".encode(),
+        "MITライセンス".encode(),  # MITライセンス
+        b"<html><body><p>Licensed under the MIT license.</p></body></html>",
+        b"Copyright (c) 2001 Someone\nAll rights reserved.",
+        b"- bullet one\n\n- bullet two\n\n  3. numbered\n\n(a) lettered\n",
+        b"a" * 70000,  # one huge line (beyond the 64 KiB read cap)
+        (b"word " * 2000) + b"\n\n" + (b"term " * 2000),
+        b"it's the boss' licence, sub-license per cent non-commercial",
+        b"s's' 'quote' can't won't\n",
+        b"=== \n*** bordered ***\n> quoted\n## heading\n[link](http://x)\n",
+        b"http://example.com & http://other.example\n\nEND OF TERMS AND "
+        b"CONDITIONS\n\ntrailing text",
+        b"// comment line one\n// comment line two\n// comment line three",
+        b"version 2.0\nhttps://spdx.org/licenses/MIT\nreal content here",
+        b"\x00embedded\x00nuls\x00",
+    ]
+
+
+def corpus_blobs() -> list[bytes]:
+    """Every vendored template's raw text — the full-corpus parity set."""
+    from licensee_tpu.corpus.license import License
+
+    return [
+        lic.content.encode("utf-8")
+        for lic in License.all(hidden=True, pseudo=False)
+        if lic.content
+    ]
+
+
+def run_parity(classifier=None) -> dict:
+    """Raises AssertionError on any native/Python divergence."""
+    from licensee_tpu.kernels.batch import BatchClassifier, NormalizedBlob
+    from licensee_tpu.project_files.project_file import sanitize_content
+    from licensee_tpu.rubytext import ruby_strip
+
+    clf = classifier or BatchClassifier(mesh=None, device=False)
+    if clf._nat is None:
+        return {"skipped": "native pipeline unavailable"}
+    blobs = adversarial_blobs() + corpus_blobs()
+    B = len(blobs)
+    W = clf.corpus.n_lanes
+
+    prepared = clf.prepare_batch(list(blobs))
+
+    bits2 = np.zeros((B, W), dtype=np.uint32)
+    n_words2 = np.zeros(B, dtype=np.int32)
+    lengths2 = np.zeros(B, dtype=np.int32)
+    cc2 = np.zeros(B, dtype=bool)
+    results2: list = [None] * B
+    for i, raw in enumerate(blobs):
+        clf._prepare_one_python(
+            raw, results2, bits2, n_words2, lengths2, cc2, i
+        )
+
+    mismatches = []
+    for i in range(B):
+        r1, r2 = prepared.results[i], results2[i]
+        if (r1 is None) != (r2 is None) or (
+            r1 is not None
+            and (r1.key, r1.matcher, r1.confidence)
+            != (r2.key, r2.matcher, r2.confidence)
+        ):
+            mismatches.append((i, "result", r1, r2))
+            continue
+        if r1 is None:
+            if not np.array_equal(prepared.bits[i], bits2[i]):
+                mismatches.append((i, "bits", None, None))
+            if prepared.n_words[i] != n_words2[i]:
+                mismatches.append(
+                    (i, "n_words", prepared.n_words[i], n_words2[i])
+                )
+            if prepared.lengths[i] != lengths2[i]:
+                mismatches.append(
+                    (i, "length", prepared.lengths[i], lengths2[i])
+                )
+            if prepared.cc_fp[i] != cc2[i]:
+                mismatches.append((i, "cc_fp", prepared.cc_fp[i], cc2[i]))
+
+    # normalized text + content hash, via the two-crossing surface
+    text_checked = 0
+    for raw in blobs:
+        content = sanitize_content(raw)
+        stripped = ruby_strip(content)
+        s1, _flags = clf._nat.stage1(stripped)
+        s2 = clf._nat.stage2(s1.lower())
+        blob = NormalizedBlob(raw)
+        want = blob.content_normalized()
+        if s2 != want:
+            mismatches.append((raw[:40], "normalized_text", s2[:80], want[:80]))
+        elif (
+            hashlib.sha1(s2.encode("utf-8")).hexdigest() != blob.content_hash
+        ):
+            mismatches.append((raw[:40], "content_hash", None, None))
+        text_checked += 1
+
+    assert not mismatches, (
+        f"native/python featurizer divergence ({len(mismatches)} rows): "
+        f"{mismatches[:3]}"
+    )
+    return {"blobs": B, "text_checked": text_checked}
+
+
+def bench_crossing(classifier=None, n: int = 256, reps: int = 3) -> float:
+    """min us/blob for the whole-batch native crossing on ~10KB blobs."""
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    clf = classifier or BatchClassifier(mesh=None, device=False)
+    if clf._nat is None:
+        return float("nan")
+    # ASCII-only seeds: a non-ASCII blob exits the crossing after the
+    # all_ascii scan (status 2, near-free) and would understate us/blob
+    seeds = [
+        b
+        for b in corpus_blobs()
+        if len(b) > 512 and all(x < 0x80 for x in b)
+    ][:16] or [b"some license words " * 64]
+    blobs = [
+        (seeds[i % len(seeds)] * (1 + 10000 // max(1, len(seeds[i % len(seeds)]))))[
+            :10000
+        ]
+        for i in range(n)
+    ]
+    W = clf.corpus.n_lanes
+    bits = np.zeros((n, W), dtype=np.uint32)
+    meta = np.zeros((n, 3), dtype=np.int32)
+    hashes = np.zeros((n, 16), dtype=np.uint8)
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        status = clf._nat.featurize_batch(
+            clf._nat_vocab, blobs, bits, meta, hashes
+        )
+        dt = (time.perf_counter() - t0) / n * 1e6
+        assert (status == 0).all(), "bench blobs must take the fast path"
+        best = dt if best is None or dt < best else best
+    return round(best, 1)
+
+
+def main() -> int:
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    clf = BatchClassifier(mesh=None, device=False)
+    if clf._nat is None:
+        print("native selftest: SKIP (native pipeline unavailable)")
+        return 0
+    try:
+        stats = run_parity(clf)
+    except AssertionError as exc:
+        print(f"native selftest: FAIL — {exc}", file=sys.stderr)
+        return 1
+    us = bench_crossing(clf)
+    print(
+        f"native selftest: parity OK over {stats['blobs']} blobs; "
+        f"featurize crossing {us} us/blob"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

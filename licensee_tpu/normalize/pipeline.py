@@ -163,15 +163,55 @@ def _native():
     return textops.load()
 
 
-def _plain_strip(content: str, regex: re.Pattern) -> str:
+# [`'"‘“’”] -> "'" is a pure character map: str.translate runs it at C
+# speed, byte-identically to _QUOTES.sub("'", ...)
+_QUOTE_TABLE = str.maketrans({ch: "'" for ch in "`'\"‘“’”"})
+
+
+_RUN3_MASK = None  # lazy: one 256-entry bool mask for [=\-*], built once
+
+
+def _has_run3(c: str) -> bool:
+    """Vectorized gate for the hrs pass: ^\\s*[=\\-*]{3,}\\s*$ cannot
+    match without 3 consecutive bytes from the class — one numpy
+    frombuffer + shift-AND answers that without a regex scan."""
+    global _RUN3_MASK
+    import numpy as np
+
+    if _RUN3_MASK is None:
+        mask = np.zeros(256, dtype=bool)
+        mask[[ord(ch) for ch in "=-*"]] = True
+        _RUN3_MASK = mask
+    b = c.encode("utf-8", "surrogatepass")
+    if len(b) < 3:
+        return False
+    m = _RUN3_MASK[np.frombuffer(b, dtype=np.uint8)]
+    return bool((m[:-2] & m[1:-1] & m[2:]).any())
+
+
+def _starts_after_ws(c: str, needle_lower: str) -> bool:
+    """Gate for \\A\\s*<literal> heads (version/url/developed_by): skip
+    the Ruby-\\s run, then a caseless literal compare — no regex."""
+    i = 0
+    n = len(c)
+    while i < n and c[i] in " \t\n\v\f\r":
+        i += 1
+    return c[i : i + len(needle_lower)].lower() == needle_lower
+
+
+def _plain_strip(content: str, regex: re.Pattern, might: bool = True) -> str:
     """Ruby ContentHelper#strip: gsub(regex, ' ').squeeze(' ').strip —
-    the squeeze and strip apply even when the regex does not match."""
+    the squeeze and strip apply even when the regex does not match.
+
+    ``might=False`` means a literal gate proved the regex cannot match:
+    the sub is skipped but the squeeze/strip contract still holds."""
     nat = _native()
     if nat is not None:
         if regex is REGEXES["whitespace"]:
             return nat.strip_whitespace(content)
-        return nat.squeeze_strip(regex.sub(" ", content))
-    return ruby_strip(squeeze_spaces(regex.sub(" ", content)))
+        return nat.squeeze_strip(regex.sub(" ", content) if might else content)
+    subbed = regex.sub(" ", content) if might else content
+    return ruby_strip(squeeze_spaces(subbed))
 
 
 class NormalizedContent:
@@ -239,12 +279,20 @@ class NormalizedContent:
         if cached is None:
             c = ruby_strip(self.content if self.content is not None else "")
             c = self._strip_html(c)
-            c = _plain_strip(c, REGEXES["hrs"])
+            # literal gates: a pass whose pattern requires a byte/substring
+            # the text lacks cannot match — same rationale (and the same
+            # gate set) as the native pipeline's plain_strip_gated
+            c = _plain_strip(c, REGEXES["hrs"], might=_has_run3(c))
             c = self._strip_comments(c)
-            c = _plain_strip(c, REGEXES["markdown_headings"])
-            c = REGEXES["link_markup"].sub(lambda m: m.group(1), c)
+            c = _plain_strip(
+                c, REGEXES["markdown_headings"], might="#" in c
+            )
+            if "[" in c:
+                c = REGEXES["link_markup"].sub(lambda m: m.group(1), c)
             c = self._strip_title(c)
-            c = _plain_strip(c, REGEXES["version"])
+            c = _plain_strip(
+                c, REGEXES["version"], might=_starts_after_ws(c, "version")
+            )
             cached = c
             self.__dict__["_cwtv"] = cached
         return cached
@@ -256,41 +304,66 @@ class NormalizedContent:
 
             # normalizations (gsub only — no squeeze/strip side effects);
             # the dash/quote/hyphenation/spelling passes run as native
-            # scanners when built (bit-identical, tests/test_textops.py)
+            # scanners when built (bit-identical, tests/test_textops.py).
+            # Each gated pass is a literal no-op when its required byte is
+            # absent; _HTTP and the quote class are literal/char-class
+            # transforms, so str.replace / str.translate run them at C
+            # speed byte-identically on the fallback path.
             nat = _native()
             c = _LISTS.sub(lambda m: "- " + m.group(1), c)
-            c = _HTTP.sub("https:", c)
+            c = c.replace("http:", "https:")
             c = c.replace("&", "and")
+            has_dashish = "-" in c or "–" in c or "—" in c
             if nat is not None:
-                c = nat.dashes(c)
+                if has_dashish:
+                    c = nat.dashes(c)
                 c = nat.quotes(c)
-                c = nat.hyphenated(c)
+                if "-" in c:
+                    c = nat.hyphenated(c)
                 c = nat.spelling(c)
             else:
-                c = _DASHES.sub("-", c)
-                c = _QUOTES.sub("'", c)
-                c = _HYPHENATED.sub(lambda m: m.group(1) + "-" + m.group(2), c)
+                if has_dashish:
+                    c = _DASHES.sub("-", c)
+                c = c.translate(_QUOTE_TABLE)
+                if "-" in c:
+                    c = _HYPHENATED.sub(
+                        lambda m: m.group(1) + "-" + m.group(2), c
+                    )
                 c = _SPELLING.sub(lambda m: VARIETAL_WORDS[m.group(0)], c)
-            c = REGEXES["span_markup"].sub(lambda m: m.group(1), c)
-            c = REGEXES["bullet"].sub(lambda _m: "\n\n- ", c)
-            c = _BULLET_JOIN.sub(lambda _m: ")(", c)
+            if "_" in c or "*" in c or "~" in c:
+                c = REGEXES["span_markup"].sub(lambda m: m.group(1), c)
+            if "\n\n" in c:
+                c = REGEXES["bullet"].sub(lambda _m: "\n\n- ", c)
+            if ")" in c:
+                c = _BULLET_JOIN.sub(lambda _m: ")(", c)
 
             # strip methods (content_helper.rb:89-105), in order
-            c = _plain_strip(c, REGEXES["bom"])
+            c = _plain_strip(c, REGEXES["bom"], might="﻿" in c)
             c = self._strip_cc_optional(c)
             c = self._strip_cc0_optional(c)
             c = self._strip_unlicense_optional(c)
-            c = REGEXES["border_markup"].sub(lambda m: m.group(1), c)
+            if "*" in c or "-" in c:
+                c = REGEXES["border_markup"].sub(lambda m: m.group(1), c)
             c = self._strip_title(c)
-            c = _plain_strip(c, REGEXES["version"])
-            c = _plain_strip(c, REGEXES["url"])
+            c = _plain_strip(
+                c, REGEXES["version"], might=_starts_after_ws(c, "version")
+            )
+            c = _plain_strip(
+                c, REGEXES["url"], might=_starts_after_ws(c, "http")
+            )
             c = self._strip_copyright(c)
             c = self._strip_title(c)
-            c = _plain_strip(c, REGEXES["block_markup"])
-            c = _plain_strip(c, REGEXES["developed_by"])
+            c = _plain_strip(c, REGEXES["block_markup"], might=">" in c)
+            c = _plain_strip(
+                c,
+                REGEXES["developed_by"],
+                might=_starts_after_ws(c, "developed by:"),
+            )
             c = self._strip_end_of_terms(c)
             c = _plain_strip(c, REGEXES["whitespace"])
-            c = _plain_strip(c, REGEXES["mit_optional"])
+            c = _plain_strip(
+                c, REGEXES["mit_optional"], might="(including" in c
+            )
 
             cached = c
             self.__dict__["_content_normalized"] = cached

@@ -63,6 +63,7 @@ def apply_visible_chips(env=None) -> list[str] | None:
     Returns the chip list, or None when the env var is unset."""
     global _chips_applied
     env = os.environ if env is None else env
+    is_process_env = env is os.environ
     spec = env.get("LICENSEE_TPU_VISIBLE_CHIPS")
     if spec is None:
         return None
@@ -71,7 +72,10 @@ def apply_visible_chips(env=None) -> list[str] | None:
         raise ValueError(
             f"LICENSEE_TPU_VISIBLE_CHIPS={spec!r}: no chip ids"
         )
-    if _chips_applied is not None:
+    # the applied-state latch tracks the PROCESS environment only: a
+    # dict-env dry run must neither consume the latch (a later real
+    # apply would silently export nothing) nor be blocked by it
+    if is_process_env and _chips_applied is not None:
         if chips != _chips_applied:
             raise RuntimeError(
                 f"LICENSEE_TPU_VISIBLE_CHIPS changed after apply: "
@@ -93,7 +97,13 @@ def apply_visible_chips(env=None) -> list[str] | None:
                 "already initialized; set it before the first device use"
             )
     want = ",".join(chips)
-    have = os.environ.get("TPU_VISIBLE_DEVICES")
+    # read AND write through the SAME mapping the chip spec came from: a
+    # caller-supplied dict env must be validated against itself and must
+    # never leak writes into os.environ (ADVICE r5 — the old code read
+    # the spec from `env` but conflict-checked and mutated os.environ,
+    # so a dict-env dry run could both miss a real conflict in `env` and
+    # corrupt the live process environment)
+    have = env.get("TPU_VISIBLE_DEVICES")
     if have is not None and have != want:
         # refuse loudly: a stale/wrapper-set value silently winning over
         # the requested subset would leave co-located ranks contending
@@ -102,23 +112,24 @@ def apply_visible_chips(env=None) -> list[str] | None:
             f"TPU_VISIBLE_DEVICES={have!r} conflicts with "
             f"LICENSEE_TPU_VISIBLE_CHIPS={spec!r}; unset one"
         )
-    os.environ["TPU_VISIBLE_DEVICES"] = want
+    env["TPU_VISIBLE_DEVICES"] = want
     # CPU rehearsal: LICENSEE_TPU_VISIBLE_CHIPS is authoritative for the
     # virtual local-device count — rewrite a leaked count (test harnesses
     # commonly export one) instead of silently keeping it
     import re
 
     flag = f"--xla_force_host_platform_device_count={len(chips)}"
-    flags = os.environ.get("XLA_FLAGS", "")
+    flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" in flags:
         flags = re.sub(
             r"--xla_force_host_platform_device_count=\d+", flag, flags
         )
-        os.environ["XLA_FLAGS"] = flags
+        env["XLA_FLAGS"] = flags
     else:
-        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+        env["XLA_FLAGS"] = (flags + " " + flag).strip()
     _export_colocated_tpu_vars(env, chips)
-    _chips_applied = chips
+    if is_process_env:
+        _chips_applied = chips
     return chips
 
 
@@ -152,12 +163,14 @@ def _export_colocated_tpu_vars(env, chips: list[str]) -> None:
         return
     n_i, rank_i = int(n), int(rank)
     base = int(env.get("LICENSEE_TPU_PROCESS_PORT_BASE", "8476"))
-    os.environ.setdefault("TPU_PROCESS_PORT", str(base + rank_i))
-    os.environ.setdefault(
+    # write through the caller's mapping, like apply_visible_chips: in
+    # production env IS os.environ; a dict env stays self-contained
+    env.setdefault("TPU_PROCESS_PORT", str(base + rank_i))
+    env.setdefault(
         "TPU_PROCESS_ADDRESSES",
         ",".join(f"localhost:{base + i}" for i in range(n_i)),
     )
-    os.environ.setdefault("CLOUD_TPU_TASK_ID", str(rank))
+    env.setdefault("CLOUD_TPU_TASK_ID", str(rank))
     for src, dst in (
         ("LICENSEE_TPU_PROCESS_BOUNDS", "TPU_PROCESS_BOUNDS"),
         (
@@ -166,7 +179,7 @@ def _export_colocated_tpu_vars(env, chips: list[str]) -> None:
         ),
     ):
         if env.get(src):
-            os.environ.setdefault(dst, env[src])
+            env.setdefault(dst, env[src])
 
 
 def maybe_initialize(env=None) -> tuple[int, int]:

@@ -405,6 +405,19 @@ class BatchProject:
                 "keys_sha1": hashlib.sha1(
                     "\n".join(corpus.keys).encode(), usedforsecurity=False
                 ).hexdigest(),
+                # per-template normalized-CONTENT hashes folded in
+                # (ADVICE r5): an edited vendored template with unchanged
+                # keys and vocab size must refuse to resume — the rows it
+                # would append score against different template text
+                "content_sha1": hashlib.sha1(
+                    "\n".join(
+                        sorted(
+                            f"{key}:{h}"
+                            for h, key in corpus.content_hashes.items()
+                        )
+                    ).encode(),
+                    usedforsecurity=False,
+                ).hexdigest(),
             }
         return {
             "mode": self.mode,

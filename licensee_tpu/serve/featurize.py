@@ -7,6 +7,12 @@ capped read policy, the route-aware dispatch/content cache key, the
 batch produce core (route + read + dedupe + prefilter + featurize), the
 memoized JSONL row renderer, and the single-request twin
 ``featurize_request`` that the micro-batcher calls at admission time.
+
+Both chains featurize through the shared BATCH crossing only
+(``classifier.prepare_batch`` -> one ``pipe_featurize_batch`` ctypes
+call per worker chunk, token bits written zero-copy into the
+caller-owned rows); per-blob native featurize calls are forbidden on
+these hot paths by a ``script/lint`` house rule.
 """
 
 from __future__ import annotations

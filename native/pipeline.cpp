@@ -23,6 +23,7 @@
 // tests/test_native_pipeline.py; end-to-end oracle: the SHA1 golden
 // corpus (tests/test_normalize_hashes.py runs this path when built).
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -80,9 +81,13 @@ namespace {
 
 struct Pat {
   pcre2_code *code = nullptr;
+  // \A-anchored pattern: at most one gsub match, always at the subject
+  // start — eligible for the zero-copy head-peel fast path below
+  bool anchored = false;
 
   bool compile(const std::string &pattern, const std::string &flags,
                std::string *err_out) {
+    anchored = pattern.compare(0, 2, "\\A") == 0;
     uint32_t options = kMultiline;  // Ruby ^/$ are always line anchors
     for (char f : flags) {
       if (f == 'i') options |= kCaseless;
@@ -128,22 +133,30 @@ struct Scratch {
   ~Scratch() { pcre2_match_data_free_8(md); }
 };
 
-// search: does `pat` match anywhere in s?  On a JIT resource error,
-// retry interpretively before giving up.
-bool search(const Pat &p, const std::string &s, Scratch &scr,
-            size_t *start_out = nullptr) {
-  int rc = pcre2_match_8(p.code, reinterpret_cast<const uint8_t *>(s.data()),
-                         s.size(), 0, 0, scr.md, nullptr);
+// search over a raw (ptr, len) subject: does `pat` match anywhere?  On a
+// JIT resource error, retry interpretively before giving up.  The span
+// outputs let the head-peel fast path reuse the one match.
+bool search_raw(const Pat &p, const char *data, size_t len, Scratch &scr,
+                size_t *start_out = nullptr, size_t *end_out = nullptr) {
+  int rc = pcre2_match_8(p.code, reinterpret_cast<const uint8_t *>(data),
+                         len, 0, 0, scr.md, nullptr);
   if (rc < 0 && rc != kNoMatch)
-    rc = pcre2_match_8(p.code, reinterpret_cast<const uint8_t *>(s.data()),
-                       s.size(), 0, kNoJit, scr.md, nullptr);
+    rc = pcre2_match_8(p.code, reinterpret_cast<const uint8_t *>(data),
+                       len, 0, kNoJit, scr.md, nullptr);
   if (rc == kNoMatch) return false;
   if (rc < 0) {
     scr.err = rc;  // resource limit, NOT a no-match — blob must fail over
     return false;
   }
-  if (start_out) *start_out = pcre2_get_ovector_pointer_8(scr.md)[0];
+  size_t *ov = pcre2_get_ovector_pointer_8(scr.md);
+  if (start_out) *start_out = ov[0];
+  if (end_out) *end_out = ov[1];
   return true;
+}
+
+bool search(const Pat &p, const std::string &s, Scratch &scr,
+            size_t *start_out = nullptr) {
+  return search_raw(p, s.data(), s.size(), scr, start_out);
 }
 
 // gsub: global substitute with a replacement template ("$1" group refs
@@ -215,6 +228,78 @@ std::string gsub_pass(const Pat &p, std::string s, const char *repl,
   return gsub(p, s, repl, scr);
 }
 
+// plain_strip with a precomputed literal gate: `might` == false means
+// the pattern provably cannot match this text (a byte it requires is
+// absent), which takes the exact no-match path — including the deferred
+// squeeze(' ').strip repair — without paying the PCRE2 scan.
+std::string plain_strip_gated(const Pat &p, std::string s, Scratch &scr,
+                              bool *clean, bool might) {
+  if (!might) {
+    if (*clean) return s;
+    *clean = true;
+    return sc::squeeze_strip(s.data(), s.size());
+  }
+  return plain_strip(p, std::move(s), scr, clean);
+}
+
+// ---------------------------------------------------------------------------
+// TextView: a (buffer, offset) view supporting ZERO-COPY head peeling.
+//
+// Every strip in the title/version/url/copyright block is \A-anchored,
+// so its gsub has at most one match, at the head: gsub(' ') + squeeze +
+// strip of a clean string is exactly "drop the matched prefix, then the
+// leading strippables" — a pointer advance, where the old path paid a
+// full-text substitute plus a full-text squeeze_strip copy per peel.
+// The caller materializes (one copy) only when a non-anchored pass needs
+// a real string.
+
+struct TextView {
+  std::string buf;
+  size_t off = 0;
+
+  explicit TextView(std::string s) : buf(std::move(s)) {}
+  const char *data() const { return buf.data() + off; }
+  size_t size() const { return buf.size() - off; }
+  void assign(std::string s) {
+    buf = std::move(s);
+    off = 0;
+  }
+  std::string take() {
+    if (off) buf.erase(0, off);
+    off = 0;
+    return std::move(buf);
+  }
+  void lstrip() {
+    while (off < buf.size() &&
+           sc::is_strippable(static_cast<unsigned char>(buf[off])))
+      ++off;
+  }
+};
+
+// One anchored peel == one plain_strip of an \A-anchored pattern.
+// Preserves the squeeze/strip-on-no-match contract via `clean` (the
+// caller must have materialized the squeeze when unclean — peels only
+// run with *clean == true, enforced below).  Returns true if a match
+// was peeled (the strip_loop condition).
+bool peel_anchored(const Pat &p, TextView &v, Scratch &scr, bool *clean) {
+  size_t start, end;
+  if (!search_raw(p, v.data(), v.size(), scr, &start, &end)) return false;
+  if (end == 0) return false;  // zero-width: no progress (loop safety)
+  // \A-anchored: start == 0.  gsub -> " " + tail; squeeze+strip of a
+  // clean string == lstrip(tail).
+  v.off += end;
+  v.lstrip();
+  return true;
+}
+
+// The non-anchored passes run on a materialized string; this wraps the
+// materialize + pass + re-assign dance.
+template <class F>
+void view_pass(TextView &v, F &&f) {
+  std::string s = v.take();
+  v.assign(f(std::move(s)));
+}
+
 bool contains(const std::string &s, const char *needle) {
   // glibc memmem is vectorized; std::string::find is a byte loop and
   // showed up in profiles at ~0.3 ns/byte x three gates per blob
@@ -223,21 +308,6 @@ bool contains(const std::string &s, const char *needle) {
 
 bool has_byte(const std::string &s, char c) {
   return std::memchr(s.data(), c, s.size()) != nullptr;
-}
-
-// Ruby String#split("\n") drops trailing empty fields.
-std::vector<std::pair<size_t, size_t>> split_lines(const std::string &s) {
-  std::vector<std::pair<size_t, size_t>> lines;
-  size_t start = 0;
-  for (size_t i = 0; i <= s.size(); ++i) {
-    if (i == s.size() || s[i] == '\n') {
-      lines.emplace_back(start, i - start);
-      start = i + 1;
-      if (i == s.size()) break;
-    }
-  }
-  while (!lines.empty() && lines.back().second == 0) lines.pop_back();
-  return lines;
 }
 
 // ---------------------------------------------------------------------------
@@ -277,6 +347,32 @@ struct PassTimer {
 };
 
 // ---------------------------------------------------------------------------
+// Always-on per-stage counters (normalize / tokenize+vocab / pack), the
+// attribution surface for the next optimization round: a handful of
+// relaxed atomic adds and 4 clock reads per blob (~0.1 us against a
+// multi-10-us blob), surfaced through pipe_profile_dump as stage.* and
+// count.* rows with no env flag required.  The fine-grained per-pass
+// rows (s1.*/s2.*) stay behind LICENSEE_TPU_PIPE_PROFILE.
+
+struct StageStats {
+  std::atomic<uint64_t> blobs{0}, bytes_in{0}, tokens{0}, uniques{0},
+      oov{0}, nonascii{0};
+  std::atomic<uint64_t> normalize_ns{0}, wordset_ns{0}, pack_ns{0};
+};
+
+StageStats &stage_stats() {
+  static StageStats s;
+  return s;
+}
+
+inline uint64_t now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+// ---------------------------------------------------------------------------
 // Pipeline handle
 
 struct Pipeline {
@@ -301,17 +397,63 @@ struct Pipeline {
     return c;
   }
 
-  // content_helper.rb:246-252 — only strip when every line is a comment
+  // strip_loop on a view: zero-copy peels when the pattern is anchored
+  // (the usual case — title/copyright are \A\s*-headed), the classic
+  // materialized loop otherwise.  Requires *clean (callers ensure it).
+  void peel_loop(const Pat &p, TextView &v, Scratch &scr,
+                 bool *clean) const {
+    if (p.anchored) {
+      for (int guard = 0; guard < 1000 && peel_anchored(p, v, scr, clean);
+           ++guard) {
+      }
+      return;
+    }
+    view_pass(v, [&](std::string s) {
+      return strip_loop(p, std::move(s), scr, clean);
+    });
+  }
+
+  // one anchored strip (strip_loop without the loop)
+  void peel_once(const Pat &p, TextView &v, Scratch &scr,
+                 bool *clean) const {
+    if (p.anchored) {
+      peel_anchored(p, v, scr, clean);
+      return;
+    }
+    view_pass(v, [&](std::string s) {
+      return plain_strip(p, std::move(s), scr, clean);
+    });
+  }
+
+  void ensure_clean(TextView &v, bool *clean) const {
+    if (*clean) return;
+    v.assign(sc::squeeze_strip(v.data(), v.size()));
+    *clean = true;
+  }
+
+  // content_helper.rb:246-252 — only strip when every line is a comment.
+  // The per-line gate is a byte scan (first non-space char is / or *)
+  // that early-exits on the first prose line — no line vector, no PCRE2
+  // unless the blob is all-comment and actually strips.
   std::string strip_comments(std::string c, Scratch &scr,
                              bool *clean) const {
-    const Pat &p = *pat("comment_markup");
-    auto lines = split_lines(c);
-    if (lines.size() <= 1) return c;
-    for (auto &ln : lines) {
-      std::string line = c.substr(ln.first, ln.second);
-      if (!search(p, line, scr)) return c;
+    // Ruby split("\n") drops trailing empty fields: ignore the trailing
+    // '\n' run (an interior empty line still fails the comment test,
+    // exactly like the original per-line regex)
+    size_t end = c.size();
+    while (end > 0 && c[end - 1] == '\n') --end;
+    size_t ls = 0, n_lines = 0;
+    while (ls <= end && end > 0) {
+      const char *nl = static_cast<const char *>(
+          std::memchr(c.data() + ls, '\n', end - ls));
+      size_t le = nl ? static_cast<size_t>(nl - c.data()) : end;
+      if (!sc::line_is_comment(c.data() + ls, le - ls)) return c;
+      ++n_lines;
+      if (!nl) break;
+      ls = le + 1;
     }
-    return plain_strip(p, std::move(c), scr, clean);
+    if (n_lines <= 1) return c;
+    return plain_strip(*pat("comment_markup"), std::move(c), scr, clean);
   }
 
   // Stage 1: content_without_title_and_version (content_helper.rb:144-151)
@@ -322,93 +464,47 @@ struct Pipeline {
     // cannot match, and a non-matching pass returns its input unchanged —
     // memchr at ~50 GB/s beats even a failing PCRE2 scan
     bool clean = sc::is_squeezed_clean(c.data(), c.size());
-    c = plain_strip(*pat("hrs"), std::move(c), scr, &clean);
+    // gates are hoisted: argument evaluation order vs std::move is
+    // unspecified, so never read `c` in the same call that moves it
+    bool hrs_might = sc::has_run3_of(c.data(), c.size(), '=', '-', '*');
+    c = plain_strip_gated(*pat("hrs"), std::move(c), scr, &clean,
+                          hrs_might);
     c = strip_comments(std::move(c), scr, &clean);
-    if (has_byte(c, '#'))
-      c = plain_strip(*pat("markdown_headings"), std::move(c), scr, &clean);
+    bool md_might = has_byte(c, '#');
+    c = plain_strip_gated(*pat("markdown_headings"), std::move(c), scr,
+                          &clean, md_might);
     if (has_byte(c, '['))
       c = gsub_pass(*pat("link_markup"), std::move(c), "$1", scr, &clean);
-    c = strip_loop(*pat("title"), std::move(c), scr, &clean);
-    c = plain_strip(*pat("version"), std::move(c), scr, &clean);
-    return c;
+    TextView v(std::move(c));
+    ensure_clean(v, &clean);
+    peel_loop(*pat("title"), v, scr, &clean);
+    peel_once(*pat("version"), v, scr, &clean);
+    return v.take();
   }
 
-  // Stage 2: content_normalized (content_helper.rb:153-168), input is the
-  // Python-downcased stage-1 output.
-  std::string stage2(std::string c, Scratch &scr) const {
-    bool clean = sc::is_squeezed_clean(c.data(), c.size());
+  // Stage 2: content_normalized (content_helper.rb:153-168).  The input
+  // is the stage-1 output; `downcase` folds A-Z inside the fused head
+  // scan (the all-ASCII fast path — callers on the Unicode path downcase
+  // in Python first and pass false).
+  std::string stage2(std::string c, Scratch &scr,
+                     bool downcase = false) const {
+    bool clean;
     {
-      PassTimer t("s2.lists");
-      c = gsub_pass(*pat("lists"), std::move(c), "- $1", scr, &clean);
-    }
-    // gsub(/http:/, 'https:') and gsub(/&/, 'and') — literal span scans.
-    // memchr/memmem, not std::string::find: find is a byte loop that
-    // costs ~0.3 ns/byte, and this block rescans the tail after every
-    // hit (replacements introduce no spaces, so `clean` is preserved)
-    {
-      PassTimer t("s2.literal_scan");
-      const char *base = c.data();
-      const char *amp = static_cast<const char *>(
-          std::memchr(base, '&', c.size()));
-      const char *http = static_cast<const char *>(
-          memmem(base, c.size(), "http:", 5));
-      if (amp || http) {
-        // kAbsent = "definitively not in the remaining tail" (sticky:
-        // the subject never mutates, so a failed scan never repeats);
-        // nullptr = "consumed, position unknown — rescan once".  A live
-        // cached hit is always at/after i: neither needle can sit
-        // inside the other's replaced span ("http:" has no '&' and
-        // vice versa), so consuming one never invalidates the other.
-        const char *kAbsent = base + c.size();
-        if (!amp) amp = kAbsent;
-        if (!http) http = kAbsent;
-        std::string r;
-        r.reserve(c.size() + 16);
-        size_t i = 0;
-        auto resolve = [&](const char *&cached, auto rescan) -> size_t {
-          if (cached == nullptr) {
-            cached = rescan();
-            if (cached == nullptr) cached = kAbsent;
-          }
-          return static_cast<size_t>(cached - base);
-        };
-        while (i < c.size()) {
-          size_t a = resolve(amp, [&] {
-            return static_cast<const char *>(
-                std::memchr(base + i, '&', c.size() - i));
-          });
-          size_t h = resolve(http, [&] {
-            return static_cast<const char *>(
-                memmem(base + i, c.size() - i, "http:", 5));
-          });
-          size_t next = a < h ? a : h;
-          if (next >= c.size()) break;
-          r.append(c, i, next - i);
-          if (a < h) {
-            r += "and";
-            i = next + 1;
-            amp = nullptr;  // consumed; re-scan once from the new tail
-          } else {
-            r += "https:";
-            i = next + 5;
-            http = nullptr;
-          }
-        }
-        r.append(c, i, std::string::npos);
-        c = std::move(r);
-      }
-    }
-    {
-      PassTimer t("s2.sc.dashes");
-      c = sc::dashes(c.data(), c.size());
-    }
-    {
-      PassTimer t("s2.sc.quotes");
-      c = sc::quotes(c.data(), c.size());
+      // fused single-pass head: downcase + lists + http:/& + dashes +
+      // quotes in ONE scan (see fold_scan's soundness note) — formerly
+      // five full-text passes, two of them PCRE2
+      PassTimer t("s2.fold");
+      bool pre_clean = sc::is_squeezed_clean(c.data(), c.size());
+      bool lists_fired = false;
+      c = sc::fold_scan(c.data(), c.size(), downcase, &lists_fired);
+      // only the lists replacement can introduce double spaces or edge
+      // strippables (e.g. "- " + a captured space); the literal/dash/
+      // quote folds replace non-space with non-space
+      clean = pre_clean && !lists_fired;
     }
     {
       PassTimer t("s2.sc.hyphenated");
-      c = sc::hyphenated(c.data(), c.size());
+      if (has_byte(c, '-')) c = sc::hyphenated(c.data(), c.size());
     }
     {
       PassTimer t("s2.sc.spelling");
@@ -419,12 +515,22 @@ struct Pipeline {
     if (sc::find_byte4(c.data(), c.data() + c.size(), '_', '*', '~', '~') !=
         c.data() + c.size()) {
       PassTimer t("s2.span_markup");
-      c = gsub_pass(*pat("span_markup"), std::move(c), "$1", scr, &clean);
+      bool changed;
+      c = sc::span_markup_scan(c.data(), c.size(), &changed);
+      if (changed) clean = false;
     }
     {
       PassTimer t("s2.bullet");
-      c = gsub_pass(*pat("bullet"), std::move(c), "\n\n- ", scr, &clean);
-      c = gsub_pass(*pat("bullet_join"), std::move(c), ")(", scr, &clean);
+      if (memmem(c.data(), c.size(), "\n\n", 2)) {
+        bool changed;
+        c = sc::bullet_scan(c.data(), c.size(), &changed);
+        if (changed) clean = false;
+      }
+      if (has_byte(c, ')')) {
+        bool changed;
+        c = sc::bullet_join_scan(c.data(), c.size(), &changed);
+        if (changed) clean = false;
+      }
     }
 
     // strip methods (content_helper.rb:89-105), in order.  bom's pattern
@@ -461,24 +567,36 @@ struct Pipeline {
                         &clean);
       }
     }
-    {
+    if (has_byte(c, '*') || has_byte(c, '-')) {
       PassTimer t("s2.border_markup");
-      c = gsub_pass(*pat("border_markup"), std::move(c), "$1", scr, &clean);
+      bool changed;
+      c = sc::border_markup_scan(c.data(), c.size(), &changed);
+      if (changed) clean = false;
     }
+    TextView v(std::move(c));
     {
+      // the title/version/url/copyright block: all \A-anchored, so each
+      // peel is a pointer advance instead of a substitute + squeeze copy
       PassTimer t("s2.title_strips");
-      c = strip_loop(*pat("title"), std::move(c), scr, &clean);
-      c = plain_strip(*pat("version"), std::move(c), scr, &clean);
-      c = plain_strip(*pat("url"), std::move(c), scr, &clean);
-      c = strip_loop(*pat("strip_copyright"), std::move(c), scr, &clean);
-      c = strip_loop(*pat("title"), std::move(c), scr, &clean);
+      ensure_clean(v, &clean);
+      peel_loop(*pat("title"), v, scr, &clean);
+      peel_once(*pat("version"), v, scr, &clean);
+      if (url_gate(v.data(), v.size()))
+        peel_once(*pat("url"), v, scr, &clean);
+      peel_loop(*pat("strip_copyright"), v, scr, &clean);
+      peel_loop(*pat("title"), v, scr, &clean);
     }
-    if (has_byte(c, '>')) {
+    if (memchr(v.data(), '>', v.size())) {
       PassTimer t("s2.block_markup");
-      c = plain_strip(*pat("block_markup"), std::move(c), scr, &clean);
+      view_pass(v, [&](std::string s) {
+        return plain_strip(*pat("block_markup"), std::move(s), scr,
+                           &clean);
+      });
     }
     PassTimer t_tail("s2.tail");
-    c = plain_strip(*pat("developed_by"), std::move(c), scr, &clean);
+    if (developed_by_gate(v.data(), v.size()))
+      peel_once(*pat("developed_by"), v, scr, &clean);
+    c = v.take();
     size_t eot;
     // the pattern's literal core; subject is already downcased here
     if (contains(c, "end of ") &&
@@ -492,51 +610,211 @@ struct Pipeline {
       c = plain_strip(*pat("mit_optional"), std::move(c), scr, &clean);
     return c;
   }
+
+  // \A\s*https?:// — the url pattern's mandatory head
+  static bool url_gate(const char *d, size_t len) {
+    size_t i = 0;
+    while (i < len && sc::is_space(static_cast<unsigned char>(d[i]))) ++i;
+    if (i + 4 > len || std::memcmp(d + i, "http", 4) != 0) return false;
+    i += 4;
+    if (i < len && d[i] == 's') ++i;
+    return i + 3 <= len && std::memcmp(d + i, "://", 3) == 0;
+  }
+
+  // \A\s*developed by: (caseless) — the developed_by pattern's head
+  static bool developed_by_gate(const char *d, size_t len) {
+    size_t i = 0;
+    while (i < len && sc::is_space(static_cast<unsigned char>(d[i]))) ++i;
+    return sc::starts_ci(d + i, d + len, "developed by:", 13);
+  }
+
+  // the copyright_full prefilter's mandatory head: only [\s_*-]* may
+  // precede the first copyright symbol (caseless "copyright", "(c)", ©)
+  static bool copyright_head_gate(const char *d, size_t len) {
+    size_t i = 0;
+    while (i < len) {
+      unsigned char ch = static_cast<unsigned char>(d[i]);
+      if (sc::is_space(ch) || ch == '_' || ch == '*' || ch == '-')
+        ++i;
+      else
+        break;
+    }
+    if (i >= len) return false;
+    if (sc::starts_ci(d + i, d + len, "copyright", 9)) return true;
+    if (d[i] == '(' && i + 2 < len &&
+        sc::lower_ascii(d[i + 1]) == 'c' && d[i + 2] == ')')
+      return true;
+    return static_cast<unsigned char>(d[i]) == 0xc2 && i + 1 < len &&
+           static_cast<unsigned char>(d[i + 1]) == 0xa9;  // ©
+  }
 };
 
 // ---------------------------------------------------------------------------
-// Vocab handle: token -> id open-addressing map (FNV-1a), plus lane count
+// Vocab handle: token -> id map, built ONCE per corpus as a CHD-style
+// perfect hash (displacement per bucket): every lookup is exactly one
+// probe of a compact 16-byte slot — the round-5 profile put the open
+// chain's L2-missing probe walk at ~1/4 of the whole crossing.  The
+// legacy open-addressing table remains as the fallback for the
+// (astronomically unlikely) full-64-bit hash collision between two
+// vocab words, which the perfect-hash build cannot place.
+
+inline uint64_t vocab_mix64(uint64_t h) {
+  h += 0x9e3779b97f4a7c15ull;
+  h = (h ^ (h >> 30)) * 0xbf58476d1ce4e5b9ull;
+  h = (h ^ (h >> 27)) * 0x94d049bb133111ebull;
+  return h ^ (h >> 31);
+}
 
 struct Vocab {
-  std::string blob;  // '\0'-joined words, id = order
+  std::string blob;  // '\0'-joined words + '\0' sentinel, id = order
+  uint32_t n_lanes = 0;
+  uint32_t n_words = 0;
+
+  // perfect-hash state
+  struct Slot {
+    uint64_t hash = 0;
+    uint32_t off_plus1 = 0;  // 0 = empty
+    uint32_t id = 0;
+  };
+  std::vector<Slot> slots;
+  std::vector<uint32_t> disp;
+  size_t smask = 0, bmask = 0;
+  bool perfect = false;
+
+  // legacy fallback
   struct Entry {
     uint64_t hash;
     uint32_t off, len, id;
     bool used = false;
   };
   std::vector<Entry> table;
-  uint32_t n_lanes = 0;
 
   static uint64_t fnv(const char *p, size_t n) { return sc::token_hash(p, n); }
 
+  static size_t slot_of(uint64_t h, uint32_t d, size_t smask) {
+    return (h + d * ((h >> 32) | 1)) & smask;
+  }
+
   void load(const char *data, size_t len, uint32_t lanes) {
     blob.assign(data, len);
+    // sentinel ('\0' word-end checks) + padding: lookups compare via
+    // 8-byte loads, which may read up to 7 bytes past a word's end
+    blob.append(8, '\0');
     n_lanes = lanes;
     std::vector<std::pair<uint32_t, uint32_t>> words;
     size_t start = 0;
-    for (size_t i = 0; i <= blob.size(); ++i) {
-      if (i == blob.size() || blob[i] == '\0') {
+    for (size_t i = 0; i <= len; ++i) {
+      if (i == len || blob[i] == '\0') {
         words.emplace_back(static_cast<uint32_t>(start),
                            static_cast<uint32_t>(i - start));
         start = i + 1;
-        if (i == blob.size()) break;
+        if (i == len) break;
       }
     }
     if (len == 0) words.clear();
+    n_words = static_cast<uint32_t>(words.size());
+    std::vector<uint64_t> hs(words.size());
+    for (uint32_t id = 0; id < words.size(); ++id)
+      hs[id] = fnv(blob.data() + words[id].first, words[id].second);
+    if (!build_perfect(words, hs)) build_legacy(words, hs);
+  }
+
+  bool build_perfect(const std::vector<std::pair<uint32_t, uint32_t>> &words,
+                     const std::vector<uint64_t> &hs) {
+    size_t n = words.size();
+    size_t S = 16;
+    while (S < n * 2) S <<= 1;
+    for (int attempt = 0; attempt < 3; ++attempt, S <<= 1) {
+      size_t B = 16;
+      while (B < n / 4 + 1) B <<= 1;
+      std::vector<std::vector<uint32_t>> buckets(B);
+      for (uint32_t id = 0; id < n; ++id)
+        buckets[vocab_mix64(hs[id]) & (B - 1)].push_back(id);
+      std::vector<uint32_t> order(B);
+      for (uint32_t b = 0; b < B; ++b) order[b] = b;
+      std::sort(order.begin(), order.end(), [&](uint32_t a, uint32_t b) {
+        return buckets[a].size() > buckets[b].size();
+      });
+      std::vector<Slot> sl(S);
+      std::vector<uint32_t> dp(B, 0);
+      bool ok = true;
+      std::vector<size_t> pos;
+      for (uint32_t b : order) {
+        const auto &ids = buckets[b];
+        if (ids.empty()) break;  // sorted desc: the rest are empty too
+        uint32_t d = 0;
+        for (;; ++d) {
+          if (d == 4096) {
+            ok = false;
+            break;
+          }
+          pos.clear();
+          bool fits = true;
+          for (uint32_t id : ids) {
+            size_t s = slot_of(hs[id], d, S - 1);
+            if (sl[s].off_plus1) {
+              fits = false;
+              break;
+            }
+            for (size_t p : pos)
+              if (p == s) {
+                fits = false;
+                break;
+              }
+            if (!fits) break;
+            pos.push_back(s);
+          }
+          if (fits) break;
+        }
+        if (!ok) break;
+        dp[b] = d;
+        for (size_t k = 0; k < ids.size(); ++k)
+          sl[pos[k]] = Slot{hs[ids[k]], words[ids[k]].first + 1, ids[k]};
+      }
+      if (ok) {
+        slots = std::move(sl);
+        disp = std::move(dp);
+        smask = S - 1;
+        bmask = B - 1;
+        perfect = true;
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void build_legacy(const std::vector<std::pair<uint32_t, uint32_t>> &words,
+                    const std::vector<uint64_t> &hs) {
     size_t cap = 16;
     while (cap < words.size() * 2) cap <<= 1;
     table.assign(cap, Entry{});
     for (uint32_t id = 0; id < words.size(); ++id) {
-      uint64_t h = fnv(blob.data() + words[id].first, words[id].second);
-      size_t slot = h & (cap - 1);
+      size_t slot = hs[id] & (cap - 1);
       while (table[slot].used) slot = (slot + 1) & (cap - 1);
-      table[slot] = Entry{h, words[id].first, words[id].second, id, true};
+      table[slot] =
+          Entry{hs[id], words[id].first, words[id].second, id, true};
     }
   }
 
-  // returns id or UINT32_MAX; `h` is the token's FNV-1a64 (same function
-  // the wordset scan folds inline)
-  uint32_t find_hashed(const char *p, size_t n, uint64_t h) const {
+  // returns id or UINT32_MAX; `h` is the token's hash (same function the
+  // wordset scan computes inline).  The compare + terminator check is
+  // the exactness proof — the hash only picks the slot.  `p_padded`:
+  // the caller guarantees 8-byte loads up to 7 bytes past p+n are in
+  // bounds (the blob side is always padded by load()).
+  uint32_t find_hashed(const char *p, size_t n, uint64_t h,
+                       bool p_padded = false) const {
+    if (perfect) {
+      uint32_t d = disp[vocab_mix64(h) & bmask];
+      const Slot &s = slots[slot_of(h, d, smask)];
+      if (s.off_plus1 && s.hash == h) {
+        uint32_t off = s.off_plus1 - 1;
+        if (off + n < blob.size() && blob[off + n] == '\0' &&
+            (p_padded ? sc::span_eq_padded(blob.data() + off, p, n)
+                      : std::memcmp(blob.data() + off, p, n) == 0))
+          return s.id;
+      }
+      return UINT32_MAX;
+    }
     if (table.empty()) return UINT32_MAX;
     size_t cap = table.size();
     size_t slot = h & (cap - 1);
@@ -573,6 +851,99 @@ void wordset_hash(const std::vector<uint64_t> &token_hashes, uint8_t *out16) {
   }
   std::memcpy(out16, &h1, 8);
   std::memcpy(out16 + 8, &h2, 8);
+}
+
+// ---------------------------------------------------------------------------
+// The fused tokenize+vocab+pack loop: ONE walk over the normalized text
+// dedupes each token span through a generation-tagged scratch table and
+// resolves NEW tokens against the perfect-hash vocab — duplicate tokens
+// (the ~3/4 majority of license prose) never touch the vocab table, and
+// each unique pays exactly one CHD probe.  The scratch is sized to the
+// expected unique count (~len/16 entries) so it stays L1-resident,
+// where the round-1 len/4 sizing spilled to L2 at 11 KB blobs.  The
+// 128-bit wordset hash is the same order-independent multiset sum, so
+// the fused discovery order changes nothing.
+static void featurize_text(Vocab *vocab, const std::string &c,
+                           uint32_t *bits_out, uint64_t *tokens_out,
+                           uint32_t *unique_out, uint32_t *oov_out,
+                           uint8_t *hash_out) {
+  const size_t W = vocab->n_lanes;
+  std::memset(bits_out, 0, W * sizeof(uint32_t));
+  struct E {
+    uint32_t off_plus1;  // 0 only via gen mismatch; offsets are +1
+    uint32_t len;
+    uint32_t tag;  // upper 32 bits of the token hash
+    uint32_t gen;  // slot occupied iff gen == current generation
+  };
+  thread_local std::vector<E> seen;
+  thread_local uint32_t generation = 0;
+  if (++generation == 0) {
+    std::memset(seen.data(), 0, seen.size() * sizeof(E));
+    generation = 1;
+  }
+  const uint32_t gen = generation;
+  // unique tokens ~= len/30 for license prose; size for load <= ~0.5 and
+  // grow on pathological inputs (runs of 1-char tokens)
+  size_t want = 64;
+  while (want < c.size() / 16) want <<= 1;
+  if (seen.size() < want) seen.resize(want);  // new slots arrive gen=0
+  size_t mask = want - 1;  // probes stay within the sized prefix
+  uint64_t s1 = 0, s2 = 0, n_tokens = 0;
+  uint32_t n_unique = 0, n_oov = 0;
+  size_t live = 0;
+  const char *base = c.data();
+  // spans with 8-byte-load headroom use the call-free compares; only
+  // tokens butting the last 7 bytes of the text take the memcmp path
+  const size_t pad_lim = c.size() >= 7 ? c.size() - 7 : 0;
+  sc::scan_tokens(base, c.size(), [&](size_t start, size_t n, uint64_t h) {
+    ++n_tokens;
+    const bool padded = start + n <= pad_lim;
+    size_t slot = h & mask;
+    const uint32_t tag = static_cast<uint32_t>(h >> 32);
+    while (seen[slot].gen == gen) {
+      const E &e = seen[slot];
+      if (e.tag == tag && e.len == n &&
+          (padded && e.off_plus1 - 1 + n <= pad_lim
+               ? sc::span_eq_padded(base + e.off_plus1 - 1, base + start, n)
+               : std::memcmp(base + e.off_plus1 - 1, base + start, n) ==
+                     0))
+        return;  // duplicate token
+      slot = (slot + 1) & mask;
+    }
+    seen[slot] = E{static_cast<uint32_t>(start + 1),
+                   static_cast<uint32_t>(n), tag, gen};
+    if (++live * 2 > want) {
+      // grow + rehash the live generation (stays exact, just slower;
+      // rehash recomputes the full hash from the recorded span)
+      std::vector<E> bigger(want * 2);
+      for (size_t k = 0; k < want; ++k)
+        if (seen[k].gen == gen) {
+          uint64_t hh = sc::token_hash(base + seen[k].off_plus1 - 1,
+                                       seen[k].len);
+          size_t s = hh & (bigger.size() - 1);
+          while (bigger[s].gen == gen) s = (s + 1) & (bigger.size() - 1);
+          bigger[s] = seen[k];
+        }
+      seen.swap(bigger);
+      want <<= 1;
+      mask = want - 1;
+    }
+    ++n_unique;
+    s1 += h;
+    s2 += mix64(h);
+    uint32_t id = vocab->find_hashed(base + start, n, h, padded);
+    if (id != UINT32_MAX && (id >> 5) < W)
+      bits_out[id >> 5] |= 1u << (id & 31);
+    else
+      ++n_oov;
+  });
+  uint64_t h1 = static_cast<uint64_t>(n_unique) + s1;
+  uint64_t h2 = ~static_cast<uint64_t>(n_unique) + s2;
+  std::memcpy(hash_out, &h1, 8);
+  std::memcpy(hash_out + 8, &h2, 8);
+  *tokens_out = n_tokens;
+  *unique_out = n_unique;
+  *oov_out = n_oov;
 }
 
 char *to_buf(const std::string &s, size_t *out_len) {
@@ -646,6 +1017,41 @@ const char *pipe_error(void *handle) {
 
 void pipe_del(void *handle) { delete static_cast<Pipeline *>(handle); }
 
+// Prefilter flag computation, shared by every entry point: bit0 is the
+// Copyright matcher's full-content test, bit1 the CC-NC/ND guard — both
+// behind literal gates that skip the PCRE2 scan when a byte/substring
+// the pattern requires is absent.
+// literal gate for CC_FALSE_POSITIVE: the pattern requires a caseless
+// "Attribution-" — scan the (sparse) '-' sites and caseless-compare the
+// 11 bytes before each.  Anchoring the scan on '-' matters: a caseless
+// scan keyed on 'a' would visit most of the text.
+static bool attribution_gate(const char *d, size_t len) {
+  size_t i = 11;
+  while (i < len) {
+    const char *p =
+        static_cast<const char *>(std::memchr(d + i, '-', len - i));
+    if (!p) return false;
+    size_t k = static_cast<size_t>(p - d);
+    if (sc::starts_ci(d + k - 11, d + len, "attribution", 11)) return true;
+    i = k + 1;
+  }
+  return false;
+}
+
+static int32_t prefilter_flags(Pipeline *pl, const std::string &in,
+                               Scratch &scr) {
+  int32_t flags = 0;
+  // both searches sit behind literal gates: the copyright pattern's
+  // [\s_*-]*-then-symbol head, and the CC pattern's "Attribution-" core
+  if (Pipeline::copyright_head_gate(in.data(), in.size()) &&
+      search(*pl->pat("copyright_full"), in, scr))
+    flags |= 1;
+  if (attribution_gate(in.data(), in.size()) &&
+      search(*pl->pat("cc_false_positive"), in, scr))
+    flags |= 2;
+  return flags;
+}
+
 // Stage 1.  flags_out bit0: copyright-notice-only file (the Copyright
 // matcher's full-content test, matchers/copyright.rb:13, on the as-given
 // input which Python has already String#strip'd); bit1: CC-NC/ND false
@@ -658,12 +1064,7 @@ char *pipe_stage1(void *handle, const char *data, size_t len, size_t *out_len,
   auto *pl = static_cast<Pipeline *>(handle);
   Scratch scr;
   std::string in(data, len);
-  int32_t flags = 0;
-  if (flags_out) {
-    if (search(*pl->pat("copyright_full"), in, scr)) flags |= 1;
-    if (search(*pl->pat("cc_false_positive"), in, scr)) flags |= 2;
-    *flags_out = flags;
-  }
+  if (flags_out) *flags_out = prefilter_flags(pl, in, scr);
   std::string out = pl->stage1(std::move(in), scr);
   if (scr.err) return nullptr;
   return to_buf(out, out_len);
@@ -688,6 +1089,36 @@ void *pipe_vocab_new(const char *words, size_t words_len, uint32_t n_lanes) {
 
 void pipe_vocab_del(void *handle) { delete static_cast<Vocab *>(handle); }
 
+// The wordset+vocab+pack tail shared by every featurize entry point:
+// the fused loop, the always-on stage counters, and (in profile mode)
+// the tokenize-only split re-scan.
+static void featurize_tail(Vocab *vocab, const std::string &c,
+                           uint32_t *bits_out, int32_t *out,
+                           uint8_t *hash_out) {
+  StageStats &st = stage_stats();
+  uint64_t t0 = now_ns();
+  uint64_t n_tokens;
+  uint32_t n_unique, n_oov;
+  featurize_text(vocab, c, bits_out, &n_tokens, &n_unique, &n_oov,
+                 hash_out);
+  uint64_t t1 = now_ns();
+  out[0] = static_cast<int32_t>(n_unique);
+  st.wordset_ns.fetch_add(t1 - t0, std::memory_order_relaxed);
+  st.pack_ns.fetch_add(now_ns() - t1, std::memory_order_relaxed);
+  st.tokens.fetch_add(n_tokens, std::memory_order_relaxed);
+  st.uniques.fetch_add(n_unique, std::memory_order_relaxed);
+  st.oov.fetch_add(n_oov, std::memory_order_relaxed);
+  if (PassProf::enabled()) {
+    // the tokenize/vocab split inside the fused loop, recovered by a
+    // timed scan-only pass: tokenize ~= this, vocab ~= wordset - this
+    PassTimer t("stage.tokenize_only");
+    uint64_t sink = 0;
+    sc::scan_tokens(c.data(), c.size(),
+                    [&](size_t, size_t, uint64_t h) { sink ^= h; });
+    if (sink == 0x5eedbead) std::fputc(0, stderr);  // defeat DCE
+  }
+}
+
 // Featurize: run stage 2 on the downcased stage-1 text, then extract the
 // wordset and project it onto the corpus vocabulary.
 //   bits_out   uint32[n_lanes]  (memset + vocab-id bit per in-vocab token)
@@ -702,26 +1133,20 @@ int pipe_featurize(void *handle, void *vocab_handle, const char *data,
   auto *pl = static_cast<Pipeline *>(handle);
   auto *vocab = static_cast<Vocab *>(vocab_handle);
   Scratch scr;
+  StageStats &st = stage_stats();
+  uint64_t t0 = now_ns();
   std::string c = pl->stage2(std::string(data, len), scr);
   if (scr.err) return 3;  // resource failure: caller falls back to Python
+  st.normalize_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
+  st.blobs.fetch_add(1, std::memory_order_relaxed);
+  st.bytes_in.fetch_add(len, std::memory_order_relaxed);
 
-  std::vector<uint64_t> hashes;
-  std::vector<sc::Slice> uniq = sc::wordset_unique(c.data(), c.size(), &hashes);
-  std::memset(bits_out, 0, vocab->n_lanes * sizeof(uint32_t));
-  for (size_t k = 0; k < uniq.size(); ++k) {
-    uint32_t id = vocab->find_hashed(c.data() + uniq[k].off, uniq[k].len,
-                                     hashes[k]);
-    if (id != UINT32_MAX && (id >> 5) < vocab->n_lanes)
-      bits_out[id >> 5] |= (1u << (id & 31));
-  }
-  out[0] = static_cast<int32_t>(uniq.size());
+  featurize_tail(vocab, c, bits_out, out, hash_out);
   // character length = non-continuation UTF-8 bytes
   size_t chars = 0;
   for (char ch : c)
     if ((static_cast<unsigned char>(ch) & 0xc0) != 0x80) ++chars;
   out[1] = static_cast<int32_t>(chars);
-
-  wordset_hash(hashes, hash_out);
   return 0;
 }
 
@@ -736,12 +1161,13 @@ int pipe_featurize(void *handle, void *vocab_handle, const char *data,
 static int featurize_ascii_core(Pipeline *pl, Vocab *vocab, const char *data,
                                 size_t len, Scratch &scr, uint32_t *bits_out,
                                 int32_t *out, uint8_t *hash_out) {
+  StageStats &st = stage_stats();
+  uint64_t t0 = now_ns();
   std::string in(data, len);
-  int32_t flags = 0;
+  int32_t flags;
   {
     PassTimer t("prefilters");
-    if (search(*pl->pat("copyright_full"), in, scr)) flags |= 1;
-    if (search(*pl->pat("cc_false_positive"), in, scr)) flags |= 2;
+    flags = prefilter_flags(pl, in, scr);
   }
   out[2] = flags;
 
@@ -750,26 +1176,18 @@ static int featurize_ascii_core(Pipeline *pl, Vocab *vocab, const char *data,
     PassTimer t("stage1");
     c = pl->stage1(std::move(in), scr);
   }
-  sc::downcase_ascii(c.data(), c.size());  // pure ASCII by precondition
   {
+    // the ASCII downcase is fused into stage2's single-pass head
     PassTimer t("stage2");
-    c = pl->stage2(std::move(c), scr);
+    c = pl->stage2(std::move(c), scr, /*downcase=*/true);
   }
   if (scr.err) return 3;  // resource failure: caller falls back to Python
+  st.normalize_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
+  st.blobs.fetch_add(1, std::memory_order_relaxed);
+  st.bytes_in.fetch_add(len, std::memory_order_relaxed);
 
-  PassTimer t_ws("wordset_vocab");
-  std::vector<uint64_t> hashes;
-  std::vector<sc::Slice> uniq = sc::wordset_unique(c.data(), c.size(), &hashes);
-  std::memset(bits_out, 0, vocab->n_lanes * sizeof(uint32_t));
-  for (size_t k = 0; k < uniq.size(); ++k) {
-    uint32_t id = vocab->find_hashed(c.data() + uniq[k].off, uniq[k].len,
-                                     hashes[k]);
-    if (id != UINT32_MAX && (id >> 5) < vocab->n_lanes)
-      bits_out[id >> 5] |= (1u << (id & 31));
-  }
-  out[0] = static_cast<int32_t>(uniq.size());
+  featurize_tail(vocab, c, bits_out, out, hash_out);
   out[1] = static_cast<int32_t>(c.size());  // pure ASCII: bytes == chars
-  wordset_hash(hashes, hash_out);
   return 0;
 }
 
@@ -803,9 +1221,14 @@ int pipe_featurize_raw(void *handle, void *vocab_handle, const char *data,
 // status_out[i]: 0 ok, 2 non-ASCII (caller redoes that blob via the
 // Unicode-safe Python path), 3 PCRE2 resource failure (ditto).
 // Outputs are row-strided: bits n x n_lanes, meta n x 3, hash n x 16.
+// `bits_rows` (nullable) maps blob i to its row in a LARGER caller-owned
+// bits matrix: the token bits land zero-copy in the final batch row even
+// when the native subset is sparse (preset/dedupe rows interleaved) —
+// no per-blob staging matrix, no copy-out.
 void pipe_featurize_batch(void *handle, void *vocab_handle,
                           const char *const *datas, const int64_t *lens,
-                          int32_t n, uint32_t *bits_out, int32_t *meta_out,
+                          int32_t n, const int64_t *bits_rows,
+                          uint32_t *bits_out, int32_t *meta_out,
                           uint8_t *hash_out, int8_t *status_out) {
   auto *pl = static_cast<Pipeline *>(handle);
   auto *vocab = static_cast<Vocab *>(vocab_handle);
@@ -817,6 +1240,7 @@ void pipe_featurize_batch(void *handle, void *vocab_handle,
     size_t l = static_cast<size_t>(lens[i]);
     if (!all_ascii(b, l)) {
       status_out[i] = 2;
+      stage_stats().nonascii.fetch_add(1, std::memory_order_relaxed);
       continue;
     }
     if (std::memchr(b, '\r', l) != nullptr) {
@@ -840,8 +1264,10 @@ void pipe_featurize_batch(void *handle, void *vocab_handle,
     }
     while (l && sc::is_strippable(static_cast<unsigned char>(b[l - 1]))) --l;
     scr.err = 0;
+    size_t row = bits_rows ? static_cast<size_t>(bits_rows[i])
+                           : static_cast<size_t>(i);
     status_out[i] = static_cast<int8_t>(featurize_ascii_core(
-        pl, vocab, b, l, scr, bits_out + static_cast<size_t>(i) * W,
+        pl, vocab, b, l, scr, bits_out + row * W,
         meta_out + static_cast<size_t>(i) * 3,
         hash_out + static_cast<size_t>(i) * 16));
   }
@@ -998,11 +1424,34 @@ int pipe_refscan_min(void *h, const char *data, size_t len) {
   return best;
 }
 
-// Dump the accumulated pass-profiler rows as "name=seconds\n" lines
-// (malloc'd; caller pipe_free's).  Empty unless LICENSEE_TPU_PIPE_PROFILE
-// was set before the first pass ran.
+// Dump per-stage attribution as "name=value\n" lines (malloc'd; caller
+// pipe_free's).  The stage.*_s seconds (normalize / wordset = fused
+// tokenize+vocab / pack) and count.* rows are ALWAYS on — a handful of
+// relaxed atomics per blob; the per-pass s1.*/s2.* rows (and the
+// stage.tokenize_only split) additionally require
+// LICENSEE_TPU_PIPE_PROFILE=1 at process start.
 char *pipe_profile_dump(size_t *out_len) {
   std::string s;
+  const StageStats &st = stage_stats();
+  auto put = [&s](const char *name, double v) {
+    char num[64];
+    std::snprintf(num, sizeof num, "%.9g", v);
+    for (char *d = num; *d; ++d)
+      if (*d == ',') *d = '.';
+    s += name;
+    s += "=";
+    s += num;
+    s += "\n";
+  };
+  put("stage.normalize_s", st.normalize_ns.load() * 1e-9);
+  put("stage.wordset_s", st.wordset_ns.load() * 1e-9);
+  put("stage.pack_s", st.pack_ns.load() * 1e-9);
+  put("count.blobs", static_cast<double>(st.blobs.load()));
+  put("count.bytes_in", static_cast<double>(st.bytes_in.load()));
+  put("count.tokens", static_cast<double>(st.tokens.load()));
+  put("count.unique", static_cast<double>(st.uniques.load()));
+  put("count.oov", static_cast<double>(st.oov.load()));
+  put("count.nonascii_fallback", static_cast<double>(st.nonascii.load()));
   for (const auto &kv : PassProf::table()) {
     // %.9g via snprintf_l-free path: std::to_string honors LC_NUMERIC
     // (a comma decimal point would break the Python float() parse)

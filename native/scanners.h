@@ -185,6 +185,69 @@ inline const char *find_byte4(const char *p, const char *end, char a, char b,
   return p;
 }
 
+// first byte equal to any of {a, b, c}
+inline const char *find_byte3(const char *p, const char *end, char a, char b,
+                              char c) {
+  return find_byte4(p, end, a, b, c, c);
+}
+
+// does the text contain a run of >= 3 consecutive bytes from {a, b, c}?
+// (the literal gate for the hrs pass: ^\s*[=\-*]{3,}\s*$ cannot match
+// without one)
+inline bool has_run3_of(const char *data, size_t len, char a, char b,
+                        char c) {
+  const char *p = data;
+  const char *end = data + len;
+  while (p < end) {
+    p = find_byte3(p, end, a, b, c);
+    if (p >= end) return false;
+    const char *q = p;
+    while (q < end && (*q == a || *q == b || *q == c)) ++q;
+    if (q - p >= 3) return true;
+    p = q;
+  }
+  return false;
+}
+
+inline char lower_ascii(char c) {
+  return (c >= 'A' && c <= 'Z') ? static_cast<char>(c + ('a' - 'A')) : c;
+}
+
+// ASCII-caseless substring scan (needle must be pre-lowercased, and its
+// first byte must be a letter or caseless-neutral).  memchr on both cases
+// of the first byte keeps the common no-hit case vectorized.
+inline bool contains_ci(const char *hay, size_t len, const char *needle_lc,
+                        size_t nlen) {
+  if (nlen == 0 || len < nlen) return false;
+  char lo = needle_lc[0];
+  char up = (lo >= 'a' && lo <= 'z') ? static_cast<char>(lo - 32) : lo;
+  const char *p = hay;
+  const char *last = hay + len - nlen;
+  while (p <= last) {
+    const char *a = static_cast<const char *>(
+        std::memchr(p, lo, last - p + 1));
+    const char *b = (up == lo) ? nullptr
+                               : static_cast<const char *>(
+                                     std::memchr(p, up, last - p + 1));
+    const char *hit = a && b ? (a < b ? a : b) : (a ? a : b);
+    if (!hit) return false;
+    size_t k = 1;
+    while (k < nlen && lower_ascii(hit[k]) == needle_lc[k]) ++k;
+    if (k == nlen) return true;
+    p = hit + 1;
+  }
+  return false;
+}
+
+// ASCII-caseless prefix compare (needle pre-lowercased)
+inline bool starts_ci(const char *p, const char *end, const char *needle_lc,
+                      size_t nlen) {
+  if (static_cast<size_t>(end - p) < nlen) return false;
+  for (size_t k = 0; k < nlen; ++k)
+    if (lower_ascii(p[k]) != needle_lc[k]) return false;
+  return true;
+}
+
 // length of the dash token at p (end exclusive), 0 if none.
 // tokens: '-' (1 byte), U+2013 "\xe2\x80\x93", U+2014 "\xe2\x80\x94"
 inline size_t dash_token(const char *p, const char *end) {
@@ -222,27 +285,64 @@ inline bool is_squeezed_clean(const char *data, size_t len) {
 
 // Ruby `squeeze(' ').strip`: collapse runs of the SPACE character only,
 // then strip [ \t\n\v\f\r\0] from both ends (String#strip includes NUL).
-// (strip commutes with the interior squeeze, so ends are trimmed first
-// and the interior is copied span-wise between double-space sites.)
+// (strip commutes with the interior squeeze, so ends are trimmed first;
+// the interior uses the strip_whitespace block plan — store all 16
+// bytes, fall back to a scalar rewrite only when the block has a
+// second-of-a-space-run byte to drop.)
 inline std::string squeeze_strip(const char *data, size_t len) {
   size_t a = 0, b = len;
   while (a < b && is_strippable(data[a])) ++a;
   while (b > a && is_strippable(data[b - 1])) --b;
   std::string out;
-  out.reserve(b - a);
-  size_t i = a;
-  while (i < b) {
-    const char *dbl =
-        static_cast<const char *>(memmem(data + i, b - i, "  ", 2));
-    if (!dbl) {
-      out.append(data + i, b - i);
-      break;
+  out.resize(b - a);
+  char *base = &out[0];
+  char *dst = base;
+  const char *p = data + a;
+  const char *end = data + b;
+#if defined(__SSE2__)
+  const __m128i sp = _mm_set1_epi8(' ');
+  unsigned carry = 0;  // 1 if the previous byte was ' '
+  while (end - p >= 16) {
+    __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+    unsigned mask =
+        static_cast<unsigned>(_mm_movemask_epi8(_mm_cmpeq_epi8(v, sp)));
+    unsigned run = mask & ((mask << 1) | carry);
+    _mm_storeu_si128(reinterpret_cast<__m128i *>(dst), v);
+    if (run == 0) {
+      dst += 16;
+    } else {
+      char *w = dst;
+      for (int k = 0; k < 16; ++k) {
+        if (run & (1u << k)) continue;
+        *w++ = p[k];
+      }
+      dst = w;
     }
-    size_t pos = static_cast<size_t>(dbl - data);
-    out.append(data + i, pos - i + 1);  // keep one space of the run
-    i = pos;
-    while (i < b && data[i] == ' ') ++i;
+    carry = (mask >> 15) & 1u;
+    p += 16;
   }
+  while (p < end) {
+    char ch = *p++;
+    if (ch == ' ') {
+      if (carry) continue;
+      carry = 1;
+    } else {
+      carry = 0;
+    }
+    *dst++ = ch;
+  }
+#else
+  while (p < end) {
+    char ch = *p++;
+    if (ch == ' ') {
+      *dst++ = ' ';
+      while (p < end && *p == ' ') ++p;
+    } else {
+      *dst++ = ch;
+    }
+  }
+#endif
+  out.resize(dst - base);
   return out;
 }
 
@@ -471,29 +571,96 @@ inline std::string hyphenated(const char *data, size_t len) {
   return out;
 }
 
+// Token hash used by the wordset uniqueness table, the vocab map and the
+// Exact-matcher multiset hash.  8-byte chunks instead of byte-serial FNV:
+// the multiply chain is per-chunk, so short tokens cost ~2 multiplies.
+// Internal to the native layer — Python only ever sees hashes computed
+// here (pipe_exact_hash / pipe_featurize), so the function just has to be
+// deterministic and consistent across the .so.
+// NOTE the tail avoids the variable-length memcpy of the round-1
+// version: a real memcpy CALL per sub-8-byte token (i.e. per average
+// token) measured ~10 ns on the deployment hosts — the fixed-size
+// overlapping loads below compile to two plain load instructions.  The
+// (value, n) encoding stays injective per length, and the length is
+// mixed into the seed, so distinct tokens still hash distinctly by
+// construction of the inputs.
+inline uint64_t token_hash(const char *p, size_t n) {
+  uint64_t h = 0x9e3779b97f4a7c15ull ^ (n * 0xff51afd7ed558ccdull);
+  size_t left = n;
+  while (left >= 8) {
+    uint64_t k;
+    std::memcpy(&k, p, 8);  // constant size: a single load, not a call
+    h = (h ^ k) * 0x9ddfea08eb382d69ull;
+    h ^= h >> 29;
+    p += 8;
+    left -= 8;
+  }
+  if (left) {
+    uint64_t k;
+    if (left >= 4) {
+      uint32_t a, b;
+      std::memcpy(&a, p, 4);
+      std::memcpy(&b, p + left - 4, 4);  // overlapping fixed loads
+      k = a | (static_cast<uint64_t>(b) << 32);
+    } else {
+      k = static_cast<unsigned char>(p[0]) |
+          (static_cast<uint64_t>(static_cast<unsigned char>(p[left >> 1]))
+           << 8) |
+          (static_cast<uint64_t>(static_cast<unsigned char>(p[left - 1]))
+           << 16);
+    }
+    h = (h ^ k) * 0x9ddfea08eb382d69ull;
+    h ^= h >> 29;
+  }
+  return h;
+}
+
 // gsub(/\b(?:variant1|variant2|...)\b/) { VARIETAL_WORDS[match] } — the
 // SPDX spelling folds.  Alternation order is the insertion order of the
-// table (first alternative whose end lands on a word boundary wins).
-// The table arrives from Python as flat "from\0to\0from\0to\0..." so the
-// single source of truth stays in pipeline.py.
+// table (among alternatives matching at the same start, the first in
+// table order wins).  The table arrives from Python as flat
+// "from\0to\0from\0to\0..." so the single source of truth stays in
+// pipeline.py.
+//
+// The regex is equivalent to a WORD-RUN test: a match can only start at
+// a word-run start (\b before), and a variant with no interior non-word
+// char matches iff it EQUALS the whole run (the trailing \b rejects both
+// longer and — via the maximal run — shorter overlaps).  So the scanner
+// walks word runs and resolves each with ONE exact-hash probe into a
+// table of single-word variants; the few variants with an interior
+// separator ("sub-license", "per cent", ...) are grouped by their first
+// word and only checked when the run equals that first word exactly.
+// This replaces the round-3 pair-bitmap/bloom design, whose gates passed
+// on the commonest word starts of license prose (li-, co-, re-) and made
+// the pass the costliest scanner in the pipeline.
 struct Spelling {
   std::vector<std::string> from, to;
-  // two-byte dispatch: an 8 KiB bitmap (L1-resident) gates a compact
-  // sorted (pair-key, variant-index) array (a few hundred bytes, also
-  // L1-resident — a 64K-bucket table would miss cache at 40% of word
-  // starts, since variant prefixes like "co"/"an"/"wi" are shared by the
-  // commonest English words).  Every variant is ≥2 bytes, so one-char
-  // words can never match; within a pair the array preserves table order
-  // (= alternation order).
-  std::vector<std::pair<uint16_t, uint16_t>> pair_cands;  // sorted by key
+  // cheap gates, both L1-resident, rejecting virtually every word start
+  // in a handful of ops: an 8 KiB bitmap over the first TWO bytes, then
+  // a 2048-bit bloom over the first THREE (the pair keys li/co/re/...
+  // are the commonest word starts of license prose)
   uint64_t pair_bits[1024] = {};
-  // second gate: 2048-bit bloom over the first THREE bytes.  The variant
-  // prefixes' two-byte keys (in/re/co/pr/of/...) are the commonest word
-  // starts in English, so the pair gate alone passes ~40% of words; the
-  // third byte drops survivors to the few real candidates (+ ~2% bloom
-  // collisions at 45 entries / 2048 bits).
   uint64_t tri_bits[32] = {};
   bool tri_enabled = true;  // off if any variant is ever < 3 bytes
+  // gate survivors resolve by EXACT WORD-RUN equality: a variant with no
+  // interior non-word char matches iff it equals the whole run (\b on
+  // both sides), so one hash probe replaces the round-3 sorted-candidate
+  // walk; variants with an interior separator ("sub-license", "per
+  // cent", ...) group by first word and memcmp forward
+  struct SEntry {
+    uint64_t hash = 0;
+    uint32_t idx = 0;
+    bool used = false;
+  };
+  std::vector<SEntry> singles;  // open-addressed, pow2
+  size_t smask = 0;
+  struct MGroup {
+    std::string first;           // the leading word-char prefix
+    std::vector<uint32_t> idxs;  // table order = alternation order
+  };
+  std::vector<MGroup> multis;
+  uint64_t single_lens = 0;  // bit l set: some single variant has len l
+  uint64_t first_lens = 0;   // bit l set: some multi first-word has len l
 
   static uint32_t tri_hash(unsigned char a, unsigned char b,
                            unsigned char c) {
@@ -512,63 +679,126 @@ struct Spelling {
       from.emplace_back(f, fl);
       to.emplace_back(t, tl);
     }
+    size_t cap = 16;
+    while (cap < from.size() * 4) cap <<= 1;
+    singles.assign(cap, SEntry{});
+    smask = cap - 1;
     for (uint32_t k = 0; k < from.size(); ++k) {
+      const std::string &f = from[k];
       uint16_t key = static_cast<uint16_t>(
-          (static_cast<unsigned char>(from[k][0]) << 8) |
-          static_cast<unsigned char>(from[k][1]));
-      pair_cands.emplace_back(key, static_cast<uint16_t>(k));
+          (static_cast<unsigned char>(f[0]) << 8) |
+          static_cast<unsigned char>(f[1]));
       pair_bits[key >> 6] |= 1ull << (key & 63);
-      if (from[k].size() < 3) {
+      if (f.size() < 3) {
         tri_enabled = false;
       } else {
-        uint32_t t = tri_hash(static_cast<unsigned char>(from[k][0]),
-                              static_cast<unsigned char>(from[k][1]),
-                              static_cast<unsigned char>(from[k][2]));
+        uint32_t t = tri_hash(static_cast<unsigned char>(f[0]),
+                              static_cast<unsigned char>(f[1]),
+                              static_cast<unsigned char>(f[2]));
         tri_bits[t >> 6] |= 1ull << (t & 63);
       }
+      size_t w = 0;
+      while (w < f.size() && kBT.word[static_cast<unsigned char>(f[w])]) ++w;
+      if (w == f.size()) {
+        uint64_t h = token_hash(f.data(), f.size());
+        size_t slot = h & smask;
+        bool dup = false;
+        while (singles[slot].used) {
+          const SEntry &e = singles[slot];
+          if (e.hash == h && from[e.idx] == f) {
+            dup = true;  // duplicate variant: first insertion wins
+            break;
+          }
+          slot = (slot + 1) & smask;
+        }
+        if (!dup) singles[slot] = SEntry{h, k, true};
+        single_lens |= 1ull << (f.size() < 64 ? f.size() : 63);
+      } else {
+        MGroup *g = nullptr;
+        for (MGroup &m : multis)
+          if (m.first.size() == w &&
+              std::memcmp(m.first.data(), f.data(), w) == 0) {
+            g = &m;
+            break;
+          }
+        if (!g) {
+          multis.push_back(MGroup{f.substr(0, w), {}});
+          g = &multis.back();
+        }
+        g->idxs.push_back(k);
+        first_lens |= 1ull << (w < 64 ? w : 63);
+      }
     }
-    std::stable_sort(pair_cands.begin(), pair_cands.end(),
-                     [](const auto &a, const auto &b) {
-                       return a.first < b.first;
-                     });
   }
 
-  // try to match a variant whose word starts at `w`; on success append
-  // the replacement and return the index just past the matched variant
-  // (a word boundary by the \b-after check), else return SIZE_MAX.
-  size_t try_match(const char *data, size_t len, size_t w, size_t &emitted,
-                   std::string &out) const {
-    if (w + 1 >= len) return SIZE_MAX;
+  // the pair-bitmap + tri-bloom gates, inlined at every word start —
+  // they reject virtually all of them, so the try_match CALL (a big
+  // out-of-line function) only happens for real candidates
+  inline bool gates_pass(const char *data, size_t len, size_t w) const {
+    if (w + 1 >= len) return false;
     uint16_t key = static_cast<uint16_t>(
         (static_cast<unsigned char>(data[w]) << 8) |
         static_cast<unsigned char>(data[w + 1]));
-    if (!(pair_bits[key >> 6] & (1ull << (key & 63)))) return SIZE_MAX;
+    if (!(pair_bits[key >> 6] & (1ull << (key & 63)))) return false;
     if (tri_enabled && w + 2 < len) {  // every variant is >= 3 bytes
       uint32_t t = tri_hash(static_cast<unsigned char>(data[w]),
                             static_cast<unsigned char>(data[w + 1]),
                             static_cast<unsigned char>(data[w + 2]));
-      if (!(tri_bits[t >> 6] & (1ull << (t & 63)))) return SIZE_MAX;
+      if (!(tri_bits[t >> 6] & (1ull << (t & 63)))) return false;
     }
-    auto it = std::lower_bound(
-        pair_cands.begin(), pair_cands.end(), key,
-        [](const auto &a, uint16_t k) { return a.first < k; });
-    for (; it != pair_cands.end() && it->first == key; ++it) {
-      uint32_t k = it->second;
-      const std::string &f = from[k];
-      if (w + f.size() <= len &&
-          std::memcmp(data + w, f.data(), f.size()) == 0) {
-        // \b after: end of input or non-word char next (every variant
-        // ends with a word char)
-        if (w + f.size() == len || !is_word(data[w + f.size()])) {
-          if (out.empty() && emitted == 0) out.reserve(len + 16);
-          out.append(data + emitted, w - emitted);
-          out.append(to[k]);
-          emitted = w + f.size();
-          return emitted;
+    return true;
+  }
+
+  // try to match a variant whose word starts at `w` (gates already
+  // passed); on success append the replacement and return the index
+  // just past the matched variant (a word boundary by the \b-after
+  // check), else return SIZE_MAX.
+  size_t try_match(const char *data, size_t len, size_t w, size_t &emitted,
+                   std::string &out) const {
+    size_t e = static_cast<size_t>(find_nonword(data + w, data + len) - data);
+    size_t n = e - w;
+    uint64_t lbit = 1ull << (n < 64 ? n : 63);
+    uint32_t best = UINT32_MAX;
+    size_t best_end = 0;
+    if (single_lens & lbit) {
+      uint64_t h = token_hash(data + w, n);
+      size_t slot = h & smask;
+      while (singles[slot].used) {
+        const SEntry &s = singles[slot];
+        if (s.hash == h && from[s.idx].size() == n &&
+            std::memcmp(from[s.idx].data(), data + w, n) == 0) {
+          best = s.idx;
+          best_end = e;
+          break;
         }
+        slot = (slot + 1) & smask;
       }
     }
-    return SIZE_MAX;
+    if (first_lens & lbit) {
+      for (const MGroup &g : multis) {
+        if (g.first.size() != n ||
+            std::memcmp(g.first.data(), data + w, n) != 0)
+          continue;
+        for (uint32_t k : g.idxs) {
+          if (k >= best) break;  // a lower idx (earlier alternative) won
+          const std::string &f = from[k];
+          if (w + f.size() <= len &&
+              std::memcmp(f.data(), data + w, f.size()) == 0 &&
+              (w + f.size() == len || !is_word(data[w + f.size()]))) {
+            best = k;
+            best_end = w + f.size();
+            break;
+          }
+        }
+        break;  // at most one group shares this first word
+      }
+    }
+    if (best == UINT32_MAX) return SIZE_MAX;
+    if (out.empty() && emitted == 0) out.reserve(len + 16);
+    out.append(data + emitted, w - emitted);
+    out.append(to[best]);
+    emitted = best_end;
+    return best_end;
   }
 
   std::string run(const char *data, size_t len) const {
@@ -591,6 +821,7 @@ struct Spelling {
       while (starts) {
         int k = __builtin_ctz(starts);
         starts &= starts - 1;
+        if (!gates_pass(data, len, i + k)) continue;
         size_t next = try_match(data, len, i + k, emitted, out);
         if (next != SIZE_MAX) {
           // the match may span separators ("sub license"): later start
@@ -611,7 +842,9 @@ struct Spelling {
     while (i < len) {
       i = find_wordbyte(data + i, data + len) - data;
       if (i >= len) break;
-      size_t next = try_match(data, len, i, emitted, out);
+      size_t next = gates_pass(data, len, i)
+                        ? try_match(data, len, i, emitted, out)
+                        : SIZE_MAX;
       i = (next != SIZE_MAX)
               ? next
               : static_cast<size_t>(find_nonword(data + i, data + len) -
@@ -623,29 +856,510 @@ struct Spelling {
   }
 };
 
-// Token hash used by the wordset uniqueness table, the vocab map and the
-// Exact-matcher multiset hash.  8-byte chunks instead of byte-serial FNV:
-// the multiply chain is per-chunk, so short tokens cost ~2 multiplies.
-// Internal to the native layer — Python only ever sees hashes computed
-// here (pipe_exact_hash / pipe_featurize), so the function just has to be
-// deterministic and consistent across the .so.
-inline uint64_t token_hash(const char *p, size_t n) {
-  uint64_t h = 0x9e3779b97f4a7c15ull ^ (n * 0xff51afd7ed558ccdull);
-  while (n >= 8) {
-    uint64_t k;
-    std::memcpy(&k, p, 8);
-    h = (h ^ k) * 0x9ddfea08eb382d69ull;
-    h ^= h >> 29;
-    p += 8;
-    n -= 8;
+// ---------------------------------------------------------------------------
+// fold_scan: the fused single-pass head of content_normalized.  One
+// left-to-right byte scan applies, in pipeline order and with byte-exact
+// pass semantics (differential tests: tests/test_native_pipeline.py,
+// tests/test_featurize_parity.py):
+//
+//   downcase  str.lower (ASCII; only enabled on the all-ASCII fast path)
+//   lists     ^\s*(?:\d\.|[*-])(?: [*_]{0,2}\(?[\da-z]\)[*_]{0,2})?\s+([^\n])
+//             -> "- $1"
+//   http:     gsub(/http:/, 'https:')
+//   &         gsub(/&/, 'and')
+//   dashes    gsub(/(?<=[^\n])([—–-]+)(?=[^\n])/, '-')
+//   quotes    gsub(/[`'"‘“’”]/, "'")
+//
+// Single-pass fusion is sound because the later transforms' trigger and
+// context bytes are invariant under the earlier ones: the literal
+// replacements introduce no list markers, dashes, quotes or newlines;
+// the dash rule's lookaround only asks [^\n], which every replacement
+// byte satisfies; and a lists match can neither contain nor destroy a
+// dash run or quote (its \s*/\s+ spans are space-class only; the one
+// captured [^\n] char is re-dispatched through the remaining transforms
+// below, exactly like the "- $1" replacement text feeding the next
+// sequential pass).  The dash lookbehind reads the OUTPUT tail (the
+// sequential pass would see the post-lists text) and the lookahead reads
+// the raw input (newline-ness is transform-invariant).
+
+// One attempt of the lists pattern with ^ matching at line start `ls`.
+// `dc` folds A-Z for the [\da-z] class test (the sequential pipeline
+// downcases before the lists pass).  On success *cap_out is the input
+// index of the captured [^\n] char; *fns_out is always set to the first
+// non-space position at/after ls — every line start sharing it fails or
+// matches identically, which the caller memoizes.
+inline bool lists_try(const char *d, size_t len, size_t ls, bool dc,
+                      size_t *cap_out, size_t *fns_out) {
+  size_t i = ls;
+  while (i < len && kBT.space[static_cast<unsigned char>(d[i])]) ++i;
+  *fns_out = i;
+  if (i >= len) return false;
+  // \s+([^\n]) from j: the greedy \s+ backs off until the capture is a
+  // non-newline byte — candidates are the byte after the space run, then
+  // the run's own bytes from the end down to the second
+  auto tail = [&](size_t j, size_t *cap) -> bool {
+    size_t s = j;
+    while (s < len && kBT.space[static_cast<unsigned char>(d[s])]) ++s;
+    if (s == j) return false;
+    if (s < len && d[s] != '\n') {
+      *cap = s;
+      return true;
+    }
+    for (size_t k = s; k-- > j + 1;) {
+      if (d[k] != '\n') {
+        *cap = k;
+        return true;
+      }
+    }
+    return false;
+  };
+  // marker: \d\. | [*-]
+  size_t m = i;
+  char c0 = d[m];
+  if (c0 >= '0' && c0 <= '9') {
+    if (m + 1 >= len || d[m + 1] != '.') return false;
+    m += 2;
+  } else if (c0 == '*' || c0 == '-') {
+    m += 1;
+  } else {
+    return false;
   }
-  if (n) {
-    uint64_t k = 0;
-    std::memcpy(&k, p, n);
-    h = (h ^ k) * 0x9ddfea08eb382d69ull;
-    h ^= h >> 29;
+  // optional group (greedy ?): ' ' [*_]{0,2} \(? [\da-z] \) [*_]{0,2},
+  // with the quantifiers' full backtracking order
+  if (m < len && d[m] == ' ') {
+    size_t g = m + 1;
+    size_t t1max = 0;
+    while (t1max < 2 && g + t1max < len &&
+           (d[g + t1max] == '*' || d[g + t1max] == '_'))
+      ++t1max;
+    for (size_t t1 = t1max + 1; t1-- > 0;) {
+      size_t h = g + t1;
+      for (int paren = (h < len && d[h] == '(') ? 1 : 0; paren >= 0;
+           --paren) {
+        size_t x = h + paren;
+        if (x >= len) continue;
+        char cc = dc ? lower_ascii(d[x]) : d[x];
+        if (!((cc >= '0' && cc <= '9') || (cc >= 'a' && cc <= 'z')))
+          continue;
+        if (x + 1 >= len || d[x + 1] != ')') continue;
+        size_t y = x + 2;
+        size_t t2max = 0;
+        while (t2max < 2 && y + t2max < len &&
+               (d[y + t2max] == '*' || d[y + t2max] == '_'))
+          ++t2max;
+        for (size_t t2 = t2max + 1; t2-- > 0;) {
+          if (tail(y + t2, cap_out)) return true;
+        }
+      }
+    }
   }
-  return h;
+  return tail(m, cap_out);
+}
+
+#if defined(__SSE2__)
+// 16-lane mask of fold_scan candidate bytes.  NOT candidates: "'" (the
+// quote fold maps it to itself — identity), and A-Z (the downcase is
+// deferred to one vectorized in-place pass over the output; no fold
+// decision other than the http: compare — which lowers on the fly —
+// depends on case).
+inline unsigned fold_cand_mask16(const char *p, bool dc) {
+  const __m128i v = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+  __m128i m = _mm_cmpeq_epi8(v, _mm_set1_epi8('\n'));
+  m = _mm_or_si128(m, _mm_cmpeq_epi8(v, _mm_set1_epi8('h')));
+  m = _mm_or_si128(m, _mm_cmpeq_epi8(v, _mm_set1_epi8('&')));
+  m = _mm_or_si128(m, _mm_cmpeq_epi8(v, _mm_set1_epi8('-')));
+  m = _mm_or_si128(
+      m, _mm_cmpeq_epi8(v, _mm_set1_epi8(static_cast<char>(0xe2))));
+  m = _mm_or_si128(m, _mm_cmpeq_epi8(v, _mm_set1_epi8('`')));
+  m = _mm_or_si128(m, _mm_cmpeq_epi8(v, _mm_set1_epi8('"')));
+  if (dc) m = _mm_or_si128(m, _mm_cmpeq_epi8(v, _mm_set1_epi8('H')));
+  return static_cast<unsigned>(_mm_movemask_epi8(m));
+}
+#endif
+
+inline std::string fold_scan(const char *d, size_t len, bool dc,
+                             bool *lists_fired) {
+  std::string out;
+  out.reserve(len + (len >> 4) + 16);
+  *lists_fired = false;
+  size_t i = 0;
+  // memo: first-non-space position of a FAILED lists attempt — every
+  // line start inside the same leading-whitespace run shares the failure
+  size_t fail_fns = SIZE_MAX;
+  // the capture position of a lists match resolved inside next_cand (the
+  // candidate byte is then the '\n' PRECEDING the match's line start)
+  size_t pending_cap = 0;
+  auto is_fold_cand = [&](unsigned char c) {
+    return c == '\n' || c == 'h' || c == '&' || c == '-' || c == 0xe2 ||
+           c == '`' || c == '"' || (dc && c == 'H');
+  };
+  // is this position a REAL http: site?  'h'/'H' bytes that aren't are
+  // filtered inside the scan so they never interrupt the bulk copy
+  auto is_http = [&](size_t p) {
+    return p + 5 <= len && (dc ? starts_ci(d + p, d + len, "http:", 5)
+                               : std::memcmp(d + p, "http:", 5) == 0);
+  };
+  // a '\n' is a SOFT candidate: it only interrupts the bulk copy when
+  // the lists pattern actually fires at the line start it opens — prose
+  // lines (the overwhelming majority) stay on the span-copy path
+  auto lists_at = [&](size_t ls) -> bool {
+    if (fail_fns != SIZE_MAX && ls < fail_fns) return false;
+    size_t cap, fns;
+    if (lists_try(d, len, ls, dc, &cap, &fns)) {
+      pending_cap = cap;
+      return true;
+    }
+    fail_fns = fns;
+    return false;
+  };
+#if defined(__SSE2__)
+  const size_t nblocks = len >> 4;
+  size_t cur_block = ~static_cast<size_t>(0);
+  unsigned cur_mask = 0;
+  auto next_cand = [&](size_t from) -> size_t {
+    for (;;) {
+      while ((from >> 4) < nblocks) {
+        size_t b = from >> 4;
+        if (b != cur_block) {
+          cur_block = b;
+          cur_mask = fold_cand_mask16(d + (b << 4), dc);
+        }
+        unsigned m = cur_mask >> (from & 15);
+        if (m) {
+          from += __builtin_ctz(m);
+          break;
+        }
+        from = (b + 1) << 4;
+      }
+      while (from < len &&
+             !is_fold_cand(static_cast<unsigned char>(d[from])))
+        ++from;
+      if (from >= len) return from;
+      unsigned char c = static_cast<unsigned char>(d[from]);
+      if ((c == 'h' || c == 'H') && !is_http(from)) {
+        ++from;  // plain letter: stay on the bulk path
+        continue;
+      }
+      if (c == '\n' && !lists_at(from + 1)) {
+        ++from;  // prose line: stay on the bulk path
+        continue;
+      }
+      return from;
+    }
+  };
+#else
+  auto next_cand = [&](size_t from) -> size_t {
+    for (;;) {
+      while (from < len &&
+             !is_fold_cand(static_cast<unsigned char>(d[from])))
+        ++from;
+      if (from >= len) return from;
+      unsigned char c = static_cast<unsigned char>(d[from]);
+      if ((c == 'h' || c == 'H') && !is_http(from)) {
+        ++from;
+        continue;
+      }
+      if (c == '\n' && !lists_at(from + 1)) {
+        ++from;
+        continue;
+      }
+      return from;
+    }
+  };
+#endif
+  // position 0 is a line start too (\A counts as ^)
+  if (len && lists_at(0)) {
+    *lists_fired = true;
+    out += "- ";
+    i = pending_cap;
+  }
+  while (i < len) {
+    // bulk-copy the run of uninteresting bytes
+    {
+      size_t j = next_cand(i);
+      if (j > i) {
+        out.append(d + i, j - i);
+        i = j;
+        if (i >= len) break;
+      }
+    }
+    unsigned char c = static_cast<unsigned char>(d[i]);
+    if (c == '\n') {
+      // next_cand only stops on a '\n' whose line fires the lists
+      // pattern (pending_cap set): the '\n' itself is kept, the match
+      // (line start .. capture) becomes "- " + the captured char, which
+      // re-enters the dispatch (lists resumes after its capture)
+      out.push_back('\n');
+      *lists_fired = true;
+      out += "- ";
+      i = pending_cap;
+      continue;
+    }
+    if (c == 'h' || c == 'H') {
+      // next_cand only stops on verified http: sites
+      out += "https:";
+      i += 5;
+      continue;
+    }
+    if (c == '&') {
+      out += "and";
+      ++i;
+      continue;
+    }
+    if (size_t t = dash_token(d + i, d + len)) {
+      // collect the maximal run; the lookbehind examines the output tail
+      // (post-lists text), the lookahead the raw input byte after the
+      // run — see the fusion-soundness note above
+      bool prev_nl = out.empty() || out.back() == '\n';
+      size_t q = i, ntok = 0, first_len = t, last_off = i, last_len = t;
+      while (size_t tt = dash_token(d + q, d + len)) {
+        last_off = q;
+        last_len = tt;
+        ++ntok;
+        q += tt;
+      }
+      bool followed = (q < len) && (d[q] != '\n');
+      size_t start_tok = prev_nl ? 1 : 0;
+      if (start_tok >= ntok) {
+        out.append(d + i, q - i);
+      } else if (followed) {
+        if (start_tok) out.append(d + i, first_len);
+        out.push_back('-');
+      } else if (ntok - start_tok >= 2) {
+        if (start_tok) out.append(d + i, first_len);
+        out.push_back('-');
+        out.append(d + last_off, last_len);
+      } else {
+        out.append(d + i, q - i);
+      }
+      i = q;
+      continue;
+    }
+    if (size_t t = quote_token(d + i, d + len)) {
+      out.push_back('\'');
+      i += t;
+      continue;
+    }
+    out.push_back(static_cast<char>(c));  // bare 0xe2 or stray `/" miss
+    ++i;
+  }
+  // deferred downcase: one vectorized in-place pass (see the candidate
+  // mask note — every fold decision above is case-blind or lowers on
+  // the fly, so folding case last is byte-identical to lowering first)
+  if (dc) downcase_ascii(out.data(), out.size());
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Hand-coded line-local passes (formerly PCRE2 substitutions).  Each
+// returns the input untouched (single copy, no scan rework) when nothing
+// matches and sets *changed accordingly.
+
+// gsub(/[_*~]+(.*?)[_*~]+/, '\1').  The lazy middle can't cross a
+// newline, so per opener run: the match closes at the next same-line
+// marker run, or — when the opener run is >= 2 chars — backtracks one
+// char and closes inside itself ($1 empty).
+inline std::string span_markup_scan(const char *d, size_t len,
+                                    bool *changed) {
+  *changed = false;
+  std::string out;
+  size_t i = 0, emitted = 0;
+  while (i < len) {
+    size_t a = find_byte3(d + i, d + len, '_', '*', '~') - d;
+    if (a >= len) break;
+    size_t j = a;
+    while (j < len && (d[j] == '_' || d[j] == '*' || d[j] == '~')) ++j;
+    size_t q = find_byte4(d + j, d + len, '_', '*', '~', '\n') - d;
+    if (q < len && d[q] != '\n') {
+      size_t s = q;
+      while (s < len && (d[s] == '_' || d[s] == '*' || d[s] == '~')) ++s;
+      out.append(d + emitted, a - emitted);
+      out.append(d + j, q - j);
+      emitted = s;
+      i = s;
+      *changed = true;
+    } else if (j - a >= 2) {
+      out.append(d + emitted, a - emitted);
+      emitted = j;
+      i = j;
+      *changed = true;
+    } else {
+      i = j;
+    }
+  }
+  if (!*changed) return std::string(d, len);
+  out.append(d + emitted, len - emitted);
+  return out;
+}
+
+// gsub(/\n\n\s*(?:[*-]|\(?[\da-z]{1,2}[).])\s+/i, "\n\n- ").  The
+// "\n\n" sites come from a cached per-block newline mask (bullet-heavy
+// texts have hundreds, and a library-call-per-site scan dominated the
+// pass).
+inline std::string bullet_scan(const char *d, size_t len, bool *changed) {
+  *changed = false;
+  std::string out;
+  size_t i = 0, emitted = 0;
+  auto alnum_ci = [](char c) {
+    c = lower_ascii(c);
+    return (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z');
+  };
+#if defined(__SSE2__)
+  const size_t nblocks = len >> 4;
+  size_t cur_block = ~static_cast<size_t>(0);
+  unsigned cur_mask = 0;
+  const __m128i nl = _mm_set1_epi8('\n');
+  auto find_pair = [&](size_t from) -> size_t {
+    while (from + 1 < len) {
+      size_t b = from >> 4;
+      if (b >= nblocks) break;
+      if (b != cur_block) {
+        cur_block = b;
+        cur_mask = static_cast<unsigned>(_mm_movemask_epi8(_mm_cmpeq_epi8(
+            _mm_loadu_si128(
+                reinterpret_cast<const __m128i *>(d + (b << 4))),
+            nl)));
+      }
+      unsigned pair = cur_mask & (cur_mask >> 1);
+      if ((cur_mask >> 15) & 1u) {
+        size_t nxt = (b << 4) + 16;
+        if (nxt < len && d[nxt] == '\n') pair |= 1u << 15;
+      }
+      pair >>= (from & 15);
+      if (pair) return from + __builtin_ctz(pair);
+      from = (b + 1) << 4;
+    }
+    while (from + 1 < len && !(d[from] == '\n' && d[from + 1] == '\n'))
+      ++from;
+    return from + 1 < len ? from : len;
+  };
+#else
+  auto find_pair = [&](size_t from) -> size_t {
+    while (from + 1 < len && !(d[from] == '\n' && d[from + 1] == '\n'))
+      ++from;
+    return from + 1 < len ? from : len;
+  };
+#endif
+  while (i + 1 < len) {
+    size_t a = find_pair(i);
+    if (a >= len) break;
+    size_t j = a + 2;
+    while (j < len && kBT.space[static_cast<unsigned char>(d[j])]) ++j;
+    size_t k = 0;  // end of the marker alternative, 0 = no match
+    if (j < len && (d[j] == '*' || d[j] == '-')) {
+      k = j + 1;
+    } else {
+      size_t x = j;
+      if (x < len && d[x] == '(') ++x;
+      if (x + 1 < len && alnum_ci(d[x]) && alnum_ci(d[x + 1]) &&
+          x + 2 < len && (d[x + 2] == ')' || d[x + 2] == '.'))
+        k = x + 3;  // {2} then [).]
+      else if (x < len && alnum_ci(d[x]) && x + 1 < len &&
+               (d[x + 1] == ')' || d[x + 1] == '.'))
+        k = x + 2;  // {1} then [).]
+    }
+    if (k) {
+      size_t s = k;
+      while (s < len && kBT.space[static_cast<unsigned char>(d[s])]) ++s;
+      if (s > k) {
+        out.append(d + emitted, a - emitted);
+        out += "\n\n- ";
+        emitted = s;
+        i = s;
+        *changed = true;
+        continue;
+      }
+    }
+    i = a + 1;  // overlap: the second \n may open the next \n\n
+  }
+  if (!*changed) return std::string(d, len);
+  out.append(d + emitted, len - emitted);
+  return out;
+}
+
+// gsub(/\)\s+\(/, ")(")
+inline std::string bullet_join_scan(const char *d, size_t len,
+                                    bool *changed) {
+  *changed = false;
+  std::string out;
+  size_t i = 0, emitted = 0;
+  while (i < len) {
+    const char *m =
+        static_cast<const char *>(std::memchr(d + i, ')', len - i));
+    if (!m) break;
+    size_t a = static_cast<size_t>(m - d);
+    size_t j = a + 1;
+    while (j < len && kBT.space[static_cast<unsigned char>(d[j])]) ++j;
+    if (j > a + 1 && j < len && d[j] == '(') {
+      out.append(d + emitted, a - emitted);
+      out += ")(";
+      emitted = j + 1;
+      i = j + 1;
+      *changed = true;
+    } else {
+      i = a + 1;
+    }
+  }
+  if (!*changed) return std::string(d, len);
+  out.append(d + emitted, len - emitted);
+  return out;
+}
+
+// gsub(/^[*-](.*?)[*-]$/, '\1'): a line whose first AND last chars are
+// [*-] (length >= 2) loses exactly those two chars — the lazy middle
+// with a 1-char closer pins the closer to the line's last char.
+inline std::string border_markup_scan(const char *d, size_t len,
+                                      bool *changed) {
+  *changed = false;
+  std::string out;
+  size_t ls = 0, emitted = 0;
+  while (ls < len) {
+    const char *nl =
+        static_cast<const char *>(std::memchr(d + ls, '\n', len - ls));
+    size_t le = nl ? static_cast<size_t>(nl - d) : len;
+    if (le - ls >= 2 && (d[ls] == '*' || d[ls] == '-') &&
+        (d[le - 1] == '*' || d[le - 1] == '-')) {
+      out.append(d + emitted, ls - emitted);
+      out.append(d + ls + 1, le - ls - 2);
+      emitted = le;
+      *changed = true;
+    }
+    ls = le + 1;
+  }
+  if (!*changed) return std::string(d, len);
+  out.append(d + emitted, len - emitted);
+  return out;
+}
+
+// one line of ^\s*?[/*]{1,2} (comment_markup as a boolean, for the
+// every-line gate of strip_comments): first non-space char is / or *
+inline bool line_is_comment(const char *p, size_t n) {
+  size_t i = 0;
+  while (i < n && kBT.space[static_cast<unsigned char>(p[i])]) ++i;
+  return i < n && (p[i] == '/' || p[i] == '*');
+}
+
+// Span equality via fixed-size 8-byte loads — a variable-length memcmp
+// CALL per probed token measured ~10 ns on the deployment hosts.
+// PRECONDITION: both spans tolerate an 8-byte load at every compared
+// offset (i.e. up to 7 bytes past the span end are readable) — callers
+// guard with an explicit limit check or pad their buffers.
+inline bool span_eq_padded(const char *a, const char *b, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t x, y;
+    std::memcpy(&x, a + i, 8);
+    std::memcpy(&y, b + i, 8);
+    if (x != y) return false;
+  }
+  if (i < n) {
+    uint64_t x, y;
+    std::memcpy(&x, a + i, 8);
+    std::memcpy(&y, b + i, 8);
+    uint64_t m = ~0ull >> ((8 - (n - i)) * 8);
+    if ((x ^ y) & m) return false;
+  }
+  return true;
 }
 
 // The wordset token regex (content_helper.rb:109):
@@ -656,6 +1370,135 @@ inline uint64_t token_hash(const char *p, size_t n) {
 struct Slice {
   size_t off, len;
 };
+
+// Walk every wordset token span (the unit-run + apostrophe-suffix
+// grammar above) and call f(start, n, hash) — the ONE tokenizer shared
+// by wordset_unique and the fused featurize loop in pipeline.cpp, so the
+// two can never disagree on token boundaries.
+//
+// The class mask is computed ONCE per 16-byte block and cached: tokens
+// average ~6 bytes, so the start scan and the end scan of one token
+// (and usually the next token's start) all read the same block — the
+// per-call vector setup of the generic find_tokbyte/find_nontok helpers
+// dominated this loop at ~4 ns/byte before the cache, ~0.6 after.
+template <class F>
+inline void scan_tokens(const char *data, size_t len, F &&f) {
+  // token spans are runs of token-class bytes, possibly glued by an
+  // apostrophe suffix ("'s" after any unit, bare "'" after an 's'); an
+  // apostrophe is only consumable right after a non-empty unit run —
+  // that guard keeps "s's'" from eating the second quote, matching the
+  // unit-loop regex.
+#if defined(__SSE2__)
+  // event-driven over per-block class masks: one tok_mask16 per 16
+  // bytes, run starts/ends pulled out with ctz — the per-call finder
+  // helpers cost ~4 ns/byte here before this shape, ~0.7 after
+  const size_t nblocks = len >> 4;
+  size_t start = ~static_cast<size_t>(0);  // ~0 = not inside a token
+  // glue is only legal after a NON-EMPTY unit segment: a second
+  // apostrophe immediately after a consumed "'s"/"'" must end the token
+  // (the regex's unit loop guard) — `glue_bar` is the position just
+  // after the last glue, and an end event at that exact position
+  // glues no further
+  size_t glue_bar = 0;
+  size_t i = 0;
+  for (size_t b = 0; b < nblocks; ++b) {
+    const size_t base = b << 4;
+    if (i >= base + 16) continue;  // an apostrophe glue jumped ahead
+    unsigned m = static_cast<unsigned>(tok_mask16(data + base));
+    // fast block skips: all-plain outside a token, all-token inside
+    if (start == ~static_cast<size_t>(0)) {
+      if (m == 0) {
+        i = base + 16;
+        continue;
+      }
+    } else if (m == 0xFFFFu) {
+      i = base + 16;
+      continue;
+    }
+    if (i < base) i = base;
+    while (i < base + 16) {
+      if (start == ~static_cast<size_t>(0)) {
+        unsigned mm = m >> (i - base);
+        if (!mm) {
+          i = base + 16;
+          break;
+        }
+        i += __builtin_ctz(mm);
+        start = i;
+      } else {
+        unsigned mm = (~m & 0xFFFFu) >> (i - base);
+        if (!mm) {
+          i = base + 16;
+          break;
+        }
+        i += __builtin_ctz(mm);
+        // run end at i: apostrophe glue keeps the token open
+        if (data[i] == '\'' && i > glue_bar) {
+          if (i + 1 < len && data[i + 1] == 's') {
+            i += 2;  // "'s" — then the unit loop may continue
+            glue_bar = i;
+            continue;
+          }
+          if (data[i - 1] == 's') {
+            i += 1;  // (?<=s)'
+            glue_bar = i;
+            continue;
+          }
+        }
+        f(start, i - start, token_hash(data + start, i - start));
+        start = ~static_cast<size_t>(0);
+      }
+    }
+  }
+  // scalar tail (plus the in-flight token state)
+  size_t p = i < (nblocks << 4) ? (nblocks << 4) : i;
+  while (p < len) {
+    if (start == ~static_cast<size_t>(0)) {
+      if (kBT.tok[static_cast<unsigned char>(data[p])]) start = p;
+      ++p;
+    } else if (kBT.tok[static_cast<unsigned char>(data[p])]) {
+      ++p;
+    } else if (data[p] == '\'' && p > glue_bar &&
+               ((p + 1 < len && data[p + 1] == 's') ||
+                data[p - 1] == 's')) {
+      p += (p + 1 < len && data[p + 1] == 's') ? 2 : 1;
+      glue_bar = p;
+    } else {
+      f(start, p - start, token_hash(data + start, p - start));
+      start = ~static_cast<size_t>(0);
+      ++p;
+    }
+  }
+  if (start != ~static_cast<size_t>(0))
+    f(start, len - start, token_hash(data + start, len - start));
+#else
+  size_t i = 0;
+  while (i < len) {
+    i = static_cast<size_t>(find_tokbyte(data + i, data + len) - data);
+    if (i >= len) break;
+    size_t start = i;
+    for (;;) {
+      size_t entry = i;
+      size_t j =
+          static_cast<size_t>(find_nontok(data + i, data + len) - data);
+      i = j;
+      if (j > entry && j < len && data[j] == '\'') {
+        if (j + 1 < len && data[j + 1] == 's') {
+          i = j + 2;  // "'s" — consumed whenever present after a unit
+          continue;
+        }
+        if (data[j - 1] == 's') {
+          i = j + 1;  // (?<=s)'
+          continue;
+        }
+      }
+      break;
+    }
+    size_t n = i - start;
+    f(start, n, token_hash(data + start, n));
+  }
+#endif
+}
 
 // Scan for unique tokens; FNV-1a64 of each token is computed inline during
 // the scan (per-token hashes land in `hashes_out` when non-null) so that
@@ -708,56 +1551,22 @@ inline std::vector<Slice> wordset_unique(const char *data, size_t len,
                         static_cast<uint32_t>(hh >> 32), gen};
     }
   };
-  size_t i = 0;
-  while (i < len) {
-    // token spans are runs of token-class bytes, possibly glued by an
-    // apostrophe suffix ("'s" after any unit, bare "'" after an 's');
-    // the vectorized finders jump run-to-run instead of per byte.  An
-    // apostrophe is only consumable right after a unit char, i.e. when
-    // this iteration's run is non-empty (j > entry) — that guard keeps
-    // "s's'" from eating the second quote, matching the unit-loop regex.
-    i = find_tokbyte(data + i, data + len) - data;
-    if (i >= len) break;
-    size_t start = i;
-    for (;;) {
-      size_t entry = i;
-      size_t j = static_cast<size_t>(find_nontok(data + i, data + len) -
-                                     data);
-      i = j;
-      if (j > entry && j < len && data[j] == '\'') {
-        if (j + 1 < len && data[j + 1] == 's') {
-          i = j + 2;  // "'s" — consumed whenever present after a unit
-          continue;
-        }
-        if (data[j - 1] == 's') {
-          i = j + 1;  // (?<=s)'
-          continue;
-        }
-      }
-      break;
-    }
-    size_t n = i - start;
-    uint64_t h = token_hash(data + start, n);
+  scan_tokens(data, len, [&](size_t start, size_t n, uint64_t h) {
     size_t slot = h & mask;
     const uint32_t tag = static_cast<uint32_t>(h >> 32);
-    bool seen = false;
     while (table[slot].gen == gen) {
       const Entry &e = table[slot];
       if (e.tag == tag && e.len == n &&
-          std::memcmp(data + e.off_plus1 - 1, data + start, n) == 0) {
-        seen = true;
-        break;
-      }
+          std::memcmp(data + e.off_plus1 - 1, data + start, n) == 0)
+        return;  // seen
       slot = (slot + 1) & mask;
     }
-    if (!seen) {
-      table[slot] = Entry{static_cast<uint32_t>(start + 1),
-                          static_cast<uint32_t>(n), tag, gen};
-      uniques.push_back({start, n});
-      hs->push_back(h);
-      if (++inserted * 10 > want * 7) grow();
-    }
-  }
+    table[slot] = Entry{static_cast<uint32_t>(start + 1),
+                        static_cast<uint32_t>(n), tag, gen};
+    uniques.push_back({start, n});
+    hs->push_back(h);
+    if (++inserted * 10 > want * 7) grow();
+  });
   return uniques;
 }
 

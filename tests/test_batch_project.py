@@ -467,3 +467,46 @@ def test_resume_sidecar_with_extra_future_keys_is_accepted(tmp_path):
         str(out), resume=True
     )
     assert len(out.read_text().splitlines()) == 4
+
+
+def test_resume_fingerprint_pins_template_content(tmp_path):
+    """Regression (ADVICE r5): the sidecar's corpus fingerprint folds in
+    per-template normalized-content hashes — an edited vendored template
+    with unchanged keys and vocab size must refuse to resume."""
+    from dataclasses import replace
+
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    mit = fixture_contents("mit/LICENSE.txt")
+    p = tmp_path / "LICENSE"
+    p.write_text(mit)
+    out = tmp_path / "out.jsonl"
+    first = BatchProject([str(p)] * 2, batch_size=2, workers=1)
+    first.run(str(out), resume=False)
+    config = first._run_config()
+    assert "content_sha1" in config["corpus"]
+
+    # same corpus -> same fingerprint -> resume accepted
+    BatchProject([str(p)] * 4, batch_size=2, workers=1).run(
+        str(out), resume=True
+    )
+
+    # simulate ONE template's normalized content changing while keys and
+    # vocab size stay identical (the exact blind spot of the old
+    # keys+vocab-only fingerprint)
+    corpus = first.classifier.corpus
+    hashes = dict(corpus.content_hashes)
+    h, key = next(iter(hashes.items()))
+    del hashes[h]
+    hashes["0" * 40] = key
+    edited = replace(corpus, content_hashes=hashes)
+    clf = BatchClassifier(corpus=edited, pad_batch_to=2, mesh=None)
+    project = BatchProject([str(p)] * 4, batch_size=2, classifier=clf)
+    assert (
+        project._run_config()["corpus"]["keys_sha1"]
+        == config["corpus"]["keys_sha1"]
+    )
+    before = out.read_text()
+    with pytest.raises(ValueError, match="corpus"):
+        project.run(str(out), resume=True)
+    assert out.read_text() == before

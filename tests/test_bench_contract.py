@@ -72,7 +72,13 @@ def _fat_details() -> dict:
             "p50_ms": 99999.999,
             "p99_ms": 99999.999,
         },
-        "host_model": {"z" * 30: 9.9 for _ in range(1)},
+        "host_model": {
+            "z" * 30: 9.9,
+            "featurize_us_per_blob": 99_999_999.9,
+            "scaling_model": {
+                "amdahl_ceiling_files_per_sec": 99_999_999.9,
+            },
+        },
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
         "scalar_agreement": {
@@ -123,6 +129,10 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["at_scale_auto"]["files_per_sec"] == 8_748_728.9
     assert d["e2e_files_per_sec"]["readme"] == 8_748_728.9
     assert d["serve_path"]["cached_rps"] == 99_999_999.9
+    assert d["host_model"]["featurize_us_per_blob"] == 99_999_999.9
+    assert (
+        d["host_model"]["amdahl_ceiling_files_per_sec"] == 99_999_999.9
+    )
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 

@@ -358,3 +358,62 @@ def test_apply_visible_chips_exports_runtime_vars():
     assert json.loads(result.stdout.strip().splitlines()[-1]) == {
         "n_local": 3
     }
+
+
+def test_apply_visible_chips_dict_env_never_touches_os_environ():
+    """Regression (ADVICE r5): with a caller-supplied dict env, the chip
+    spec, the conflict check, and every write must go through THAT
+    mapping — a dict-env dry run used to validate against (and mutate)
+    os.environ instead."""
+    child = textwrap.dedent(
+        """
+        import json, os, sys
+        sys.path.insert(0, %r)
+        from licensee_tpu.parallel import distributed
+
+        # conflict inside the DICT env must refuse, even though
+        # os.environ has no TPU_VISIBLE_DEVICES at all
+        env = {
+            "LICENSEE_TPU_VISIBLE_CHIPS": "4,5",
+            "TPU_VISIBLE_DEVICES": "9",
+        }
+        try:
+            distributed.apply_visible_chips(env=env)
+        except RuntimeError:
+            pass
+        else:
+            raise AssertionError("dict-env conflict not refused")
+        assert "TPU_VISIBLE_DEVICES" not in os.environ
+
+        # a consistent dict env is applied INTO the dict, with
+        # os.environ untouched (including the co-location var set)
+        env = {
+            "LICENSEE_TPU_VISIBLE_CHIPS": "4,5",
+            "LICENSEE_TPU_NUM_PROCESSES": "2",
+            "LICENSEE_TPU_PROCESS_ID": "0",
+        }
+        before = dict(os.environ)
+        chips = distributed.apply_visible_chips(env=env)
+        assert chips == ["4", "5"], chips
+        assert env["TPU_VISIBLE_DEVICES"] == "4,5"
+        assert "device_count=2" in env["XLA_FLAGS"], env
+        assert env["TPU_PROCESS_PORT"] == "8476"
+        assert env["CLOUD_TPU_TASK_ID"] == "0"
+        assert dict(os.environ) == before, "os.environ was mutated"
+        print(json.dumps({"ok": True}))
+        """
+        % REPO
+    )
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("LICENSEE_TPU_", "TPU_", "XLA_FLAGS"))
+    }
+    result = subprocess.run(
+        [sys.executable, "-c", child],
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert json.loads(result.stdout.strip().splitlines()[-1]) == {"ok": True}

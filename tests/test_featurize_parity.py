@@ -1,0 +1,134 @@
+"""Golden featurizer-parity suite: the fused single-pass native
+featurizer must be BIT-IDENTICAL to the pure-Python pipeline — no
+semantic drift is allowed in exchange for speed.
+
+Covers the full vendored corpus plus adversarial blobs (HTML, CRLF,
+unicode dashes/quotes, non-ASCII titles, empty/huge lines) across every
+surface a classification can depend on: normalized text, content hash,
+wordset bits, |wordset|, normalized length, prefilter flags.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from licensee_tpu.native import selftest
+
+
+@pytest.fixture(scope="module")
+def clf():
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    c = BatchClassifier(mesh=None, device=False)
+    if c._nat is None:
+        pytest.skip("native pipeline unavailable")
+    return c
+
+
+def test_full_corpus_and_adversarial_parity(clf):
+    stats = selftest.run_parity(clf)
+    assert stats["blobs"] >= 60  # 47 vendored templates + adversarial set
+    assert stats["text_checked"] == stats["blobs"]
+
+
+def test_adversarial_blob_list_covers_required_shapes():
+    blobs = selftest.adversarial_blobs()
+    joined = b"|".join(blobs)
+    assert b"" in blobs  # empty
+    assert b"\r\n" in joined  # CRLF
+    assert "–".encode() in joined  # unicode dash
+    assert "“".encode() in joined  # unicode quote
+    assert "MITライセンス".encode() in joined  # non-ASCII title
+    assert b"<html>" in joined  # HTML-shaped content
+    assert any(len(b) > 65536 for b in blobs)  # huge line
+    assert b"\xef\xbb\xbf" in joined  # BOM
+
+
+def test_normalized_text_and_hash_bit_identical(clf):
+    """Spot parity on the exact surfaces the golden corpus pins: the
+    native stage1 -> lower -> stage2 text equals content_normalized,
+    and sha1 of it equals content_hash."""
+    from licensee_tpu.kernels.batch import NormalizedBlob
+    from licensee_tpu.rubytext import ruby_strip
+
+    for raw in (
+        b"MIT License\n\ncopyright (c) 2000 X\n\npermission granted & "
+        b"http://x.test \xe2\x80\x94 'quoted' sub-license per cent",
+        "the licence – “MIT”:\n\n- a\n\n- b\n".encode(),
+    ):
+        blob = NormalizedBlob(raw)
+        stripped = ruby_strip(blob.content)
+        s1, _ = clf._nat.stage1(stripped)
+        s2 = clf._nat.stage2(s1.lower())
+        assert s2 == blob.content_normalized()
+        assert (
+            hashlib.sha1(s2.encode()).hexdigest() == blob.content_hash
+        )
+
+
+def test_batch_rows_mapping_zero_copy(clf):
+    """featurize_batch with a sparse row map writes each blob's bits into
+    the caller-owned row of the FULL matrix — identical to the dense
+    call, with untouched rows left alone."""
+    contents = [
+        b"permission granted to deal in the software " * 20,
+        b"redistribution and use in source and binary forms " * 20,
+    ]
+    W = clf.corpus.n_lanes
+    dense_bits = np.zeros((2, W), dtype=np.uint32)
+    meta = np.zeros((2, 3), dtype=np.int32)
+    hashes = np.zeros((2, 16), dtype=np.uint8)
+    st = clf._nat.featurize_batch(
+        clf._nat_vocab, contents, dense_bits, meta, hashes
+    )
+    assert (st == 0).all()
+
+    big = np.full((5, W), 7, dtype=np.uint32)
+    meta2 = np.zeros((2, 3), dtype=np.int32)
+    hashes2 = np.zeros((2, 16), dtype=np.uint8)
+    st2 = clf._nat.featurize_batch(
+        clf._nat_vocab,
+        contents,
+        big,
+        meta2,
+        hashes2,
+        rows=np.array([3, 1], dtype=np.int64),
+    )
+    assert (st2 == 0).all()
+    assert np.array_equal(big[3], dense_bits[0])
+    assert np.array_equal(big[1], dense_bits[1])
+    assert (big[0] == 7).all() and (big[2] == 7).all() and (big[4] == 7).all()
+    assert np.array_equal(meta2, meta)
+    assert np.array_equal(hashes2, hashes)
+    # out-of-range rows are rejected, not written
+    with pytest.raises(ValueError):
+        clf._nat.featurize_batch(
+            clf._nat_vocab, contents, big, meta2, hashes2,
+            rows=np.array([3, 5], dtype=np.int64),
+        )
+
+
+def test_prepare_batch_sparse_subset_matches_dense(clf):
+    """prepare_batch with preset rows (the dedupe shape) routes the
+    native-eligible remainder through the row map; features must equal
+    the no-preset run row for row."""
+    from licensee_tpu.kernels.batch import BlobResult
+
+    contents = [
+        b"alpha beta gamma delta " * 40,
+        b"the quick brown fox " * 40,
+        b"permission is hereby granted " * 40,
+        b"redistribution and use " * 40,
+    ]
+    dense = clf.prepare_batch(list(contents))
+    preset = [None, BlobResult("mit", "exact", 100.0), None, None]
+    sparse = clf.prepare_batch(list(contents), preset=preset)
+    for i in (0, 2, 3):
+        assert np.array_equal(sparse.bits[i], dense.bits[i])
+        assert sparse.n_words[i] == dense.n_words[i]
+        assert sparse.lengths[i] == dense.lengths[i]
+    assert sparse.results[1] is preset[1]
+    assert sparse.todo == [0, 2, 3]

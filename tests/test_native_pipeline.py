@@ -212,10 +212,11 @@ def test_resource_limit_fails_over_to_python(monkeypatch):
         assert (r.key, r.matcher) == ("mit", "exact")
 
 
-def test_profile_dump_off_by_default():
-    """The pass profiler (LICENSEE_TPU_PIPE_PROFILE) must cost nothing
-    and report nothing unless enabled at process start; the enabled
-    path is exercised by a subprocess so this process stays clean."""
+def test_profile_dump_stage_counters_and_gated_passes():
+    """profile_dump always reports the stage.*/count.* attribution rows
+    (cheap relaxed counters); the fine-grained per-pass rows require
+    LICENSEE_TPU_PIPE_PROFILE at process start — exercised by a
+    subprocess so this process stays clean."""
     import json
     import subprocess
     import sys
@@ -225,8 +226,26 @@ def test_profile_dump_off_by_default():
     clf = BatchClassifier(pad_batch_to=8, mesh=None)
     if clf._nat is None:
         pytest.skip("native pipeline unavailable")
+    before = clf._nat.profile_dump()
     clf.classify_blobs([b"some words to featurize"])
-    assert clf._nat.profile_dump() == {}
+    prof = clf._nat.profile_dump()
+    # always-on stage counters, no env flag required
+    assert {
+        "stage.normalize_s",
+        "stage.wordset_s",
+        "stage.pack_s",
+        "count.blobs",
+        "count.tokens",
+        "count.unique",
+        "count.oov",
+        "count.bytes_in",
+        "count.nonascii_fallback",
+    } <= set(prof)
+    assert prof["count.blobs"] >= before.get("count.blobs", 0) + 1
+    assert prof["count.tokens"] >= prof["count.unique"]
+    # the env-gated per-pass rows must NOT appear without the flag
+    assert not any(k.startswith(("s1.", "s2.", "stage1", "stage2"))
+                   for k in prof)
 
     code = (
         "import json\n"
@@ -248,7 +267,7 @@ def test_profile_dump_off_by_default():
     )
     assert result.returncode == 0, result.stderr[-2000:]
     prof = json.loads(result.stdout.strip().splitlines()[-1])
-    assert {"stage1", "stage2", "wordset_vocab"} <= set(prof)
+    assert {"stage1", "stage2", "stage.tokenize_only"} <= set(prof)
     assert all(v >= 0 for v in prof.values())
 
 
