@@ -909,6 +909,21 @@ def bench_serve_path(n_requests: int = 2048) -> dict:
             r.wait(600.0)
         cached_sec = time.perf_counter() - t0
         stats = batcher.stats()
+        # the obs layer's own health, measured on real serve traffic:
+        # exposition size + grammar, trace retention, and the device
+        # compile-vs-execute split (details.obs; a scalar summary rides
+        # the headline)
+        from licensee_tpu.obs import check_exposition
+
+        exposition = batcher.prometheus()
+        obs = {
+            "prometheus_lines": len(exposition.splitlines()),
+            "prometheus_grammar_errors": len(check_exposition(exposition)),
+            "metric_families": len(batcher.obs.registry.families()),
+            "tracing": batcher.obs.tracer.stats(),
+            "device_dispatch": stats.get("device"),
+            "uptime_s": stats.get("uptime_s"),
+        }
     total = stats["latency_ms"]["total"]
     return {
         "requests": n_requests,
@@ -919,6 +934,7 @@ def bench_serve_path(n_requests: int = 2048) -> dict:
         "bucket_counts": stats["scheduler"]["buckets"],
         "p50_ms": total["p50_ms"],
         "p99_ms": total["p99_ms"],
+        "obs": obs,
     }
 
 
@@ -988,6 +1004,19 @@ def make_headline(
                 "uncached_rps": serve.get("uncached_rps"),
                 "cached_rps": serve.get("cached_rps"),
                 "p99_ms": serve.get("p99_ms"),
+            },
+            # the observability layer's own health on real serve
+            # traffic (full snapshot under details.serve_path.obs)
+            "obs": {
+                "prom_lines": (serve.get("obs") or {}).get(
+                    "prometheus_lines"
+                ),
+                "grammar_errors": (serve.get("obs") or {}).get(
+                    "prometheus_grammar_errors"
+                ),
+                "traces": ((serve.get("obs") or {}).get("tracing") or {}).get(
+                    "retained"
+                ),
             },
             # the host-featurize trajectory: crossing us/blob and the
             # single-process Amdahl ceiling it implies

@@ -457,6 +457,10 @@ def cmd_serve(args) -> int:
             deadline_ms=args.deadline_ms,
             threshold=args.confidence,
             buckets=buckets,
+            tracing=not args.no_tracing,
+            trace_sample=args.trace_sample,
+            trace_slow_ms=args.trace_slow_ms,
+            trace_log=args.trace_log,
             **kwargs,
         )
     except ValueError as exc:
@@ -477,6 +481,60 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_stats(args) -> int:
+    """Observability exporter client: scrape a running serve worker's
+    metrics (JSON or Prometheus text exposition) or its trace tail over
+    the Unix-socket control verbs, or run the obs-layer selftest."""
+    if args.selftest:
+        from licensee_tpu.obs.selftest import selftest as obs_selftest
+
+        return obs_selftest()
+    if not args.socket:
+        print(
+            "error: need --socket PATH (a running `licensee-tpu serve "
+            "--socket` worker) or --selftest",
+            file=sys.stderr,
+        )
+        return 1
+    import socket as socketlib
+
+    if args.trace is not None:
+        request = {"op": "trace", "n": args.trace}
+    else:
+        request = {"op": "stats"}
+        if args.format == "prometheus":
+            request["format"] = "prometheus"
+    try:
+        with socketlib.socket(
+            socketlib.AF_UNIX, socketlib.SOCK_STREAM
+        ) as sock:
+            sock.settimeout(args.timeout)
+            sock.connect(args.socket)
+            f = sock.makefile("rwb")
+            f.write(json.dumps(request).encode("utf-8") + b"\n")
+            f.flush()
+            line = f.readline()
+    except OSError as exc:
+        print(f"error: cannot scrape {args.socket!r}: {exc}", file=sys.stderr)
+        return 1
+    try:
+        row = json.loads(line)
+    except ValueError as exc:
+        print(f"error: bad response line: {exc}", file=sys.stderr)
+        return 1
+    if "prometheus" in row:
+        sys.stdout.write(row["prometheus"])
+    elif "traces" in row:
+        for trace in row["traces"]:
+            print(json.dumps(trace))
+    elif "stats" in row:
+        print(json.dumps(row["stats"]))
+    else:
+        print(f"error: unexpected response: {row}", file=sys.stderr)
+        return 1
+    return 0
+
+
 # the one command table: build_parser() wires each entry into argparse
 # and cmd_help() prints it — no argparse-private introspection (the
 # Thor-style listing of /root/reference/bin/licensee:10-43)
@@ -488,6 +546,7 @@ COMMANDS = (
     ("help", "Describe available commands"),
     ("batch-detect", "Classify a manifest of files on the TPU batch path"),
     ("serve", "Run the online micro-batching classification worker"),
+    ("stats", "Scrape a serve worker's metrics/traces (obs exporters)"),
 )
 _COMMAND_HELP = dict(COMMANDS)
 
@@ -769,14 +828,74 @@ def build_parser() -> argparse.ArgumentParser:
         help="Dump the final stats JSON to stderr at shutdown",
     )
     serve.add_argument(
+        "--no-tracing", action="store_true",
+        help="Disable request tracing entirely (metrics stay on)",
+    )
+    serve.add_argument(
+        "--trace-sample", type=nonneg(float), default=0.01, metavar="RATE",
+        help=(
+            "Head-sampling rate in [0,1]: retain every ~1/RATE-th "
+            "request's trace (default 0.01; slow requests are always "
+            "retained regardless)"
+        ),
+    )
+    serve.add_argument(
+        "--trace-slow-ms", type=nonneg(float), default=250.0, metavar="MS",
+        help=(
+            "Slow-request exemplar threshold: a request slower than MS "
+            "is retained (and logged with --trace-log) even when head "
+            "sampling skipped it (default 250)"
+        ),
+    )
+    serve.add_argument(
+        "--trace-log", default=None, metavar="PATH",
+        help=(
+            "Append slow-request exemplar traces to this JSONL file "
+            "(bounded: one rotation to PATH.1 at ~4 MiB)"
+        ),
+    )
+    serve.add_argument(
         "--selftest", action="store_true",
         help=(
             "Run an in-process end-to-end session (exact prefilter, "
-            "Dice micro-batch, cache hit, stats verb) and exit 0/1 — "
+            "Dice micro-batch, cache hit, stats verb, Prometheus "
+            "exposition, five-span exemplar trace) and exit 0/1 — "
             "the CI smoke"
         ),
     )
     serve.set_defaults(func=cmd_serve)
+
+    stats = sub.add_parser("stats", help=_COMMAND_HELP["stats"])
+    stats.add_argument(
+        "--socket", default=None, metavar="PATH",
+        help="The serve worker's Unix socket to scrape",
+    )
+    stats.add_argument(
+        "--format", default="json", choices=["json", "prometheus"],
+        help=(
+            "Output: 'json' (the stats verb snapshot) or 'prometheus' "
+            "(text exposition — pipe into a node_exporter textfile or "
+            "curl-style scrape job)"
+        ),
+    )
+    stats.add_argument(
+        "--trace", type=nonneg(int), default=None, metavar="N",
+        help="Print the last N retained traces (JSONL) instead of metrics",
+    )
+    stats.add_argument(
+        "--timeout", type=nonneg(float), default=10.0, metavar="SECS",
+        help="Socket connect/read timeout (default 10)",
+    )
+    stats.add_argument(
+        "--selftest", action="store_true",
+        help=(
+            "Exercise the obs layer in-process (registry, histogram "
+            "math, exposition grammar, tracer sampling + slow "
+            "exemplars, native-profile delta scrape) and exit 0/1 — "
+            "the CI smoke"
+        ),
+    )
+    stats.set_defaults(func=cmd_stats)
 
     # the COMMANDS table and the registered subcommands must not drift:
     # `help` prints from the table, the parser dispatches from argparse
@@ -791,7 +910,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "-h", "--help"}
+    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

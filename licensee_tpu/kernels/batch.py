@@ -9,6 +9,8 @@ one vmapped bit-matrix pass on device.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -189,6 +191,18 @@ class BatchClassifier:
         if mode not in ("license", "readme", "package", "auto"):
             raise ValueError(f"unknown mode {mode!r}")
         self.mode = mode
+        # device dispatch attribution (obs): each distinct padded shape
+        # jit-compiles exactly once, so the FIRST dispatch of a shape is
+        # compile-dominated and every later one is steady-state enqueue.
+        # Splitting the two is the compile-vs-execute story the serve
+        # registry exports (one cold bucket showing up as a p99 cliff is
+        # a compile, not a regression).
+        self._dispatch_lock = threading.Lock()
+        self._dispatch_prof = {
+            "compiles": 0, "compile_s": 0.0,
+            "dispatches": 0, "dispatch_s": 0.0,
+        }
+        self._dispatched_shapes: set[int] = set()
         self.closest = int(closest)
         if self.closest < 0:
             raise ValueError("closest must be >= 0")
@@ -768,6 +782,7 @@ class BatchClassifier:
         already consumed, for readme sections) — the helpers never
         re-derive it from a filename.  The sentinel name below only
         re-arms NormalizedContent's own stage-ordered _strip_html."""
+        t0 = time.perf_counter()
         blob = NormalizedBlob(raw, filename="x.html" if html else None)
         results[i] = self._prefilter(blob) if prefilter else None
         if results[i] is None:
@@ -775,6 +790,16 @@ class BatchClassifier:
             cc_fp[i] = bool(
                 CC_FALSE_POSITIVE_REGEX.search(ruby_strip(blob.content or ""))
             )
+        # fallback parity for the native stage.*/count.* profile surface
+        # (native/pipeline.py profile_dump): blobs featurized on the
+        # pure-Python path account under the same keys
+        from licensee_tpu.native.pipeline import py_profile_add
+
+        py_profile_add(**{
+            "count.blobs": 1,
+            "count.bytes_in": len(raw) if raw is not None else 0,
+            "stage.normalize_s": time.perf_counter() - t0,
+        })
 
     @staticmethod
     def _is_html(filename: str | None) -> bool:
@@ -935,7 +960,19 @@ class BatchClassifier:
                 from licensee_tpu.parallel.mesh import shard_batch
 
                 b, nw, ln, cf = shard_batch(self.mesh, b, nw, ln, cf)
+            t0 = time.perf_counter()
             out = self._fn(b, nw, ln, cf)
+            dt = time.perf_counter() - t0
+            with self._dispatch_lock:
+                # first dispatch of a shape blocks on the jit compile;
+                # later ones are the steady-state async enqueue
+                if B not in self._dispatched_shapes:
+                    self._dispatched_shapes.add(B)
+                    self._dispatch_prof["compiles"] += 1
+                    self._dispatch_prof["compile_s"] += dt
+                else:
+                    self._dispatch_prof["dispatches"] += 1
+                    self._dispatch_prof["dispatch_s"] += dt
             # start the device->host copies NOW so finish_chunks finds
             # them ready instead of paying a synchronous transfer per
             # array (the main loop's serial section at 10M-file scale)
@@ -946,6 +983,16 @@ class BatchClassifier:
                     break  # non-jax arrays (interpret/test paths)
             outs.append((chunk, out))
         return outs
+
+    def dispatch_stats(self) -> dict:
+        """The device compile-vs-execute split: counts and seconds of
+        first-dispatch-per-shape (jit compile included) vs steady-state
+        dispatches, plus the compiled shape set.  Scraped into the obs
+        registry; resets with the classifier, never midstream."""
+        with self._dispatch_lock:
+            out = dict(self._dispatch_prof)
+            out["shapes"] = sorted(self._dispatched_shapes)
+        return out
 
     def merge_prepared(self, group: list[PreparedBatch]) -> PreparedBatch:
         """Coalesce the ``todo`` rows of several prepared batches into ONE

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ctypes
 import re
+import threading
 
 import numpy as np
 
@@ -180,6 +181,14 @@ class NativePipeline:
         lib.pipe_profile_dump.argtypes = [
             ctypes.POINTER(ctypes.c_size_t)
         ]
+        # a cached .so built before pipe_profile_reset existed lacks the
+        # symbol; degrade to reset-unavailable instead of failing init
+        try:
+            lib.pipe_profile_reset.restype = None
+            lib.pipe_profile_reset.argtypes = []
+            self._has_profile_reset = True
+        except AttributeError:
+            self._has_profile_reset = False
         lib.pipe_featurize_raw.restype = ctypes.c_int
         lib.pipe_featurize_raw.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
@@ -457,6 +466,15 @@ class NativePipeline:
                 out[name] = float(secs)
         return out
 
+    def profile_reset(self) -> bool:
+        """Zero every counter profile_dump reports (the obs registry
+        scrapes deltas and bench intervals want a clean zero).  Returns
+        False when the loaded .so predates the symbol."""
+        if not self._has_profile_reset:
+            return False
+        self._lib.pipe_profile_reset()
+        return True
+
     def exact_hash(self, wordset) -> bytes:
         """The 16-byte hash pipe_featurize computes, for a Python-side
         wordset (e.g. a compiled template's).  The hash is an
@@ -476,3 +494,51 @@ def load() -> NativePipeline | None:
         except NativeUnavailable:
             _failed = True
     return _instance
+
+
+# ---------------------------------------------------------------------------
+# Module-level profile surface with pure-Python fallback parity.
+#
+# The obs registry (and any scraper) wants ONE call pair that works
+# whether or not the native library loaded: with it, the native
+# stage.*/count.* counters; without it, a Python-side dict the fallback
+# featurize path feeds (same key names, so dashboards and the delta
+# collector never care which build served the traffic).
+
+_py_profile: dict[str, float] = {}
+_py_profile_lock = threading.Lock()
+
+
+def py_profile_add(**rows: float) -> None:
+    """Accumulate fallback-path rows, e.g. ``py_profile_add(**{
+    "count.blobs": 1, "stage.normalize_s": dt})``.  Cheap enough for
+    the per-blob pure-Python path (one lock + dict adds against a
+    multi-100-us blob)."""
+    with _py_profile_lock:
+        for name, v in rows.items():
+            _py_profile[name] = _py_profile.get(name, 0.0) + v
+
+
+def profile_dump() -> dict[str, float]:
+    """Cumulative stage.*/count.* rows, native and Python-side merged:
+    with the native library loaded the native counters dominate and the
+    Python accumulator carries only the rare failed-over blobs (PCRE2
+    resource limits); without it, the Python accumulator is the whole
+    story.  Key names are identical either way."""
+    pipe = _instance  # never trigger a build from a metrics scrape
+    native = pipe.profile_dump() if pipe is not None else {}
+    with _py_profile_lock:
+        py = dict(_py_profile)
+    for name, v in py.items():
+        native[name] = native.get(name, 0.0) + v
+    return native
+
+
+def profile_reset() -> bool:
+    """Zero the cumulative profile surface (both sides).  Returns False
+    only when a loaded native .so predates pipe_profile_reset — the
+    pure-Python accumulator always resets."""
+    with _py_profile_lock:
+        _py_profile.clear()
+    pipe = _instance
+    return pipe.profile_reset() if pipe is not None else True

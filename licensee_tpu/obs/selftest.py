@@ -1,0 +1,154 @@
+"""In-process selftest of the observability layer — the
+`licensee-tpu stats --selftest` CI smoke.
+
+Deliberately device-free and corpus-free (the serve selftest already
+covers the integrated path): this checks the obs substrate itself —
+registry math, exposition grammar, tracer retention (head sampling +
+slow exemplars + bounded JSONL log), and the native-profile delta
+scrape (two scrapes must not double-count).  Runs in milliseconds.
+
+House rule exception note: this module REPORTS via an explicit stream
+argument (stderr by default), honoring the obs/ no-print lint rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+from licensee_tpu.obs import (
+    MetricsRegistry,
+    NativeProfileSource,
+    Observability,
+    Tracer,
+    check_exposition,
+    render_prometheus,
+)
+
+
+def selftest(stream=None) -> int:
+    stream = sys.stderr if stream is None else stream
+    problems: list[str] = []
+
+    # -- registry math --
+    reg = MetricsRegistry()
+    c = reg.counter("t_events_total", "events", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc()
+    if c.labels(kind="a").value != 3 or c.labels(kind="b").value != 1:
+        problems.append(f"counter math: {c.samples()}")
+    g = reg.gauge("t_depth", "depth")
+    g.set(7)
+    if g.value != 7:
+        problems.append(f"gauge set: {g.value}")
+    pulled = reg.gauge("t_pulled", "pull gauge")
+    pulled.set_fn(lambda: 41 + 1)
+    if pulled.value != 42:
+        problems.append(f"gauge pull: {pulled.value}")
+    h = reg.histogram("t_lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    hv = h.value
+    if hv["count"] != 4 or hv["buckets"]["+Inf"] != 4 or hv["buckets"]["0.01"] != 1:
+        problems.append(f"histogram buckets: {hv}")
+    if reg.counter("t_events_total", labels=("kind",)) is not c:
+        problems.append("registry re-registration is not idempotent")
+    try:
+        reg.gauge("t_events_total")
+        problems.append("kind mismatch not rejected")
+    except ValueError:
+        pass
+
+    # -- exposition grammar --
+    text = render_prometheus(reg)
+    grammar = check_exposition(text)
+    if not text or grammar:
+        problems.append(f"exposition grammar: {grammar[:3]}")
+    for needle in (
+        "# TYPE t_events_total counter",
+        't_events_total{kind="a"} 3',
+        't_lat_seconds_bucket{le="+Inf"} 4',
+        "t_lat_seconds_count 4",
+    ):
+        if needle not in text:
+            problems.append(f"exposition missing {needle!r}")
+
+    # -- tracer: head sampling stride + always-captured slow exemplars --
+    with tempfile.TemporaryDirectory() as tmp:
+        log = os.path.join(tmp, "trace.jsonl")
+        tracer = Tracer(
+            sample_rate=0.5, slow_ms=40.0, capacity=8, log_path=log
+        )
+        kept = 0
+        for i in range(4):  # stride 2: traces 2 and 4 retained
+            t = tracer.start(request_id=i)
+            t.add_span("featurize", 0.001)
+            kept += tracer.finish(t)
+        if kept != 2:
+            problems.append(f"head sampling kept {kept}, want 2")
+        slow = tracer.start(request_id="slow")
+        slow.sampled = False  # force retention to come from slowness alone
+        slow.add_span("device", 0.05)
+        time.sleep(0.05)
+        if not tracer.finish(slow):
+            problems.append("slow exemplar not retained")
+        tail = tracer.tail(10)
+        if not tail or tail[-1]["id"] != "slow":
+            problems.append(f"trace tail: {tail}")
+        try:
+            with open(log, encoding="utf-8") as f:
+                logged = [json.loads(line) for line in f]
+        except OSError:
+            logged = []
+        if len(logged) != 1 or logged[0]["id"] != "slow" or not logged[0]["slow"]:
+            problems.append(f"exemplar log: {logged}")
+
+    # -- native profile deltas: two scrapes must not double-count --
+    cumulative = {"stage.normalize_s": 1.5, "count.blobs": 10.0}
+    reg2 = MetricsRegistry()
+    NativeProfileSource(reg2, dump_fn=lambda: dict(cumulative))
+    reg2.snapshot()
+    reg2.snapshot()  # no new work in between
+    blobs = (
+        reg2.counter("native_featurize_events_total", labels=("kind",))
+        .labels(kind="blobs")
+        .value
+    )
+    if blobs != 10.0:
+        problems.append(f"profile delta double-counted: {blobs}")
+    cumulative["count.blobs"] = 25.0
+    reg2.snapshot()
+    blobs = (
+        reg2.counter("native_featurize_events_total", labels=("kind",))
+        .labels(kind="blobs")
+        .value
+    )
+    if blobs != 25.0:
+        problems.append(f"profile delta lost an increment: {blobs}")
+
+    # -- Observability bundle: uptime gauge + merged snapshot shape --
+    obs = Observability(tracing=True, trace_sample=1.0)
+    snap = obs.snapshot()
+    if "process_uptime_seconds" not in snap["metrics"]:
+        problems.append("bundle missing process_uptime_seconds")
+    if "tracing" not in snap or "started" not in snap["tracing"]:
+        problems.append(f"bundle tracing stats: {snap.get('tracing')}")
+
+    stream.write(
+        json.dumps(
+            {
+                "obs_selftest": "ok" if not problems else "FAIL",
+                "problems": problems,
+            }
+        )
+        + "\n"
+    )
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(selftest())

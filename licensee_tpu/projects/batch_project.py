@@ -195,6 +195,7 @@ class BatchProject:
         progress_every: float = 0,
         already_striped: bool = False,
         coalesce_batches: int = 32,
+        tracer=None,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -292,6 +293,18 @@ class BatchProject:
             raise ValueError(
                 f"progress_every must be >= 0, got {progress_every!r}"
             )
+        # per-chunk observability: every produced batch gets a trace in
+        # the PROCESS-WIDE tracer (obs/tracing.py get_tracer) with
+        # read / featurize / device / write spans — the offline twin of
+        # the serve path's per-request traces, at one trace per
+        # batch_size files (negligible against a multi-second chunk).
+        # Pass tracer=False to opt out, or a Tracer to isolate.
+        if tracer is False:
+            self._tracer = None
+        else:
+            from licensee_tpu.obs import get_tracer
+
+            self._tracer = get_tracer() if tracer is None else tracer
 
     @classmethod
     def from_manifest_file(cls, manifest_file: str, **kwargs) -> "BatchProject":
@@ -535,6 +548,7 @@ class BatchProject:
             pending: deque = deque()
             gather: list = []
             gather_todo = 0
+            chunk_no = done // self.batch_size  # resume keeps ids stable
 
             def dispatch_gathered() -> None:
                 nonlocal gather_todo
@@ -550,7 +564,17 @@ class BatchProject:
                     device_out = self.classifier.dispatch_chunks(merged)
                 else:
                     merged, device_out = None, None
-                self.stats.add_stage("dispatch", time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                self.stats.add_stage("dispatch", dt)
+                if merged is not None:
+                    for b in batches:
+                        if b[9] is not None:
+                            # the group's device dispatch, shared by
+                            # every coalesced batch riding it
+                            b[9].add_span(
+                                "dispatch", dt, t0=t0,
+                                note=f"group={len(batches)}",
+                            )
                 pending.append((batches, merged, device_out))
 
             while futures or pending or gather:
@@ -563,6 +587,21 @@ class BatchProject:
                     submit_next()
                     self.stats.add_stage("read", t_read)
                     self.stats.add_stage("featurize", t_feat)
+                    trace = None
+                    if self._tracer is not None:
+                        chunk_no += 1
+                        trace = self._tracer.start(
+                            request_id=f"chunk-{chunk_no}"
+                        )
+                        # the produce stages ran on a worker BEFORE the
+                        # trace existed: rebase t_start so their spans
+                        # sit at t>=0 and the trace duration covers the
+                        # chunk's whole pipeline residency
+                        trace.t_start -= t_read + t_feat
+                        trace.add_span("read", t_read, t0=trace.t_start)
+                        trace.add_span(
+                            "featurize", t_feat, t0=trace.t_start + t_read
+                        )
                     if self.dedupe:
                         # re-probe the cross-batch cache on the main
                         # thread: rows produced during the pipeline /
@@ -590,7 +629,7 @@ class BatchProject:
                         prepared.compact_features()
                     gather.append(
                         (chunk, read_errs, keys, preset, dup_of, routes,
-                         prepared, contents, pre_rows)
+                         prepared, contents, pre_rows, trace)
                     )
                     gather_todo += len(prepared.todo)
                     if (
@@ -616,9 +655,14 @@ class BatchProject:
                     self.classifier.scatter_merged(
                         [b[6] for b in batches], merged
                     )
-                self.stats.add_stage("score", time.perf_counter() - t0)
+                dt_score = time.perf_counter() - t0
+                self.stats.add_stage("score", dt_score)
+                if merged is not None:
+                    for b in batches:
+                        if b[9] is not None:
+                            b[9].add_span("score", dt_score, t0=t0)
                 for (chunk, read_errs, keys, preset, dup_of, routes, prepared,
-                     contents, pre_rows) in batches:
+                     contents, pre_rows, trace) in batches:
                     results = prepared.results
                     for i, j in dup_of.items():
                         results[i] = results[j]
@@ -699,6 +743,9 @@ class BatchProject:
                     out.flush()
                     t2 = time.perf_counter()
                     self.stats.add_stage("write", t2 - t1)
+                    if trace is not None:
+                        trace.add_span("write", t2 - t1, t0=t1)
+                        self._tracer.finish(trace)
                     if (
                         self.progress_every
                         and t2 - t_progress >= self.progress_every

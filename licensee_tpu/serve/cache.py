@@ -91,3 +91,28 @@ class ResultCache:
                     else None
                 ),
             }
+
+    def register_metrics(self, registry) -> None:
+        """Publish this cache into an obs MetricsRegistry: occupancy
+        gauges pull live, hit/miss/eviction counters sync per scrape.
+        The cache owns its metric names — every consumer (the serve
+        scheduler today, an HTTP front end tomorrow) exports the same
+        series."""
+        registry.gauge(
+            "serve_cache_entries", "Result-cache entries resident"
+        ).set_fn(lambda: len(self))
+        registry.gauge(
+            "serve_cache_capacity", "Result-cache capacity"
+        ).set(self.capacity)
+        events = registry.counter(
+            "serve_cache_events_total",
+            "Result-cache hits / misses / evictions",
+            labels=("event",),
+        )
+
+        def collect(_reg) -> None:
+            snap = self.stats()
+            for kind in ("hits", "misses", "evictions"):
+                events.labels(event=kind).sync(snap[kind])
+
+        registry.add_collector(collect)

@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 
 import licensee_tpu
 from licensee_tpu.kernels.batch import BlobResult
+from licensee_tpu.obs import NativeProfileSource, Observability
 from licensee_tpu.serve.cache import ResultCache
 from licensee_tpu.serve.featurize import (
     UNROUTED,
@@ -50,7 +51,7 @@ from licensee_tpu.serve.featurize import (
 )
 from licensee_tpu.serve.stats import StageStats
 
-STAGES = ("featurize", "queue_wait", "device", "total")
+STAGES = ("cache_probe", "featurize", "queue_wait", "device", "total")
 
 
 class BatcherClosedError(RuntimeError):
@@ -64,8 +65,9 @@ class QueueFullError(RuntimeError):
     surfaces it so a well-behaved client backs off instead of
     hammering."""
 
-    def __init__(self, retry_after: float):
+    def __init__(self, retry_after: float, trace_id: str | None = None):
         self.retry_after = retry_after
+        self.trace_id = trace_id  # echoed on the backpressure row
         super().__init__(
             f"queue full; retry after {retry_after:.3f}s"
         )
@@ -93,6 +95,12 @@ class ServeRequest:
     # pipeline's in-batch dedupe
     followers: list = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
+    # the request's Trace (obs/tracing.py) — None when tracing is off
+    trace: object = None
+
+    @property
+    def trace_id(self) -> str | None:
+        return self.trace.trace_id if self.trace is not None else None
 
     def wait(self, timeout: float | None = None) -> BlobResult:
         if not self.done.wait(timeout):
@@ -125,6 +133,11 @@ class MicroBatcher:
         threshold: float | None = None,
         buckets: tuple[int, ...] | None = None,
         start: bool = True,
+        registry=None,
+        tracing: bool = True,
+        trace_sample: float = 0.01,
+        trace_slow_ms: float = 250.0,
+        trace_log: str | None = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch!r}")
@@ -159,7 +172,29 @@ class MicroBatcher:
         )
         self.cache = ResultCache(cache_entries)
         self.buckets = self._resolve_buckets(buckets)
-        self.stats_stages = StageStats(STAGES)
+        # -- observability: one registry + tracer per batcher.  The
+        # fresh default registry keeps repeated instances (tests,
+        # notebooks) from shadowing each other's serve_* gauges; the
+        # serve_* families assume ONE batcher per registry (the process
+        # doctrine), so share a registry only across non-overlapping
+        # sources --
+        self.obs = Observability(
+            registry,
+            tracing=tracing,
+            trace_sample=trace_sample,
+            trace_slow_ms=trace_slow_ms,
+            trace_log=trace_log,
+        )
+        stage_hist = self.obs.registry.histogram(
+            "serve_stage_seconds",
+            "Serve-path per-stage latency (one fixed-bound histogram "
+            "per stage, fed by the same clock reads as the reservoirs)",
+            labels=("stage",),
+        )
+        self.stats_stages = StageStats(
+            STAGES,
+            observer=lambda s, dt: stage_hist.labels(stage=s).observe(dt),
+        )
         self._queue: deque[ServeRequest] = deque()
         # content key -> the queued primary request: a duplicate
         # arriving while its twin is still queued attaches as a
@@ -190,8 +225,86 @@ class MicroBatcher:
         self._flush_reasons = {"full": 0, "deadline": 0, "drain": 0}
         self._bucket_counts: dict[int, int] = {}
         self._thread: threading.Thread | None = None
+        self._register_metrics()
         if start:
             self.start()
+
+    def _register_metrics(self) -> None:
+        """Wire every serve-path stat into the obs registry: live
+        gauges pull at scrape time, and one collector syncs the
+        scheduler/cache/device/native counter dicts — the subsystems
+        keep their cheap ad-hoc increments and the registry absorbs
+        them per scrape."""
+        reg = self.obs.registry
+        reg.gauge(
+            "serve_queue_depth", "Dice-bound requests waiting right now"
+        ).set_fn(lambda: len(self._queue))
+        reg.gauge(
+            "serve_in_flight",
+            "Queued primaries still owning a device slot (coalesce keys)",
+        ).set_fn(lambda: len(self._inflight))
+        reg.gauge(
+            "serve_queue_capacity", "Bounded admission queue size"
+        ).set(self.queue_depth)
+        self.cache.register_metrics(reg)
+        events = reg.counter(
+            "serve_requests_total",
+            "Scheduler lifecycle events by kind (submitted, completed, "
+            "cache_hits, coalesced, prefiltered, unrouted, rejected, "
+            "expired, fallbacks, ...)",
+            labels=("event",),
+        )
+        flush = reg.counter(
+            "serve_flush_total",
+            "Micro-batch flushes by reason (full / deadline / drain)",
+            labels=("reason",),
+        )
+        bucket = reg.counter(
+            "serve_bucket_flush_total",
+            "Device flushes by padded bucket shape",
+            labels=("bucket",),
+        )
+        disp_n = reg.counter(
+            "device_dispatch_total",
+            "Device dispatches split compile (first dispatch of a "
+            "shape, jit compile included) vs execute (steady state)",
+            labels=("phase",),
+        )
+        disp_s = reg.counter(
+            "device_dispatch_seconds_total",
+            "Seconds in device dispatch by phase (compile vs execute)",
+            labels=("phase",),
+        )
+        traces = reg.counter(
+            "trace_events_total",
+            "Tracer retention events (started / retained / slow)",
+            labels=("event",),
+        )
+        NativeProfileSource(reg)
+
+        def collect(_reg) -> None:
+            with self._lock:
+                counters = dict(self._counters)
+                flush_now = dict(self._flush_reasons)
+                buckets_now = dict(self._bucket_counts)
+            for k, v in counters.items():
+                events.labels(event=k).sync(v)
+            for k, v in flush_now.items():
+                flush.labels(reason=k).sync(v)
+            for b, v in buckets_now.items():
+                bucket.labels(bucket=b).sync(v)
+            dstats = getattr(self.classifier, "dispatch_stats", None)
+            if callable(dstats):
+                d = dstats()
+                disp_n.labels(phase="compile").sync(d["compiles"])
+                disp_n.labels(phase="execute").sync(d["dispatches"])
+                disp_s.labels(phase="compile").sync(d["compile_s"])
+                disp_s.labels(phase="execute").sync(d["dispatch_s"])
+            t = self.obs.tracer.stats()
+            for k in ("started", "retained", "slow"):
+                traces.labels(event=k).sync(t[k])
+
+        reg.add_collector(collect)
 
     def _resolve_buckets(self, buckets) -> tuple[int, ...]:
         if buckets is None:
@@ -310,6 +423,11 @@ class MicroBatcher:
             request_id=request_id,
             created=t0,
         )
+        # trace minted at admission: its ID follows the request through
+        # every span below and is echoed on the response row
+        trace = self.obs.tracer.start(request_id)
+        if trace is not None:
+            req.trace = trace
         ms = self.deadline_ms if deadline_ms is None else deadline_ms
         if ms and ms > 0:
             req.deadline = t0 + ms / 1000.0
@@ -320,14 +438,19 @@ class MicroBatcher:
             # without reading a byte, same as the offline path
             with self._lock:
                 self._counters["unrouted"] += 1
-            return self._finish_local(req, UNROUTED, t0)
+            return self._finish_local(req, UNROUTED, t0, "unrouted")
         key = content_key(route, filename, raw)
+        t_probe = time.perf_counter()
         cached = self.cache.get(key)
+        dt_probe = time.perf_counter() - t_probe
+        self.stats_stages.record("cache_probe", dt_probe)
+        if trace is not None:
+            trace.add_span("cache_probe", dt_probe, t0=t_probe)
         if cached is not None:
             with self._lock:
                 self._counters["cache_hits"] += 1
             req.cached = True
-            return self._finish_local(req, cached, t0)
+            return self._finish_local(req, cached, t0, "cache_hit")
         req.cache_key = key
         # early coalesce: a duplicate of a QUEUED request skips even
         # featurization — it inherits the primary's verdict at flush
@@ -337,11 +460,15 @@ class MicroBatcher:
                 primary.followers.append(req)
                 self._counters["coalesced"] += 1
                 return req
+        t_feat = time.perf_counter()
         prepared = featurize_request(
             self.classifier, raw, filename,
             route if self.mode == "auto" else None,
         )
-        self.stats_stages.record("featurize", time.perf_counter() - t0)
+        dt_feat = time.perf_counter() - t_feat
+        self.stats_stages.record("featurize", dt_feat)
+        if trace is not None:
+            trace.add_span("featurize", dt_feat, t0=t_feat)
         req.prepared = prepared
         host_result = prepared.results[0]
         if host_result is not None:
@@ -352,7 +479,7 @@ class MicroBatcher:
                 self.cache.put(key, host_result)
             with self._lock:
                 self._counters["prefiltered"] += 1
-            return self._finish_local(req, host_result, t0)
+            return self._finish_local(req, host_result, t0, "prefiltered")
         late = None
         with self._cond:
             primary = self._inflight.get(key)
@@ -367,10 +494,14 @@ class MicroBatcher:
             late = self.cache.get(key, record_miss=False)
             if late is None:
                 if self._closed:
+                    self.obs.tracer.finish(trace, "closed")
                     raise BatcherClosedError("batcher is closed")
                 if len(self._queue) >= self.queue_depth:
                     self._counters["rejected"] += 1
-                    raise QueueFullError(self._estimate_retry_after())
+                    self.obs.tracer.finish(trace, "queue_full")
+                    raise QueueFullError(
+                        self._estimate_retry_after(), req.trace_id
+                    )
                 req.enqueued_at = time.perf_counter()
                 self._queue.append(req)
                 self._inflight[key] = req
@@ -379,7 +510,7 @@ class MicroBatcher:
             with self._lock:
                 self._counters["cache_hits"] += 1
             req.cached = True
-            return self._finish_local(req, late, t0)
+            return self._finish_local(req, late, t0, "cache_hit")
         return req
 
     def classify(
@@ -391,11 +522,13 @@ class MicroBatcher:
         """Blocking convenience: submit + wait."""
         return self.submit(content, filename).wait(timeout)
 
-    def _finish_local(self, req, result, t0) -> ServeRequest:
+    def _finish_local(self, req, result, t0, status: str = "ok") -> ServeRequest:
         req.result = result
         with self._lock:
             self._counters["completed"] += 1
         self.stats_stages.record("total", time.perf_counter() - t0)
+        if req.trace is not None:
+            self.obs.tracer.finish(req.trace, status)
         req.done.set()
         return req
 
@@ -454,9 +587,11 @@ class MicroBatcher:
         # longer (or no) deadline must not inherit its twin's expiry
         live: list[ServeRequest] = []
         for req in batch:
-            self.stats_stages.record(
-                "queue_wait", t0 - (req.enqueued_at or req.created)
-            )
+            enq = req.enqueued_at or req.created
+            wait = t0 - enq
+            self.stats_stages.record("queue_wait", wait)
+            if req.trace is not None:
+                req.trace.add_span("queue_wait", wait, t0=enq)
             with self._lock:
                 alive = unexpired(req) or any(
                     unexpired(f) for f in req.followers
@@ -468,6 +603,7 @@ class MicroBatcher:
             n = sum(len(p.todo) for p in group)
             bucket = self.bucket_for(n)
             clf = self.classifier
+            device_err = None
             try:
                 merged = clf.merge_prepared(group)
                 outs = clf.dispatch_chunks(merged, pad_to=bucket)
@@ -475,11 +611,31 @@ class MicroBatcher:
                 clf.scatter_merged(group, merged)
                 for req in live:
                     req.result = req.prepared.results[0]
-            except Exception:  # noqa: BLE001 — device failure containment
+            except Exception as exc:  # noqa: BLE001 — device failure containment
+                device_err = exc
                 with self._lock:
                     self._counters["fallbacks"] += len(live)
+            dt_device = time.perf_counter() - t0
+            for req in live:
+                if req.trace is not None:
+                    # the batch's device attempt, shared by every rider
+                    req.trace.add_span(
+                        "device", dt_device, t0=t0,
+                        note=(
+                            f"error: {device_err}" if device_err is not None
+                            else f"bucket={bucket} rows={n}"
+                        ),
+                    )
+            if device_err is not None:
                 for req in live:
+                    t_fb = time.perf_counter()
                     req.result = self._scalar_fallback(req)
+                    if req.trace is not None:
+                        req.trace.add_span(
+                            "fallback",
+                            time.perf_counter() - t_fb,
+                            t0=t_fb,
+                        )
             dt = time.perf_counter() - t0
             self.stats_stages.record("device", dt)
             with self._lock:
@@ -521,13 +677,17 @@ class MicroBatcher:
                     # deduplicated answers, like cache hits
                     member.result = scored
                     member.cached = member is not req
+                    status = "coalesced" if member is not req else "ok"
                 else:
                     member.result = BlobResult(
                         None, None, 0.0, error="deadline_exceeded"
                     )
+                    status = "deadline_exceeded"
                     with self._lock:
                         self._counters["expired"] += 1
                 self.stats_stages.record("total", done_t - member.created)
+                if member.trace is not None:
+                    self.obs.tracer.finish(member.trace, status)
                 member.done.set()
 
     def _scalar_fallback(self, req: ServeRequest) -> BlobResult:
@@ -570,11 +730,15 @@ class MicroBatcher:
         with self._lock:
             counters = dict(self._counters)
             counters["queue_depth_now"] = len(self._queue)
+            counters["queue_depth"] = counters["queue_depth_now"]
+            counters["in_flight"] = len(self._inflight)
             flush = dict(self._flush_reasons)
             bucket_counts = {
                 str(k): v for k, v in sorted(self._bucket_counts.items())
             }
+        dispatch = getattr(self.classifier, "dispatch_stats", None)
         return {
+            "uptime_s": self.obs.uptime_s(),
             "scheduler": {
                 **counters,
                 "flush": flush,
@@ -582,6 +746,8 @@ class MicroBatcher:
             },
             "cache": self.cache.stats(),
             "latency_ms": self.stats_stages.snapshot(),
+            "device": dispatch() if callable(dispatch) else None,
+            "tracing": self.obs.tracer.stats(),
             "config": {
                 "mode": self.mode,
                 "max_batch": self.max_batch,
@@ -591,5 +757,21 @@ class MicroBatcher:
                 "deadline_ms": self.deadline_ms,
                 "buckets": list(self.buckets),
                 "threshold": self.threshold,
+                "trace_sample": self.obs.tracer.sample_rate,
+                "trace_slow_ms": (
+                    self.obs.tracer.slow_ms
+                    if self.obs.tracer.slow_ms != float("inf")
+                    else None
+                ),
             },
         }
+
+    def prometheus(self) -> str:
+        """The Prometheus text exposition for this batcher's registry
+        (the `stats` verb's ``format: "prometheus"`` answer)."""
+        return self.obs.prometheus()
+
+    def trace_tail(self, n: int = 20) -> list[dict]:
+        """The most recent retained traces (sampled heads + slow
+        exemplars), oldest first — the `trace` verb's answer."""
+        return self.obs.tracer.tail(n)

@@ -12,10 +12,19 @@ Request lines:
   {"content": "...", "id": ..., "filename": ..., "deadline_ms": ...}
   {"content_b64": "...", ...}        # raw bytes, base64
   {"op": "stats", "id": ...}         # dump scheduler/cache/latency JSON
+  {"op": "stats", "format": "prometheus", "id": ...}  # text exposition
+  {"op": "trace", "n": 20, "id": ...}  # recent retained traces
 Response lines:
-  {"id": ..., "key": ..., "matcher": ..., "confidence": ..., "cached": ...}
-  {"id": ..., "error": "queue_full", "retry_after": 1.25}   # backpressure
-  {"id": ..., "stats": {...}}
+  {"id": ..., "key": ..., "matcher": ..., "confidence": ...,
+   "cached": ..., "trace": "16-hex trace id"}
+  {"id": ..., "error": "queue_full", "retry_after": 1.25,
+   "trace": ...}                     # backpressure
+  {"id": ..., "stats": {...}} / {"id": ..., "prometheus": "..."} /
+  {"id": ..., "traces": [...]}
+
+Every classification (and backpressure) row echoes the trace ID minted
+for its request at admission — the handle that joins a client-side log
+line to the server-side exemplar trace (obs/tracing.py).
 
 The same session loop runs over stdio (``licensee-tpu serve``) and over
 a Unix domain socket (``--socket PATH``, one session per connection) —
@@ -38,6 +47,8 @@ def _render_result(req) -> dict:
     if req.result.error:
         row["error"] = req.result.error
     row["cached"] = req.cached
+    if req.trace_id is not None:
+        row["trace"] = req.trace_id
     return row
 
 
@@ -78,7 +89,14 @@ class _Session:
                 # snapshot at WRITE time, not parse time: every earlier
                 # request in the stream has answered by now, so the verb
                 # reports "stats as of this point in the session"
-                row = {"id": payload, "stats": self.batcher.stats()}
+                rid, fmt = payload
+                if fmt == "prometheus":
+                    row = {"id": rid, "prometheus": self.batcher.prometheus()}
+                else:
+                    row = {"id": rid, "stats": self.batcher.stats()}
+            elif kind == "trace":
+                rid, n = payload
+                row = {"id": rid, "traces": self.batcher.trace_tail(n)}
             else:
                 row = payload
             try:
@@ -104,7 +122,26 @@ class _Session:
         rid = msg.get("id")
         op = msg.get("op")
         if op == "stats":
-            self._emit("stats", rid)
+            fmt = msg.get("format")
+            if fmt not in (None, "json", "prometheus"):
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": f"bad_request: unknown stats format {fmt!r}"},
+                )
+                return
+            self._emit("stats", (rid, fmt))
+            return
+        if op == "trace":
+            n = msg.get("n", 20)
+            if isinstance(n, bool) or not isinstance(n, int) or n < 0:
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: n must be a non-negative int"},
+                )
+                return
+            self._emit("trace", (rid, n))
             return
         if op is not None:
             self._emit(
@@ -163,14 +200,14 @@ class _Session:
                 deadline_ms=deadline_ms,
             )
         except QueueFullError as exc:
-            self._emit(
-                "raw",
-                {
-                    "id": rid,
-                    "error": "queue_full",
-                    "retry_after": exc.retry_after,
-                },
-            )
+            row = {
+                "id": rid,
+                "error": "queue_full",
+                "retry_after": exc.retry_after,
+            }
+            if exc.trace_id is not None:
+                row["trace"] = exc.trace_id
+            self._emit("raw", row)
             return
         except Exception as exc:  # noqa: BLE001 — session containment
             # a week-long worker answers an error row and keeps serving;
@@ -260,13 +297,18 @@ def selftest(verbose: bool = True) -> int:
     """End-to-end smoke of the whole serving stack on this host's
     devices (CPU-safe): exact prefilter, a Dice-scored micro-batch
     (deadline flush — the session is 3 requests, far under max_batch),
-    a content-hash cache hit, and the stats verb, all through the real
-    JSONL session loop.  Returns 0 on success — the CI gate and the
-    `licensee-tpu serve --selftest` command."""
+    a content-hash cache hit, the stats verb, the Prometheus exposition
+    (every line must match the text-format grammar), trace propagation
+    (every classification row echoes its request's trace ID), and a
+    slow-request exemplar carrying all five spans (cache_probe /
+    featurize / queue_wait / device / fallback — exercised by a forced
+    device failure with the slow threshold at 0).  Returns 0 on success
+    — the CI gate and the `licensee-tpu serve --selftest` command."""
     import io
     import re
 
     from licensee_tpu.corpus.license import License
+    from licensee_tpu.obs import check_exposition
 
     body = re.sub(
         r"\[(\w+)\]", "example", License.find("mit").content or ""
@@ -277,15 +319,43 @@ def selftest(verbose: bool = True) -> int:
         json.dumps({"id": 2, "content": variant, "filename": "LICENSE"}),
         json.dumps({"id": 3, "content": variant, "filename": "LICENSE"}),
         json.dumps({"id": 4, "op": "stats"}),
+        json.dumps({"id": 5, "op": "stats", "format": "prometheus"}),
+        json.dumps({"id": 6, "op": "trace"}),
     ]
     out = io.StringIO()
-    with MicroBatcher(max_batch=64, max_delay_ms=10.0) as batcher:
+    problems = []
+    with MicroBatcher(
+        max_batch=64, max_delay_ms=10.0, trace_sample=1.0,
+        trace_slow_ms=0.0,
+    ) as batcher:
         counts = serve_session(
             batcher, session_lines, lambda line: out.write(line + "\n")
         )
+        # -- the degradation exemplar: a forced device failure routes the
+        # request through the scalar fallback, so its trace carries ALL
+        # FIVE span kinds; trace_slow_ms=0 makes it a slow exemplar --
+        original = batcher.classifier.dispatch_chunks
+        batcher.classifier.dispatch_chunks = _raise_injected
+        try:
+            fb = batcher.classify(body + "\nzqfb zqfc\n", "LICENSE")
+        finally:
+            batcher.classifier.dispatch_chunks = original
+        if (fb.key, fb.matcher) != ("mit", "dice"):
+            problems.append(f"fallback verdict: {fb.as_dict()}")
+        exemplar = None
+        for t in batcher.trace_tail(50):
+            names = {s["name"] for s in t.get("spans", ())}
+            if {"cache_probe", "featurize", "queue_wait", "device",
+                "fallback"} <= names:
+                exemplar = t
+                break
+        if exemplar is None:
+            problems.append(
+                "no slow-request exemplar with all five spans in "
+                f"{batcher.trace_tail(50)}"
+            )
     rows = [json.loads(line) for line in out.getvalue().splitlines()]
-    problems = []
-    if counts != {"requests": 4, "responses": 4}:
+    if counts != {"requests": 6, "responses": 6}:
         problems.append(f"bad session counts: {counts}")
     else:
         by_id = {r["id"]: r for r in rows}
@@ -293,10 +363,20 @@ def selftest(verbose: bool = True) -> int:
             problems.append(f"exact prefilter: {by_id[1]}")
         if (by_id[2].get("key"), by_id[2].get("matcher")) != ("mit", "dice"):
             problems.append(f"dice micro-batch: {by_id[2]}")
-        if by_id[2] != {**by_id[3], "id": 2, "cached": False}:
+        cached_row = {
+            k: v for k, v in by_id[3].items() if k != "trace"
+        }
+        want = {
+            k: v for k, v in by_id[2].items() if k != "trace"
+        }
+        if want != {**cached_row, "id": 2, "cached": False}:
             problems.append(f"cache hit disagrees: {by_id[3]} vs {by_id[2]}")
         if not by_id[3].get("cached"):
             problems.append(f"duplicate not cached: {by_id[3]}")
+        # every classification row carries its own trace id
+        trace_ids = [by_id[i].get("trace") for i in (1, 2, 3)]
+        if not all(trace_ids) or len(set(trace_ids)) != 3:
+            problems.append(f"trace ids missing/shared: {trace_ids}")
         stats = by_id[4].get("stats") or {}
         sched = stats.get("scheduler") or {}
         if sched.get("device_batches") != 1 or sched.get("device_rows") != 1:
@@ -308,6 +388,19 @@ def selftest(verbose: bool = True) -> int:
         deduped = sched.get("cache_hits", 0) + sched.get("coalesced", 0)
         if deduped != 1:
             problems.append(f"duplicate not deduplicated: {sched}")
+        for gauge in ("queue_depth", "in_flight"):
+            if sched.get(gauge) != 0:
+                problems.append(f"{gauge} gauge: {sched.get(gauge)!r}")
+        if not isinstance(stats.get("uptime_s"), (int, float)):
+            problems.append(f"uptime_s missing: {stats.get('uptime_s')!r}")
+        exposition = by_id[5].get("prometheus") or ""
+        grammar = check_exposition(exposition)
+        if not exposition or grammar:
+            problems.append(f"prometheus exposition: {grammar[:3]}")
+        if "serve_stage_seconds_bucket" not in exposition:
+            problems.append("exposition missing serve_stage_seconds")
+        if not by_id[6].get("traces"):
+            problems.append("trace verb returned no traces")
     if verbose:
         summary = {
             "selftest": "ok" if not problems else "FAIL",
@@ -316,3 +409,7 @@ def selftest(verbose: bool = True) -> int:
         }
         print(json.dumps(summary))
     return 0 if not problems else 1
+
+
+def _raise_injected(*args, **kwargs):
+    raise RuntimeError("selftest: injected device failure")

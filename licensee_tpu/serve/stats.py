@@ -37,6 +37,10 @@ class LatencyStats:
             if len(self._ring) < self.capacity:
                 self._ring.append(seconds)
             else:
+                # overwrite the OLDEST sample: during the append phase
+                # _idx stayed 0 (the oldest), and each overwrite
+                # advances it — so a wrapped ring is always the most
+                # recent `capacity` samples, capacity=1 included
                 self._ring[self._idx] = seconds
                 self._idx = (self._idx + 1) % self.capacity
 
@@ -51,8 +55,13 @@ class LatencyStats:
         percentiles over the recent reservoir window, the count/mean
         over the process lifetime."""
         with self._lock:
-            window = sorted(self._ring)
+            # COPY under the lock, sort outside it: an O(n log n) sort
+            # of a 4096-ring inside the lock would stall every
+            # concurrent record() on the serving hot path for the
+            # duration of a stats scrape
+            window = list(self._ring)
             count, total = self._count, self._total
+        window.sort()
         if not window:
             return {
                 "count": 0, "mean_ms": None, "p50_ms": None,
@@ -74,13 +83,23 @@ class LatencyStats:
 
 class StageStats:
     """A named family of LatencyStats — one per pipeline stage — that
-    snapshots into a single JSON-ready dict."""
+    snapshots into a single JSON-ready dict.
 
-    def __init__(self, stages: tuple[str, ...], capacity: int = 4096):
+    ``observer(stage, seconds)``, when given, is called on every record
+    — the obs registry tees each sample into its fixed-bound histograms
+    without a second timing site (one reservoir, one histogram, one
+    clock read)."""
+
+    def __init__(
+        self, stages: tuple[str, ...], capacity: int = 4096, observer=None
+    ):
         self._stages = {s: LatencyStats(capacity) for s in stages}
+        self._observer = observer
 
     def record(self, stage: str, seconds: float) -> None:
         self._stages[stage].record(seconds)
+        if self._observer is not None:
+            self._observer(stage, seconds)
 
     def snapshot(self) -> dict:
         return {s: ls.snapshot() for s, ls in self._stages.items()}

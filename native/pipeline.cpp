@@ -1472,6 +1472,31 @@ char *pipe_profile_dump(size_t *out_len) {
   return buf;
 }
 
+// Zero every counter pipe_profile_dump reports — the always-on stage.*/
+// count.* atomics AND the LICENSEE_TPU_PIPE_PROFILE per-pass table — so
+// a scraper (or bench) can measure per-interval deltas from a
+// long-running process.  The atomic stores race benignly with in-flight
+// featurize calls (a reset during live traffic may keep a few racing
+// increments, matching the dump side's relaxed loads).  The per-pass
+// std::map clear is NOT concurrency-safe against PassTimer inserts —
+// it inherits PassProf's existing contract ("profiling runs are
+// single-threaded by design"): only touch it when profiling is
+// enabled, i.e. in a single-threaded run, where dump already iterates
+// the same unsynchronized map.
+void pipe_profile_reset(void) {
+  StageStats &st = stage_stats();
+  st.blobs.store(0, std::memory_order_relaxed);
+  st.bytes_in.store(0, std::memory_order_relaxed);
+  st.tokens.store(0, std::memory_order_relaxed);
+  st.uniques.store(0, std::memory_order_relaxed);
+  st.oov.store(0, std::memory_order_relaxed);
+  st.nonascii.store(0, std::memory_order_relaxed);
+  st.normalize_ns.store(0, std::memory_order_relaxed);
+  st.wordset_ns.store(0, std::memory_order_relaxed);
+  st.pack_ns.store(0, std::memory_order_relaxed);
+  if (PassProf::enabled()) PassProf::table().clear();
+}
+
 // Hash a '\0'-joined unique-token blob (Python-side template wordsets, any
 // order) with the same multiset hash pipe_featurize computes.
 void pipe_exact_hash(const char *blob, size_t len, uint8_t *hash_out) {

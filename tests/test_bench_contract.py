@@ -71,6 +71,28 @@ def _fat_details() -> dict:
                               (8, 32, 128, 256)},
             "p50_ms": 99999.999,
             "p99_ms": 99999.999,
+            "obs": {
+                "prometheus_lines": 99_999_999,
+                "prometheus_grammar_errors": 99_999_999,
+                "metric_families": 99_999_999,
+                "tracing": {
+                    "started": 99_999_999,
+                    "retained": 99_999_999,
+                    "slow": 99_999_999,
+                    "ring": 99_999_999,
+                    "sample_rate": 0.999999,
+                    "slow_ms": 99999.999,
+                    "log_path": "y" * 120,
+                },
+                "device_dispatch": {
+                    "compiles": 99_999_999,
+                    "compile_s": 99999.999,
+                    "dispatches": 99_999_999,
+                    "dispatch_s": 99999.999,
+                    "shapes": [8, 32, 128, 256],
+                },
+                "uptime_s": 99999.999,
+            },
         },
         "host_model": {
             "z" * 30: 9.9,
@@ -129,6 +151,8 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["at_scale_auto"]["files_per_sec"] == 8_748_728.9
     assert d["e2e_files_per_sec"]["readme"] == 8_748_728.9
     assert d["serve_path"]["cached_rps"] == 99_999_999.9
+    assert d["obs"]["prom_lines"] == 99_999_999
+    assert d["obs"]["traces"] == 99_999_999
     assert d["host_model"]["featurize_us_per_blob"] == 99_999_999.9
     assert (
         d["host_model"]["amdahl_ceiling_files_per_sec"] == 99_999_999.9

@@ -164,6 +164,80 @@ def test_serve_stdin_jsonl_session(capsys, monkeypatch):
     assert sched["device_rows"] == 1  # the duplicate deduplicated
 
 
+def test_stats_selftest(capsys):
+    """`licensee-tpu stats --selftest` — the obs-layer CI smoke —
+    passes in-process (registry, exposition grammar, tracer retention,
+    profile deltas)."""
+    rc = main(["stats", "--selftest"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert json.loads(err.splitlines()[-1])["obs_selftest"] == "ok"
+
+
+def test_stats_requires_socket_or_selftest(capsys):
+    rc = main(["stats"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "--socket" in err
+
+
+def test_stats_scrapes_a_running_server(tmp_path, capsys):
+    """The exporter client end-to-end: `licensee-tpu stats --socket`
+    scrapes JSON, Prometheus exposition, and the trace tail from a live
+    serve worker over its Unix socket."""
+    import threading
+
+    from licensee_tpu.obs import check_exposition
+    from licensee_tpu.serve.scheduler import MicroBatcher
+    from licensee_tpu.serve.server import UnixServer
+
+    path = str(tmp_path / "serve.sock")
+    with MicroBatcher(
+        max_delay_ms=5.0, buckets=(4,), mesh=None, trace_sample=1.0
+    ) as batcher:
+        batcher.classify(
+            fixture_contents("mit/LICENSE.txt") + "\nzqstats\n", "LICENSE"
+        )
+        server = UnixServer(path, batcher)
+        thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        thread.start()
+        try:
+            rc, out = run_cli(["stats", "--socket", path], capsys)
+            assert rc == 0
+            snap = json.loads(out)
+            assert snap["scheduler"]["completed"] == 1
+            assert snap["uptime_s"] >= 0
+
+            rc, out = run_cli(
+                ["stats", "--socket", path, "--format", "prometheus"],
+                capsys,
+            )
+            assert rc == 0
+            assert check_exposition(out) == []
+            assert 'serve_requests_total{event="submitted"} 1' in out
+
+            rc, out = run_cli(
+                ["stats", "--socket", path, "--trace", "5"], capsys
+            )
+            assert rc == 0
+            traces = [json.loads(line) for line in out.splitlines()]
+            assert traces and all("trace" in t for t in traces)
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+
+
+def test_stats_socket_error_is_reported(tmp_path, capsys):
+    rc = main(["stats", "--socket", str(tmp_path / "absent.sock")])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "cannot scrape" in err
+
+
 def test_batch_detect_output_preflight(tmp_path, capsys):
     """The --output preflight names the actual problem: a missing parent
     directory vs an existing path component that is not a directory."""

@@ -1,0 +1,390 @@
+"""The unified observability layer (licensee_tpu/obs/): metrics
+registry math, Prometheus exposition grammar, tracer retention (head
+sampling + slow exemplars + bounded JSONL log), the native profile
+delta scrape (no double-count across scrapes), profile_reset parity,
+the device compile-vs-execute split, and the offline BatchProject
+per-chunk traces.  All CPU-only and fast."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from licensee_tpu.obs import (
+    MetricsRegistry,
+    NativeProfileSource,
+    Observability,
+    Tracer,
+    check_exposition,
+    render_prometheus,
+)
+
+# -- registry --
+
+
+def test_counter_gauge_histogram_math():
+    reg = MetricsRegistry()
+    c = reg.counter("events_total", "events", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(4)
+    assert c.labels(kind="a").value == 5
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)  # counters are monotonic
+    g = reg.gauge("depth")
+    g.set(3)
+    assert g.value == 3
+    g.set_fn(lambda: 11)
+    assert g.value == 11
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.05, 5.0):
+        h.observe(v)
+    hv = h.value
+    # buckets are CUMULATIVE (le semantics): 0.01 holds both <=0.01
+    # observations, +Inf holds everything
+    assert hv["buckets"]["0.01"] == 2
+    assert hv["buckets"]["0.1"] == 3
+    assert hv["buckets"]["1.0"] == 3
+    assert hv["buckets"]["+Inf"] == 4
+    assert hv["count"] == 4
+    assert hv["sum"] == pytest.approx(5.065)
+
+
+def test_registry_registration_is_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", labels=("k",))
+    assert reg.counter("x_total", labels=("k",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("x_total")  # label mismatch
+    with pytest.raises(ValueError):
+        reg.counter("bad name")  # exposition-illegal name
+    with pytest.raises(ValueError):
+        a.labels(other="v")  # undeclared label
+
+
+def test_counter_sync_never_goes_backwards():
+    reg = MetricsRegistry()
+    c = reg.counter("ext_total")
+    c.sync(10)
+    c.sync(7)  # a restarted source must not rewind the series
+    assert c.value == 10
+
+
+def test_snapshot_runs_collectors():
+    reg = MetricsRegistry()
+    c = reg.counter("pulled_total")
+    state = {"n": 0}
+    reg.add_collector(lambda r: c.sync(state["n"]))
+    state["n"] = 5
+    snap = reg.snapshot()
+    assert snap["pulled_total"]["samples"][0]["value"] == 5
+
+
+# -- exposition --
+
+
+def test_prometheus_exposition_grammar_and_content():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests by kind", labels=("kind",))
+    c.labels(kind="cache_hit").inc(3)
+    reg.gauge("queue_depth", "now").set(7)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.01, 1.0))
+    h.observe(0.005)
+    text = render_prometheus(reg)
+    assert check_exposition(text) == []
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{kind="cache_hit"} 3' in text
+    assert "queue_depth 7" in text
+    assert 'lat_seconds_bucket{le="0.01"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "lat_seconds_sum 0.005" in text
+    assert "lat_seconds_count 1" in text
+
+
+def test_exposition_escapes_label_values():
+    reg = MetricsRegistry()
+    c = reg.counter("weird_total", labels=("path",))
+    c.labels(path='a"b\\c\nd').inc()
+    text = render_prometheus(reg)
+    assert check_exposition(text) == []
+    assert r'path="a\"b\\c\nd"' in text
+
+
+def test_check_exposition_flags_garbage():
+    assert check_exposition("not a metric line !!!\n")
+    assert check_exposition("name{unclosed 1\n")
+    assert check_exposition("") == []
+
+
+# -- tracer --
+
+
+def test_head_sampling_is_deterministic_stride():
+    tracer = Tracer(sample_rate=0.25, slow_ms=10_000.0, capacity=64)
+    kept = sum(
+        tracer.finish(tracer.start(request_id=i)) for i in range(16)
+    )
+    assert kept == 4  # every 4th
+
+
+def test_slow_exemplar_always_captured(tmp_path):
+    log = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(
+        sample_rate=0.0, slow_ms=20.0, capacity=8, log_path=log
+    )
+    fast = tracer.start(request_id="fast")
+    assert tracer.finish(fast) is False  # unsampled and fast: dropped
+    slow = tracer.start(request_id="slow")
+    slow.add_span("featurize", 0.001)
+    slow.add_span("device", 0.02)
+    time.sleep(0.025)
+    assert tracer.finish(slow) is True  # sampling off, kept anyway
+    tail = tracer.tail(10)
+    assert [t["id"] for t in tail] == ["slow"]
+    assert [s["name"] for s in tail[0]["spans"]] == ["featurize", "device"]
+    assert tail[0]["dur_ms"] >= 20.0
+    with open(log, encoding="utf-8") as f:
+        logged = [json.loads(line) for line in f]
+    assert len(logged) == 1 and logged[0]["slow"] is True
+    assert logged[0]["trace"] == tail[0]["trace"]
+
+
+def test_trace_log_is_bounded_by_rotation(tmp_path):
+    import os
+
+    log = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(
+        sample_rate=0.0, slow_ms=0.0, capacity=4, log_path=log,
+        log_max_bytes=2048,
+    )
+    for i in range(100):
+        tracer.finish(tracer.start(request_id=f"r{i}"))
+    assert os.path.getsize(log) <= 2048
+    assert os.path.getsize(log + ".1") <= 2048  # single rotation, ~2x cap
+
+
+def test_trace_ids_unique_and_ring_bounded():
+    tracer = Tracer(sample_rate=1.0, slow_ms=10_000.0, capacity=4)
+    ids = set()
+    for i in range(10):
+        t = tracer.start(request_id=i)
+        ids.add(t.trace_id)
+        tracer.finish(t)
+    assert len(ids) == 10
+    assert all(len(i) == 16 for i in ids)
+    tail = tracer.tail(100)
+    assert len(tail) == 4  # ring keeps the most recent `capacity`
+    assert [t["id"] for t in tail] == [6, 7, 8, 9]
+
+
+# -- native profile deltas --
+
+
+def test_profile_source_does_not_double_count_across_scrapes():
+    cumulative = {"stage.normalize_s": 2.0, "count.blobs": 8.0}
+    reg = MetricsRegistry()
+    NativeProfileSource(reg, dump_fn=lambda: dict(cumulative))
+    reg.snapshot()
+    reg.snapshot()  # the regression: a second scrape with no new work
+    blobs = reg.counter(
+        "native_featurize_events_total", labels=("kind",)
+    ).labels(kind="blobs")
+    secs = reg.counter(
+        "native_featurize_stage_seconds_total", labels=("stage",)
+    ).labels(stage="normalize")
+    assert blobs.value == 8.0
+    assert secs.value == 2.0
+    cumulative["count.blobs"] = 11.0
+    reg.snapshot()
+    assert blobs.value == 11.0
+    # an external profile_reset rewinds the cumulative source: the
+    # delta clamps at zero instead of going negative
+    cumulative["count.blobs"] = 1.0
+    reg.snapshot()
+    assert blobs.value == 11.0
+    cumulative["count.blobs"] = 3.0
+    reg.snapshot()
+    assert blobs.value == 13.0  # counts resume from the new baseline
+
+
+def test_profile_source_is_once_per_registry():
+    """Several attachments to ONE registry (e.g. MicroBatchers sharing
+    the process-wide registry) must not multiply the deltas: the
+    cumulative surface is process-wide, so only one collector scrapes
+    it."""
+    cumulative = {"count.blobs": 5.0}
+    reg = MetricsRegistry()
+    NativeProfileSource(reg, dump_fn=lambda: dict(cumulative))
+    NativeProfileSource(reg, dump_fn=lambda: dict(cumulative))
+    reg.snapshot()
+    blobs = reg.counter(
+        "native_featurize_events_total", labels=("kind",)
+    ).labels(kind="blobs")
+    assert blobs.value == 5.0  # not 10: one collector, one baseline
+
+
+def test_histogram_bucket_mismatch_is_rejected():
+    """Re-registering a histogram with different bounds must be a hard
+    error — silently reusing the first family would drop the second
+    caller's observations into the wrong bins."""
+    reg = MetricsRegistry()
+    reg.histogram("h_seconds", buckets=(1.0, 10.0))
+    with pytest.raises(ValueError):
+        reg.histogram("h_seconds", buckets=(0.001, 0.01))
+    assert reg.histogram("h_seconds", buckets=(1.0, 10.0)) is not None
+
+
+def test_module_profile_dump_reset_fallback_parity():
+    """The module-level pair works without the native library: the
+    pure-Python accumulator reports under the same keys and resets."""
+    from licensee_tpu.native import pipeline
+
+    pipeline.profile_reset()
+    before = pipeline.profile_dump()
+    pipeline.py_profile_add(**{
+        "count.blobs": 2, "stage.normalize_s": 0.25,
+    })
+    after = pipeline.profile_dump()
+    assert after.get("count.blobs", 0) - before.get("count.blobs", 0) == 2
+    assert (
+        after.get("stage.normalize_s", 0.0)
+        - before.get("stage.normalize_s", 0.0)
+    ) == pytest.approx(0.25)
+    assert pipeline.profile_reset() is True
+    cleared = pipeline.profile_dump()
+    assert cleared.get("count.blobs", 0.0) == 0.0
+
+
+def test_native_profile_reset_zeroes_stage_counters():
+    from licensee_tpu.native import pipeline
+
+    nat = pipeline.load()
+    if nat is None:
+        pytest.skip("native pipeline unavailable")
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    clf = BatchClassifier(pad_batch_to=8, mesh=None, device=False)
+    clf.prepare_batch([b"mit license words alpha beta"])
+    assert nat.profile_dump().get("count.blobs", 0) >= 1
+    assert nat.profile_reset() is True
+    assert nat.profile_dump().get("count.blobs") == 0.0
+
+
+def test_two_scrapes_after_work_count_each_blob_once():
+    """End-to-end double-count regression over the REAL profile
+    surface: scrape, do one blob of work, scrape twice — the counter
+    moves by exactly that one blob."""
+    from licensee_tpu.kernels.batch import BatchClassifier
+    from licensee_tpu.native import pipeline
+
+    reg = MetricsRegistry()
+    NativeProfileSource(reg, dump_fn=pipeline.profile_dump)
+    reg.snapshot()  # baseline absorbs all prior work in this process
+    blobs = reg.counter(
+        "native_featurize_events_total", labels=("kind",)
+    ).labels(kind="blobs")
+    base = blobs.value
+    clf = BatchClassifier(pad_batch_to=8, mesh=None, device=False)
+    clf.prepare_batch([b"one more blob of words to featurize"])
+    reg.snapshot()
+    reg.snapshot()
+    assert blobs.value == base + 1
+
+
+# -- Observability bundle --
+
+
+def test_bundle_snapshot_shape_and_uptime():
+    obs = Observability(tracing=True, trace_sample=1.0)
+    t = obs.tracer.start(request_id="x")
+    obs.tracer.finish(t)
+    snap = obs.snapshot()
+    assert snap["uptime_s"] >= 0
+    assert "process_uptime_seconds" in snap["metrics"]
+    assert snap["tracing"]["started"] == 1
+    assert check_exposition(obs.prometheus()) == []
+
+
+def test_bundle_tracing_disabled_is_null_tracer():
+    obs = Observability(tracing=False)
+    assert obs.tracer.start("x") is None
+    assert obs.tracer.tail() == []
+    assert obs.tracer.finish(None) is False
+
+
+# -- device compile-vs-execute split --
+
+
+def test_dispatch_stats_split_compile_then_execute():
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    clf = BatchClassifier(pad_batch_to=4, mesh=None)
+    blob = b"Permission is hereby granted free of charge zqx zqy"
+    clf.classify_blobs([blob + b" one"])
+    d1 = clf.dispatch_stats()
+    clf.classify_blobs([blob + b" two"])
+    d2 = clf.dispatch_stats()
+    # same padded shape: first dispatch was the compile, the second a
+    # steady-state execute
+    assert d1["compiles"] == 1 and d1["dispatches"] == 0
+    assert d2["compiles"] == 1 and d2["dispatches"] == 1
+    assert d2["shapes"] == [4]
+    assert d2["compile_s"] > 0 and d2["dispatch_s"] > 0
+
+
+# -- offline per-chunk traces --
+
+
+def test_batch_project_run_emits_per_chunk_traces(tmp_path):
+    from licensee_tpu.projects.batch_project import BatchProject
+    from tests.conftest import fixture_contents
+
+    mit = fixture_contents("mit/LICENSE.txt")
+    paths = []
+    for i in range(6):
+        p = tmp_path / f"LICENSE_{i}"
+        p.write_text(mit + f"\nzqchunk{i}\n", encoding="utf-8")
+        paths.append(str(p))
+    tracer = Tracer(sample_rate=1.0, slow_ms=10_000.0, capacity=16)
+    project = BatchProject(
+        paths, batch_size=3, mesh=None, workers=1, tracer=tracer
+    )
+    out = tmp_path / "out.jsonl"
+    project.run(str(out), resume=False)
+    tail = tracer.tail(16)
+    assert len(tail) == 2  # 6 files / batch_size 3
+    assert [t["id"] for t in tail] == ["chunk-1", "chunk-2"]
+    for t in tail:
+        names = [s["name"] for s in t["spans"]]
+        assert names[:2] == ["read", "featurize"]
+        assert "write" in names
+        # these chunks carry Dice-bound rows, so the group device spans
+        # ride along too
+        assert "dispatch" in names and "score" in names
+        # the trace is rebased over the worker-side produce stages:
+        # every span sits at t >= 0 on the chunk's own timeline
+        assert all(s["t_ms"] >= 0 for s in t["spans"])
+        assert t["dur_ms"] >= t["spans"][0]["dur_ms"]
+
+
+def test_tracer_concurrent_finish_is_consistent():
+    tracer = Tracer(sample_rate=1.0, slow_ms=10_000.0, capacity=1024)
+
+    def work(k):
+        for i in range(50):
+            t = tracer.start(request_id=f"{k}-{i}")
+            t.add_span("featurize", 0.0001)
+            tracer.finish(t)
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert tracer.started == 200
+    assert tracer.retained == 200
+    assert len(tracer.tail(1024)) == 200

@@ -352,6 +352,59 @@ def test_latency_stats_percentiles():
     assert empty["count"] == 0 and empty["p99_ms"] is None
 
 
+def test_latency_stats_capacity_one():
+    """capacity=1 is the degenerate ring: every percentile IS the last
+    sample, count/mean stay lifetime."""
+    ls = LatencyStats(capacity=1)
+    ls.record(0.010)
+    ls.record(0.030)
+    snap = ls.snapshot()
+    assert snap["count"] == 2
+    assert snap["p50_ms"] == 30.0
+    assert snap["p99_ms"] == 30.0
+    assert snap["max_ms"] == 30.0
+    assert snap["mean_ms"] == 20.0  # lifetime mean, not window mean
+    with pytest.raises(ValueError):
+        LatencyStats(capacity=0)
+
+
+def test_latency_stats_percentiles_after_ring_wrap():
+    """After the ring wraps, percentiles cover exactly the most recent
+    `capacity` samples — the overwrite must hit the OLDEST slot, so an
+    early outlier ages out."""
+    ls = LatencyStats(capacity=4)
+    ls.record(9.999)  # the outlier that must age out
+    for ms in (1, 2, 3, 4):  # wraps: overwrites the outlier first
+        ls.record(ms / 1000.0)
+    snap = ls.snapshot()
+    assert snap["count"] == 5
+    assert snap["max_ms"] == 4.0  # the outlier left the window
+    assert snap["p50_ms"] == 2.0
+    assert snap["p99_ms"] == 4.0
+
+
+def test_latency_stats_concurrent_records():
+    """N threads hammering record(): lifetime count must equal the sum
+    of per-thread records (no lost updates), and the ring stays exactly
+    `capacity` wide."""
+    ls = LatencyStats(capacity=64)
+    per_thread, n_threads = 500, 8
+
+    def work():
+        for i in range(per_thread):
+            ls.record(0.001 * (i % 10 + 1))
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = ls.snapshot()
+    assert snap["count"] == per_thread * n_threads
+    assert len(ls._ring) == 64
+    assert snap["p50_ms"] is not None and snap["max_ms"] <= 10.0
+
+
 # -- transports --
 
 
@@ -504,3 +557,194 @@ def test_batch_project_reexports_shared_helpers():
     assert batch_project._jsonl_row is featurize.jsonl_row
     assert batch_project._IN_BATCH_DUP is featurize.IN_BATCH_DUP
     assert batch_project._UNROUTED is featurize.UNROUTED
+
+
+# -- observability: trace propagation + the extended stats verb --
+
+
+def test_every_response_row_carries_its_requests_trace_id(clf, mit_body):
+    """A serve JSONL session: every response row echoes the trace ID
+    minted for ITS request — device-scored, exact-prefiltered, and
+    cache-hit rows alike, each with a distinct id."""
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), trace_sample=1.0,
+    ) as b:
+        out: list[str] = []
+        serve_session(
+            b,
+            _session_lines(
+                [
+                    {"id": 1, "content": dice_blob(mit_body, "tp1"),
+                     "filename": "LICENSE"},
+                    {"id": 2, "content": mit_body, "filename": "LICENSE"},
+                    {"id": 3, "content": dice_blob(mit_body, "tp1"),
+                     "filename": "LICENSE"},  # cache hit (or coalesce)
+                ]
+            ),
+            out.append,
+        )
+    rows = [json.loads(line) for line in out]
+    traces = [r.get("trace") for r in rows]
+    assert all(re.fullmatch(r"[0-9a-f]{16}", t) for t in traces)
+    assert len(set(traces)) == 3  # one trace per request, even dupes
+    assert rows[2]["cached"]
+
+
+def test_queue_full_row_carries_trace_id(clf, mit_body):
+    b = MicroBatcher(
+        classifier=clf, queue_depth=1, max_delay_ms=5.0, buckets=(4,),
+        start=False, trace_sample=1.0,
+    )
+    out: list[str] = []
+    session = _Session(b, out.append)
+    session.handle_line(json.dumps(
+        {"id": 1, "content": dice_blob(mit_body, "tq1"),
+         "filename": "LICENSE"}
+    ))
+    session.handle_line(json.dumps(
+        {"id": 2, "content": dice_blob(mit_body, "tq2"),
+         "filename": "LICENSE"}
+    ))
+    b.start()
+    session.finish()
+    b.close()
+    rows = [json.loads(line) for line in out]
+    assert rows[1]["error"] == "queue_full"
+    assert re.fullmatch(r"[0-9a-f]{16}", rows[1]["trace"])
+    assert rows[1]["trace"] != rows[0]["trace"]
+    # the rejected request's trace was retained with queue_full status
+    statuses = {t["status"] for t in b.trace_tail(10)}
+    assert "queue_full" in statuses
+
+
+def test_scalar_fallback_row_carries_trace_with_all_five_spans(
+    clf, mit_body
+):
+    """A device failure routes through the scalar fallback: the
+    response still carries the trace id, and the retained trace holds
+    the full five-span story (cache_probe, featurize, queue_wait,
+    device, fallback)."""
+    blob = dice_blob(mit_body, "tfb")
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,),
+        trace_sample=1.0, trace_slow_ms=0.0,
+    ) as b:
+        original = b.classifier.dispatch_chunks
+
+        def broken(*args, **kwargs):
+            raise RuntimeError("injected device failure")
+
+        b.classifier.dispatch_chunks = broken
+        try:
+            out: list[str] = []
+            serve_session(
+                b,
+                _session_lines(
+                    [{"id": 1, "content": blob, "filename": "LICENSE"}]
+                ),
+                out.append,
+            )
+        finally:
+            b.classifier.dispatch_chunks = original
+        row = json.loads(out[0])
+        assert (row["key"], row["matcher"]) == ("mit", "dice")
+        trace = next(
+            t for t in b.trace_tail(10) if t["trace"] == row["trace"]
+        )
+    names = [s["name"] for s in trace["spans"]]
+    assert names == [
+        "cache_probe", "featurize", "queue_wait", "device", "fallback"
+    ]
+    device_span = trace["spans"][3]
+    assert "error" in device_span.get("note", "")
+
+
+def test_stats_verb_reports_gauges_and_uptime(clf, mit_body):
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        b.classify(mit_body, "LICENSE")
+        stats = b.stats()
+    sched = stats["scheduler"]
+    assert sched["queue_depth"] == 0
+    assert sched["in_flight"] == 0
+    assert isinstance(stats["uptime_s"], float) and stats["uptime_s"] >= 0
+    assert stats["tracing"]["started"] == 1
+    # the compile/execute split rides along (cumulative per classifier,
+    # which this module shares across tests — so shape only)
+    assert {"compiles", "compile_s", "dispatches", "dispatch_s",
+            "shapes"} <= set(stats["device"])
+    assert stats["config"]["trace_sample"] == 0.01
+
+
+def test_stats_verb_prometheus_format_parses(clf, mit_body):
+    from licensee_tpu.obs import check_exposition
+
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        out: list[str] = []
+        serve_session(
+            b,
+            _session_lines(
+                [
+                    {"id": 1, "content": dice_blob(mit_body, "prom"),
+                     "filename": "LICENSE"},
+                    {"id": 2, "op": "stats", "format": "prometheus"},
+                    {"id": 3, "op": "trace", "n": 5},
+                    {"id": 4, "op": "stats", "format": "nope"},
+                ]
+            ),
+            out.append,
+        )
+    rows = [json.loads(line) for line in out]
+    text = rows[1]["prometheus"]
+    assert check_exposition(text) == []
+    assert 'serve_requests_total{event="submitted"} 1' in text
+    assert "serve_queue_depth 0" in text
+    assert "serve_stage_seconds_bucket" in text
+    # the classifier is module-shared so the compile COUNT is
+    # cumulative; the family itself must be present and synced
+    assert 'device_dispatch_total{phase="compile"}' in text
+    assert "process_uptime_seconds" in text
+    assert isinstance(rows[2]["traces"], list)
+    assert rows[3]["error"].startswith("bad_request")
+
+
+def test_tracing_disabled_omits_trace_fields(clf, mit_body):
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), tracing=False,
+    ) as b:
+        out: list[str] = []
+        serve_session(
+            b,
+            _session_lines(
+                [{"id": 1, "content": dice_blob(mit_body, "notrace"),
+                  "filename": "LICENSE"}]
+            ),
+            out.append,
+        )
+        assert b.trace_tail(10) == []
+    row = json.loads(out[0])
+    assert row["key"] == "mit"
+    assert "trace" not in row
+
+
+def test_registry_absorbs_cache_and_flush_counters(clf, mit_body):
+    """One registry scrape carries the scheduler, cache, AND stage
+    reservoir families — the three former islands behind one snapshot."""
+    blob = dice_blob(mit_body, "absorb")
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        b.classify(blob, "LICENSE")
+        b.classify(blob, "LICENSE")  # cache hit
+        snap = b.obs.registry.snapshot()
+
+    def value(name, **labels):
+        for s in snap[name]["samples"]:
+            if s["labels"] == labels:
+                return s["value"]
+        return None
+
+    assert value("serve_requests_total", event="submitted") == 2
+    assert value("serve_requests_total", event="cache_hits") == 1
+    assert value("serve_cache_events_total", event="hits") == 1
+    assert value("serve_flush_total", reason="deadline") == 1
+    assert value("serve_bucket_flush_total", bucket="4") == 1
+    hist = value("serve_stage_seconds", stage="total")
+    assert hist["count"] == 2
