@@ -938,6 +938,115 @@ def bench_serve_path(n_requests: int = 2048) -> dict:
     }
 
 
+def bench_fleet(n_requests: int = 1500) -> dict:
+    """The fleet tier's own cost and resilience, measured over STUB
+    workers (fleet/faults.py): requests/sec through the router with 1
+    vs 2 workers (the router-overhead + scaling number — the device
+    path itself is ``details.serve_path``'s job), and the failover
+    story under a live SIGKILL: the longest client-visible stall, the
+    time until the supervisor's replacement worker answers probes, and
+    the client-visible error count (the fleet contract says zero)."""
+    import os as _os
+    import tempfile
+    import threading
+
+    from licensee_tpu.fleet.router import Router
+    from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+
+    def stub_argv(name, sock):
+        return [
+            sys.executable, "-m", "licensee_tpu.fleet.faults",
+            "--socket", sock, "--name", name, "--service-ms", "1",
+        ]
+
+    def measure_rps(router: Router, n: int, senders: int = 16):
+        errors = [0]
+        gaps: list[float] = []
+        last_done = [time.perf_counter()]
+        lock = threading.Lock()
+
+        def send(k: int) -> None:
+            for i in range(k):
+                row = router.dispatch(
+                    {"id": i, "content": f"blob {i}", "filename": "L"}
+                )
+                now = time.perf_counter()
+                with lock:
+                    gaps.append(now - last_done[0])
+                    last_done[0] = now
+                    if row.get("error"):
+                        errors[0] += 1
+
+        per = n // senders
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=send, args=(per,), daemon=True)
+            for _ in range(senders)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        return per * senders / dt, errors[0], (max(gaps) if gaps else 0.0)
+
+    out: dict = {"requests": n_requests}
+    tmpdir = tempfile.mkdtemp(prefix="licensee-fleet-bench-")
+    for n_workers in (1, 2):
+        sockets = {
+            f"w{i}": _os.path.join(tmpdir, f"{n_workers}-w{i}.sock")
+            for i in range(n_workers)
+        }
+        with Supervisor(
+            sockets, argv_for=stub_argv,
+            env_for=lambda name, chips: worker_env(None, None),
+            probe_interval_s=0.1, backoff_base_s=0.1, backoff_max_s=1.0,
+        ) as supervisor:
+            if not supervisor.wait_healthy(30.0):
+                raise RuntimeError(f"fleet bench workers never booted "
+                                   f"({n_workers}w)")
+            with Router(
+                sockets, supervisor=supervisor, probe_interval_s=0.1,
+                request_timeout_s=10.0, trace_sample=0.0,
+            ) as router:
+                rps, errors, _gap = measure_rps(router, n_requests)
+                out[f"rps_{n_workers}w"] = round(rps, 1)
+                out[f"errors_{n_workers}w"] = errors
+                if n_workers == 2:
+                    # the failover probe: SIGKILL w0 under load, with a
+                    # CONCURRENT watcher timing the supervisor's
+                    # replacement (waiting until the load run finishes
+                    # would report the run length, not the recovery)
+                    pid = supervisor.workers["w0"].pid
+                    recovery = {}
+
+                    def kill_and_time_recovery() -> None:
+                        t_kill = time.perf_counter()
+                        _os.kill(pid, 9)
+                        deadline = t_kill + 30.0
+                        while time.perf_counter() < deadline:
+                            if (
+                                supervisor.workers["w0"].restarts >= 1
+                                and supervisor.probe("w0") is not None
+                            ):
+                                recovery["s"] = round(
+                                    time.perf_counter() - t_kill, 3
+                                )
+                                return
+                            time.sleep(0.02)
+
+                    killer = threading.Timer(
+                        0.15, kill_and_time_recovery
+                    )
+                    killer.start()
+                    _rps, errors, gap = measure_rps(router, n_requests)
+                    killer.join(timeout=35.0)
+                    out["failover_errors"] = errors
+                    out["failover_max_stall_s"] = round(gap, 3)
+                    out["restart_recovery_s"] = recovery.get("s")
+    return out
+
+
 # the round driver records only the last ~2 KB of bench stdout; round 4's
 # single fat JSON line outgrew that window and the official artifact
 # recorded no numbers at all.  The final printed line is therefore
@@ -964,6 +1073,7 @@ def make_headline(
     at_scale = details.get("end_to_end_1m") or {}
     at_auto = details.get("end_to_end_1m_auto") or {}
     serve = details.get("serve_path") or {}
+    fleet = details.get("fleet") or {}
     hm = details.get("host_model") or {}
     return {
         "metric": metric,
@@ -1004,6 +1114,15 @@ def make_headline(
                 "uncached_rps": serve.get("uncached_rps"),
                 "cached_rps": serve.get("cached_rps"),
                 "p99_ms": serve.get("p99_ms"),
+            },
+            # the fleet tier over stub workers: router overhead/scaling
+            # and the SIGKILL failover story (full row: details.fleet)
+            "fleet": {
+                "rps_1w": fleet.get("rps_1w"),
+                "rps_2w": fleet.get("rps_2w"),
+                "failover_errors": fleet.get("failover_errors"),
+                "failover_max_stall_s": fleet.get("failover_max_stall_s"),
+                "restart_recovery_s": fleet.get("restart_recovery_s"),
             },
             # the observability layer's own health on real serve
             # traffic (full snapshot under details.serve_path.obs)
@@ -1139,6 +1258,7 @@ def main() -> None:
         "end_to_end_auto", bench_end_to_end, n_files=32768, mode="auto"
     )
     serve_path = run_safe("serve_path", bench_serve_path)
+    fleet = run_safe("fleet", bench_fleet)
     host_model = run_safe("host_model", bench_host_model, e2e=end_to_end)
     reference_fallback = run_safe(
         "reference_fallback", bench_reference_fallback
@@ -1178,6 +1298,7 @@ def main() -> None:
         "end_to_end_package": end_to_end_package,
         "end_to_end_auto": end_to_end_auto,
         "serve_path": serve_path,
+        "fleet": fleet,
         "host_model": host_model,
         "reference_fallback": reference_fallback,
         "tp_width": tp_width,

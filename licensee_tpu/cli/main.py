@@ -1,10 +1,14 @@
 """Command-line interface: detect / diff / license-path / version /
-batch-detect / serve.
+batch-detect / serve / stats / fleet.
 
 Parity target: `bin/licensee` + `lib/licensee/commands/*.rb` (Thor CLI).
 `batch-detect` is new: the TPU batch path over a manifest of files.
 `serve` is new: the persistent online micro-batching worker (JSONL over
 stdio or a Unix socket, serve/).
+`stats` scrapes one worker (JSON/Prometheus/traces) or a whole fleet
+(merged table with --watch, merged exposition).
+`fleet` supervises N serve workers behind one health-checked, load-
+balanced, hedging front socket (fleet/).
 """
 
 from __future__ import annotations
@@ -454,6 +458,7 @@ def cmd_serve(args) -> int:
             max_delay_ms=args.max_delay_ms,
             queue_depth=args.queue_depth,
             cache_entries=args.cache_entries,
+            cache_bytes=args.cache_bytes,
             deadline_ms=args.deadline_ms,
             threshold=args.confidence,
             buckets=buckets,
@@ -481,57 +486,336 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _scrape_row(socket_path: str, request: dict, timeout: float) -> dict:
+    """One control-verb round trip to a worker socket; raises OSError
+    (WireError) on any transport or parse failure.  The wire protocol
+    lives in ONE place — fleet/wire.py — shared with the router and
+    supervisor probes (the module is stdlib-only, no device imports)."""
+    from licensee_tpu.fleet.wire import oneshot
+
+    return oneshot(socket_path, request, timeout)
+
+
+def socket_labels(sockets: list[str]) -> dict[str, str]:
+    """Display label per scraped socket: the basename — unless two
+    sockets share one (two fleets, each with a w0.sock), in which case
+    the colliding entries keep their full paths so no worker silently
+    vanishes from the merged view."""
+    from collections import Counter
+
+    counts = Counter(os.path.basename(s) for s in sockets)
+    return {
+        s: (
+            os.path.basename(s)
+            if counts[os.path.basename(s)] == 1
+            else s
+        )
+        for s in sockets
+    }
+
+
+def stats_table_rows(
+    snaps: dict, prev: dict | None = None, dt: float | None = None
+) -> list[list[str]]:
+    """The merged fleet table: one row per scraped worker socket.
+    ``snaps`` maps label -> stats dict (or None for an unreachable
+    worker); ``prev``/``dt`` from the previous --watch round turn
+    completed-counter deltas into a live req/s column."""
+    header = ["WORKER", "UP_S", "DONE", "Q", "INFL", "CACHE%",
+              "P50_MS", "P99_MS", "REQ_S"]
+    rows = [header]
+    for label, snap in snaps.items():
+        if not snap:
+            rows.append([label, "-", "-", "-", "-", "-", "-", "-", "down"])
+            continue
+        sched = snap.get("scheduler") or {}
+        cache = snap.get("cache") or {}
+        total = (snap.get("latency_ms") or {}).get("total") or {}
+        hit_rate = cache.get("hit_rate")
+        done = sched.get("completed")
+        rate = "-"
+        if prev and dt and label in prev and prev[label]:
+            before = (prev[label].get("scheduler") or {}).get("completed")
+            if isinstance(done, (int, float)) and isinstance(
+                before, (int, float)
+            ) and dt > 0 and done >= before:
+                # done < before means the counter reset (the supervisor
+                # restarted the worker): no honest rate this frame
+                rate = f"{(done - before) / dt:.1f}"
+
+        def cell(value, fmt="{}"):
+            return "-" if value is None else fmt.format(value)
+
+        rows.append([
+            label,
+            cell(snap.get("uptime_s"), "{:.0f}"),
+            cell(done),
+            cell(sched.get("queue_depth")),
+            cell(sched.get("in_flight")),
+            "-" if hit_rate is None else f"{hit_rate * 100:.1f}",
+            cell(total.get("p50_ms")),
+            cell(total.get("p99_ms")),
+            rate,
+        ])
+    return rows
+
+
+def _render_table(rows: list[list[str]], stream) -> None:
+    widths = [
+        max(len(str(row[i])) for row in rows)
+        for i in range(len(rows[0]))
+    ]
+    for row in rows:
+        stream.write(
+            "  ".join(str(c).ljust(w) for c, w in zip(row, widths)).rstrip()
+            + "\n"
+        )
+
+
+def _stats_watch(
+    sockets: list[str], interval: float, timeout: float,
+    iterations: int | None = None,
+) -> int:
+    """The operator view of a fleet: scrape every socket, print ONE
+    merged table, redraw every ``interval`` seconds (Ctrl-C stops).
+    ``iterations`` bounds the loop (None = forever) — tests use it."""
+    import itertools
+    import time as timelib
+
+    labels = socket_labels(sockets)
+    prev: dict = {}
+    prev_t: float | None = None
+    for i in itertools.count():
+        if iterations is not None and i >= iterations:
+            return 0
+        snaps = {}
+        for sock in sockets:
+            try:
+                row = _scrape_row(sock, {"op": "stats"}, timeout)
+                snaps[labels[sock]] = row.get("stats")
+            except (OSError, ValueError):
+                snaps[labels[sock]] = None
+        now = timelib.perf_counter()
+        dt = None if prev_t is None else now - prev_t
+        table = stats_table_rows(snaps, prev, dt)
+        if interval > 0 and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home, like watch(1)
+        _render_table(table, sys.stdout)
+        sys.stdout.flush()
+        prev, prev_t = snaps, now
+        if interval <= 0:
+            return 0
+        try:
+            timelib.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_stats(args) -> int:
-    """Observability exporter client: scrape a running serve worker's
-    metrics (JSON or Prometheus text exposition) or its trace tail over
-    the Unix-socket control verbs, or run the obs-layer selftest."""
+    """Observability exporter client: scrape running serve workers'
+    metrics (JSON, Prometheus exposition, or — for several --socket
+    flags or --watch — one merged fleet table) or a trace tail over the
+    Unix-socket control verbs, or run the obs-layer selftest."""
     if args.selftest:
         from licensee_tpu.obs.selftest import selftest as obs_selftest
 
         return obs_selftest()
-    if not args.socket:
+    sockets = args.socket or []
+    if not sockets:
         print(
             "error: need --socket PATH (a running `licensee-tpu serve "
-            "--socket` worker) or --selftest",
+            "--socket` worker; repeat for a fleet) or --selftest",
             file=sys.stderr,
         )
         return 1
-    import socket as socketlib
-
     if args.trace is not None:
-        request = {"op": "trace", "n": args.trace}
-    else:
-        request = {"op": "stats"}
-        if args.format == "prometheus":
-            request["format"] = "prometheus"
-    try:
-        with socketlib.socket(
-            socketlib.AF_UNIX, socketlib.SOCK_STREAM
-        ) as sock:
-            sock.settimeout(args.timeout)
-            sock.connect(args.socket)
-            f = sock.makefile("rwb")
-            f.write(json.dumps(request).encode("utf-8") + b"\n")
-            f.flush()
-            line = f.readline()
-    except OSError as exc:
-        print(f"error: cannot scrape {args.socket!r}: {exc}", file=sys.stderr)
-        return 1
-    try:
-        row = json.loads(line)
-    except ValueError as exc:
-        print(f"error: bad response line: {exc}", file=sys.stderr)
-        return 1
-    if "prometheus" in row:
-        sys.stdout.write(row["prometheus"])
-    elif "traces" in row:
+        if len(sockets) > 1:
+            print(
+                "error: --trace reads one worker at a time (one --socket)",
+                file=sys.stderr,
+            )
+            return 1
+        try:
+            row = _scrape_row(
+                sockets[0], {"op": "trace", "n": args.trace}, args.timeout
+            )
+        except (OSError, ValueError) as exc:
+            print(
+                f"error: cannot scrape {sockets[0]!r}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+        if "traces" not in row:
+            print(f"error: unexpected response: {row}", file=sys.stderr)
+            return 1
         for trace in row["traces"]:
             print(json.dumps(trace))
-    elif "stats" in row:
-        print(json.dumps(row["stats"]))
-    else:
-        print(f"error: unexpected response: {row}", file=sys.stderr)
+        return 0
+    if args.format == "prometheus":
+        labels = socket_labels(sockets)
+        expositions = {}
+        for sock in sockets:
+            try:
+                row = _scrape_row(
+                    sock, {"op": "stats", "format": "prometheus"},
+                    args.timeout,
+                )
+            except (OSError, ValueError) as exc:
+                print(
+                    f"error: cannot scrape {sock!r}: {exc}",
+                    file=sys.stderr,
+                )
+                return 1
+            if "prometheus" not in row:
+                # a version-mismatched worker answering an error row
+                # must fail the scrape loudly, never record an empty
+                # exposition with exit 0
+                print(
+                    f"error: unexpected response from {sock!r}: {row}",
+                    file=sys.stderr,
+                )
+                return 1
+            expositions[labels[sock]] = row["prometheus"]
+        if len(expositions) == 1:
+            sys.stdout.write(next(iter(expositions.values())))
+        else:
+            from licensee_tpu.obs import merge_expositions
+
+            sys.stdout.write(merge_expositions(expositions))
+        return 0
+    if args.watch is not None or len(sockets) > 1:
+        # the fleet operator view: merged table, optionally redrawn
+        return _stats_watch(
+            sockets, args.watch or 0.0, args.timeout,
+            iterations=args.watch_iterations,
+        )
+    try:
+        row = _scrape_row(sockets[0], {"op": "stats"}, args.timeout)
+    except (OSError, ValueError) as exc:
+        print(
+            f"error: cannot scrape {sockets[0]!r}: {exc}", file=sys.stderr
+        )
         return 1
+    if "stats" in row:
+        print(json.dumps(row["stats"]))
+        return 0
+    print(f"error: unexpected response: {row}", file=sys.stderr)
+    return 1
+
+
+def cmd_fleet(args) -> int:
+    """The fleet tier: supervise N serve worker processes (restart on
+    crash/wedge with backoff, drain on rolling restart) behind one
+    health-checked, load-balanced, hedging front socket
+    (fleet/supervisor.py + fleet/router.py)."""
+    if args.selftest:
+        from licensee_tpu.fleet.selftest import selftest
+
+        return selftest(stub=args.stub)
+    if not args.socket:
+        print("error: need --socket PATH (the client-facing front "
+              "socket) or --selftest", file=sys.stderr)
+        return 1
+    hedge_ms = args.hedge_ms
+    if hedge_ms not in (None, "off", "auto"):
+        try:
+            hedge_ms = float(hedge_ms)
+            if not (hedge_ms >= 0):
+                raise ValueError
+        except ValueError:
+            print(
+                f"error: bad --hedge-ms {args.hedge_ms!r} "
+                "(want a number, 'auto', or 'off')",
+                file=sys.stderr,
+            )
+            return 1
+    import tempfile
+
+    from licensee_tpu.fleet.router import FrontServer, Router
+    from licensee_tpu.fleet.supervisor import Supervisor
+
+    socket_dir = args.socket_dir or tempfile.mkdtemp(
+        prefix="licensee-fleet-"
+    )
+    os.makedirs(socket_dir, exist_ok=True)
+    workers = {
+        f"w{i}": os.path.join(socket_dir, f"w{i}.sock")
+        for i in range(args.workers)
+    }
+    serve_args: list[str] = []
+    for flag, value in (
+        ("--mode", args.mode),
+        ("--corpus", args.corpus),
+        ("--method", args.method),
+        ("--max-batch", args.max_batch),
+        ("--max-delay-ms", args.max_delay_ms),
+        ("--queue-depth", args.queue_depth),
+        ("--cache-entries", args.cache_entries),
+        ("--cache-bytes", args.cache_bytes),
+        ("--trace-sample", args.trace_sample),
+    ):
+        if value is not None:
+            serve_args += [flag, str(value)]
+    supervisor = Supervisor(
+        workers,
+        chips_per_worker=args.chips_per_worker,
+        serve_args=tuple(serve_args),
+        backoff_base_s=args.restart_backoff_ms / 1000.0,
+        probe_interval_s=args.probe_interval_ms / 1000.0,
+    )
+    router = Router(
+        workers,
+        supervisor=supervisor,
+        hedge_ms=None if hedge_ms == "off" else hedge_ms,
+        probe_interval_s=args.probe_interval_ms / 1000.0,
+    )
+    from licensee_tpu.serve.server import SocketInUseError
+
+    print(
+        f"fleet: {args.workers} workers under {socket_dir}, "
+        f"front socket {args.socket}",
+        file=sys.stderr,
+    )
+    supervisor.start()
+    if not supervisor.wait_healthy(args.boot_timeout):
+        print(
+            f"error: workers failed to boot: {supervisor.status()}",
+            file=sys.stderr,
+        )
+        supervisor.stop()
+        return 1
+    router.start()
+    try:
+        server = FrontServer(args.socket, router)
+    except SocketInUseError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        router.close()
+        supervisor.stop()
+        return 1
+    import signal as signallib
+    import threading
+
+    def _term(*_):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    try:
+        signallib.signal(signallib.SIGTERM, _term)
+    except ValueError:
+        pass
+    try:
+        server.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        try:
+            os.unlink(args.socket)
+        except OSError:
+            pass
+        router.close()
+        supervisor.stop()
+        if args.stats:
+            print(json.dumps(router.stats()), file=sys.stderr)
     return 0
 
 
@@ -546,7 +830,8 @@ COMMANDS = (
     ("help", "Describe available commands"),
     ("batch-detect", "Classify a manifest of files on the TPU batch path"),
     ("serve", "Run the online micro-batching classification worker"),
-    ("stats", "Scrape a serve worker's metrics/traces (obs exporters)"),
+    ("stats", "Scrape serve workers' metrics/traces (obs exporters)"),
+    ("fleet", "Supervise N serve workers behind one routed socket"),
 )
 _COMMAND_HELP = dict(COMMANDS)
 
@@ -800,6 +1085,14 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     serve.add_argument(
+        "--cache-bytes", type=nonneg(int), default=None, metavar="N",
+        help=(
+            "Bound the result cache by estimated resident BYTES "
+            "(LRU eviction, on top of --cache-entries) — the memory "
+            "ceiling for week-long fleet workers (default: unbounded)"
+        ),
+    )
+    serve.add_argument(
         "--deadline-ms", type=nonneg(float), default=0.0, metavar="MS",
         help=(
             "Default per-request deadline: a request still queued after "
@@ -867,8 +1160,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     stats = sub.add_parser("stats", help=_COMMAND_HELP["stats"])
     stats.add_argument(
-        "--socket", default=None, metavar="PATH",
-        help="The serve worker's Unix socket to scrape",
+        "--socket", action="append", default=None, metavar="PATH",
+        help=(
+            "A serve worker's Unix socket to scrape; repeat the flag "
+            "for a fleet — several sockets print ONE merged table "
+            "(json) or one worker-labeled merged exposition "
+            "(prometheus)"
+        ),
     )
     stats.add_argument(
         "--format", default="json", choices=["json", "prometheus"],
@@ -877,6 +1175,18 @@ def build_parser() -> argparse.ArgumentParser:
             "(text exposition — pipe into a node_exporter textfile or "
             "curl-style scrape job)"
         ),
+    )
+    stats.add_argument(
+        "--watch", type=nonneg(float), default=None, metavar="SECS",
+        help=(
+            "Re-scrape and redraw the merged table every SECS seconds "
+            "(Ctrl-C stops) — the live operator view of a fleet; the "
+            "REQ_S column is the completed-counter delta per second"
+        ),
+    )
+    stats.add_argument(
+        "--watch-iterations", type=nonneg(int), default=None,
+        help=argparse.SUPPRESS,  # bound the --watch loop (tests/CI)
     )
     stats.add_argument(
         "--trace", type=nonneg(int), default=None, metavar="N",
@@ -897,6 +1207,118 @@ def build_parser() -> argparse.ArgumentParser:
     )
     stats.set_defaults(func=cmd_stats)
 
+    fleet = sub.add_parser("fleet", help=_COMMAND_HELP["fleet"])
+    fleet.add_argument(
+        "--socket", default=None, metavar="PATH",
+        help="The client-facing front socket (JSONL, same protocol "
+             "as one worker — clients cannot tell the difference)",
+    )
+    fleet.add_argument(
+        "--workers", type=bounded(int, 1), default=2, metavar="N",
+        help="Worker processes to supervise (default 2)",
+    )
+    fleet.add_argument(
+        "--chips-per-worker", type=bounded(int, 1), default=None,
+        metavar="K",
+        help=(
+            "Give worker i chips [i*K, (i+1)*K) via the "
+            "LICENSEE_TPU_VISIBLE_CHIPS env contract "
+            "(parallel/distributed.py apply_visible_chips); default: "
+            "workers share default device visibility"
+        ),
+    )
+    fleet.add_argument(
+        "--socket-dir", default=None, metavar="DIR",
+        help="Directory for per-worker sockets (default: a tmpdir)",
+    )
+    fleet.add_argument(
+        "--hedge-ms", default="off", metavar="MS|auto|off",
+        help=(
+            "Hedged requests: after MS milliseconds without an answer, "
+            "duplicate the request to a second worker and take the "
+            "first answer ('auto' derives the delay from the live p95; "
+            "default off).  A duplicate the twin has cached or in "
+            "flight coalesces by content hash; otherwise the extra "
+            "device load is bounded by the hedge rate (~5% at auto)"
+        ),
+    )
+    fleet.add_argument(
+        "--probe-interval-ms", type=bounded(float, 1), default=250.0,
+        metavar="MS",
+        help="Health-probe cadence for supervisor and router "
+             "(default 250)",
+    )
+    fleet.add_argument(
+        "--restart-backoff-ms", type=bounded(float, 1), default=250.0,
+        metavar="MS",
+        help=(
+            "Base restart backoff: a crashed worker respawns after "
+            "MS * 2^restarts ms, capped at 10s; the counter resets "
+            "after 10s of stable health (default 250)"
+        ),
+    )
+    fleet.add_argument(
+        "--boot-timeout", type=bounded(float, 1), default=300.0,
+        metavar="SECS",
+        help="How long to wait for every worker's first health probe "
+             "(default 300)",
+    )
+    # per-worker serve knobs, forwarded verbatim to each worker argv
+    fleet.add_argument("--mode", default=None,
+                       choices=["license", "readme", "package", "auto"],
+                       help="Forwarded to each worker (serve --mode)")
+    fleet.add_argument("--corpus", default=None,
+                       help="Forwarded to each worker (serve --corpus)")
+    fleet.add_argument(
+        "--method", default=None,
+        choices=["auto", "popcount", "matmul", "pallas", "pallas-mxu"],
+        help="Forwarded to each worker (serve --method)",
+    )
+    fleet.add_argument("--max-batch", type=bounded(int, 1), default=None,
+                       help="Forwarded to each worker (serve --max-batch)")
+    fleet.add_argument(
+        "--max-delay-ms", type=nonneg(float), default=None,
+        help="Forwarded to each worker (serve --max-delay-ms)",
+    )
+    fleet.add_argument(
+        "--queue-depth", type=bounded(int, 1), default=None,
+        help="Forwarded to each worker (serve --queue-depth)",
+    )
+    fleet.add_argument(
+        "--cache-entries", type=nonneg(int), default=None,
+        help="Forwarded to each worker (serve --cache-entries)",
+    )
+    fleet.add_argument(
+        "--cache-bytes", type=nonneg(int), default=None,
+        help="Forwarded to each worker (serve --cache-bytes)",
+    )
+    fleet.add_argument(
+        "--trace-sample", type=nonneg(float), default=None,
+        help="Forwarded to each worker (serve --trace-sample)",
+    )
+    fleet.add_argument(
+        "--stats", action="store_true",
+        help="Dump the router's fleet stats JSON to stderr at shutdown",
+    )
+    fleet.add_argument(
+        "--selftest", action="store_true",
+        help=(
+            "Boot a 2-worker CPU fleet, SIGKILL one worker under live "
+            "client traffic, and assert zero client-visible errors, "
+            "restart-within-backoff, trace propagation, merged "
+            "exposition, and clean drain; exit 0/1 — the CI smoke"
+        ),
+    )
+    fleet.add_argument(
+        "--stub", action="store_true",
+        help=(
+            "With --selftest: use protocol-faithful stub workers "
+            "(no device path) — seconds instead of a JAX boot per "
+            "worker"
+        ),
+    )
+    fleet.set_defaults(func=cmd_fleet)
+
     # the COMMANDS table and the registered subcommands must not drift:
     # `help` prints from the table, the parser dispatches from argparse
     if set(sub.choices) != {name for name, _ in COMMANDS}:
@@ -910,7 +1332,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "-h", "--help"}
+    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "fleet", "-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

@@ -1,0 +1,259 @@
+"""Fault injection for the fleet: kill / hang / slow-walk live worker
+processes, plus a protocol-faithful STUB WORKER for harness runs that
+don't need a device path.
+
+The faults are real OS-level faults against real processes — SIGKILL
+(crash), SIGSTOP (wedge: alive but silent), and a SIGSTOP/SIGCONT duty
+cycle (slow-walk: the brownout that health checks miss but tail
+latency exposes).  The selftest (fleet/selftest.py) drives them under
+live traffic and asserts the client never sees an error.
+
+The stub worker (``python -m licensee_tpu.fleet.faults --socket P``)
+speaks the serve JSONL contract — content rows, ``stats``/``trace``
+verbs, trace-ID adoption, ``queue_full`` shedding — with configurable
+misbehavior (``--service-ms``, ``--hang-after``, ``--exit-after``,
+``--queue-full``), so router/supervisor tests exercise real processes,
+real sockets, and real SIGKILL in milliseconds instead of paying a JAX
+import per worker.
+
+House rules (script/lint): monotonic clocks only, no print — the stub
+talks through its socket and reports errors on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socketserver
+import sys
+import threading
+import time
+from collections import deque
+
+
+def kill(pid: int) -> None:
+    """The crash fault: SIGKILL, no cleanup, no goodbye — the worker's
+    socket file stays behind (the stale-socket fix reclaims it)."""
+    os.kill(pid, signal.SIGKILL)
+
+
+def hang(pid: int) -> None:
+    """The wedge fault: SIGSTOP freezes the process mid-whatever; it
+    stays alive (poll() sees nothing) but answers no probe."""
+    os.kill(pid, signal.SIGSTOP)
+
+
+def resume(pid: int) -> None:
+    os.kill(pid, signal.SIGCONT)
+
+
+class SlowWalker:
+    """The brownout fault: duty-cycle SIGSTOP/SIGCONT so the worker
+    still answers — eventually.  ``duty`` is the STOPPED fraction of
+    each ``period_s``."""
+
+    def __init__(self, pid: int, *, duty: float = 0.8,
+                 period_s: float = 0.1):
+        if not (0.0 < duty < 1.0):
+            raise ValueError(f"duty must be in (0, 1), got {duty!r}")
+        self.pid = pid
+        self.duty = float(duty)
+        self.period_s = float(period_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._walk, name="fleet-slowwalk", daemon=True
+        )
+        self._thread.start()
+
+    def _walk(self) -> None:
+        while not self._stop.is_set():
+            try:
+                os.kill(self.pid, signal.SIGSTOP)
+                if self._stop.wait(self.period_s * self.duty):
+                    break
+                os.kill(self.pid, signal.SIGCONT)
+                if self._stop.wait(self.period_s * (1.0 - self.duty)):
+                    break
+            except ProcessLookupError:
+                return  # the victim died: nothing left to torment
+        try:
+            os.kill(self.pid, signal.SIGCONT)  # never leave it frozen
+        except ProcessLookupError:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+
+# -- the stub worker ---------------------------------------------------
+
+
+class _StubState:
+    """Shared across stub sessions: counters, the trace ring, and the
+    scripted misbehavior."""
+
+    def __init__(self, args):
+        self.args = args
+        self.name = args.name
+        self.t0 = time.perf_counter()
+        self.lock = threading.Lock()
+        self.completed = 0
+        self.in_flight = 0
+        self.traces: deque = deque(maxlen=64)
+        self.hang_forever = threading.Event()
+
+
+def _stub_answer(state: _StubState, msg: dict) -> dict | None:
+    """One stub response row; None hangs the session (the wedge)."""
+    args = state.args
+    rid = msg.get("id")
+    op = msg.get("op")
+    if op == "stats":
+        with state.lock:
+            completed, in_flight = state.completed, state.in_flight
+        if msg.get("format") == "prometheus":
+            text = (
+                "# HELP stub_requests_total Stub worker requests.\n"
+                "# TYPE stub_requests_total counter\n"
+                f"stub_requests_total {completed}\n"
+            )
+            return {"id": rid, "prometheus": text}
+        return {
+            "id": rid,
+            "stats": {
+                "uptime_s": round(time.perf_counter() - state.t0, 3),
+                "worker": state.name,
+                "scheduler": {
+                    "queue_depth": args.report_load,
+                    "in_flight": in_flight,
+                    "completed": completed,
+                },
+            },
+        }
+    if op == "trace":
+        with state.lock:
+            tail = list(state.traces)[-int(msg.get("n", 20)):]
+        return {"id": rid, "traces": tail}
+    if op is not None:
+        return {"id": rid, "error": f"bad_request: unknown op {op!r}"}
+    # a content row
+    if args.queue_full:
+        return {"id": rid, "error": "queue_full", "retry_after": 0.05}
+    with state.lock:
+        state.in_flight += 1
+    try:
+        if args.service_ms:
+            time.sleep(args.service_ms / 1000.0)
+        with state.lock:
+            state.completed += 1
+            n = state.completed
+            trace_id = msg.get("trace")
+            if trace_id:
+                state.traces.append({
+                    "trace": trace_id, "id": rid, "status": "ok",
+                    "spans": [{"name": "stub_serve", "t_ms": 0.0,
+                               "dur_ms": float(args.service_ms)}],
+                })
+    finally:
+        with state.lock:
+            state.in_flight -= 1
+    if args.hang_after and n > args.hang_after:
+        return None  # N answers delivered; silence from here on (wedge)
+    if args.exit_after and n >= args.exit_after:
+        # crash AFTER answering: the next request finds a dead socket
+        threading.Timer(0.05, os._exit, args=(41,)).start()
+    row = {
+        "id": rid, "key": "stub-mit", "matcher": "stub",
+        "confidence": 99.0, "cached": False, "stub_worker": state.name,
+    }
+    if msg.get("trace"):
+        row["trace"] = msg["trace"]
+    return row
+
+
+class _StubServer(socketserver.ThreadingMixIn,
+                  socketserver.UnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _StubHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        state: _StubState = self.server.state
+        for raw in self.rfile:
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                msg = {}
+            row = _stub_answer(state, msg)
+            if row is None:
+                state.hang_forever.wait()  # wedged, forever
+                return
+            try:
+                self.wfile.write(json.dumps(row).encode("utf-8") + b"\n")
+                self.wfile.flush()
+            except OSError:
+                return
+
+
+def stub_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="licensee-tpu-stub-worker",
+        description="Protocol-faithful stub serve worker (fault harness)",
+    )
+    parser.add_argument("--socket", required=True)
+    parser.add_argument("--name", default="stub")
+    parser.add_argument("--service-ms", type=float, default=0.0)
+    parser.add_argument(
+        "--report-load", type=int, default=0,
+        help="Static queue_depth to report in stats (routing tests)",
+    )
+    parser.add_argument(
+        "--hang-after", type=int, default=0,
+        help="After N answers, stop responding (stay alive): the wedge",
+    )
+    parser.add_argument(
+        "--exit-after", type=int, default=0,
+        help="After N answers, exit(41): the scripted crash",
+    )
+    parser.add_argument(
+        "--queue-full", action="store_true",
+        help="Answer every content row with queue_full backpressure",
+    )
+    args = parser.parse_args(argv)
+    try:
+        if os.path.exists(args.socket):
+            os.unlink(args.socket)
+        server = _StubServer(args.socket, _StubHandler)
+    except OSError as exc:
+        sys.stderr.write(f"stub worker: cannot bind: {exc}\n")
+        return 1
+    server.state = _StubState(args)
+    try:
+        server.serve_forever(poll_interval=0.1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        try:
+            os.unlink(args.socket)
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(stub_main())

@@ -1,0 +1,712 @@
+"""The fleet router: one client-facing socket fronting N serve workers
+— least-loaded dispatch, health-checked failover, backpressure-aware
+retries, and tail-cutting hedged requests.
+
+Dean & Barroso's "The Tail at Scale" is the playbook:
+
+* **least-loaded routing** — each request goes to the healthy,
+  non-draining worker with the lowest load score (probed
+  ``queue_depth + in_flight`` plus the router's own outstanding count
+  for that worker; the local term keeps bursts spread even between
+  probe rounds).
+* **failover on death** — classification requests are pure functions
+  of content (the content-hash cache key IS the idempotency proof), so
+  a request whose worker dies mid-flight is simply retried on another
+  replica.  The client sees one answer, never a connection reset.
+* **backpressure failover** — a worker answering ``queue_full`` sheds
+  load; the router tries the next replica and only surfaces
+  ``queue_full`` (with the smallest ``retry_after``) when EVERY
+  replica is shedding.
+* **hedged requests** — optionally, a duplicate is sent to a second
+  worker once the first has been out longer than the observed p95
+  (``hedge_ms="auto"``) or a fixed delay; the first answer wins.  The
+  duplicate costs the twin a device slot only for content it has never
+  seen: a blob already cached or in flight there coalesces via the
+  content-hash key (ResultCache/MicroBatcher), and otherwise the extra
+  load is bounded by the hedge rate (~5% at a p95-derived delay).  The
+  loser's late answer is discarded and its connection recycled.
+
+Trace IDs are minted HERE and forwarded on the wire (``"trace"``
+field); the worker adopts the ID (obs/tracing.py), so the router tail
+shows ``route``/``hedge``/``failover`` spans and the worker tail shows
+the serving spans — same 16-hex handle end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from queue import Empty, SimpleQueue
+
+from licensee_tpu.fleet.wire import ConnectionPool, WireError, oneshot
+from licensee_tpu.obs import Observability, merge_expositions
+from licensee_tpu.serve.server import JsonlUnixServer
+from licensee_tpu.serve.stats import LatencyStats
+
+
+class Backend:
+    """The router's view of one worker: socket, pool, probed load, and
+    per-backend counters."""
+
+    def __init__(self, name: str, socket_path: str, probe_timeout_s: float):
+        self.name = name
+        self.socket_path = socket_path
+        self.pool = ConnectionPool(
+            socket_path, connect_timeout=probe_timeout_s
+        )
+        self.healthy = False
+        self.probed_load = 0
+        self.probe_failures = 0
+        self.outstanding = 0  # routed requests in flight right now
+        self.dispatched = 0
+        self.ok = 0
+        self.failed = 0
+        self.queue_full = 0
+        self.last_stats: dict = {}
+
+    def load(self) -> int:
+        return self.probed_load + self.outstanding
+
+    def as_dict(self) -> dict:
+        return {
+            "socket": self.socket_path,
+            "healthy": self.healthy,
+            "probed_load": self.probed_load,
+            "outstanding": self.outstanding,
+            "dispatched": self.dispatched,
+            "ok": self.ok,
+            "failed": self.failed,
+            "queue_full": self.queue_full,
+        }
+
+
+class Router:
+    """Dispatch requests across the worker fleet; serve the front
+    socket.
+
+    ``backends`` maps worker name -> socket path.  ``supervisor`` is
+    optional: when given, its draining/stopped flags veto dispatch (the
+    drain protocol) and the supervisor reads ``outstanding()`` back.
+    ``hedge_ms`` is ``None``/"off" (no hedging), a number (fixed delay
+    in ms), or "auto" (the p95 of recent request latencies, refreshed
+    per dispatch, floored at ``hedge_floor_ms``)."""
+
+    def __init__(
+        self,
+        backends: dict[str, str],
+        *,
+        supervisor=None,
+        probe_interval_s: float = 0.25,
+        probe_timeout_s: float = 2.0,
+        request_timeout_s: float = 30.0,
+        dispatch_wait_s: float = 15.0,
+        hedge_ms=None,
+        hedge_floor_ms: float = 5.0,
+        hedge_min_samples: int = 20,
+        max_concurrency: int = 64,
+        registry=None,
+        tracing: bool = True,
+        trace_sample: float = 0.01,
+        trace_slow_ms: float = 250.0,
+    ):
+        if not backends:
+            raise ValueError("need at least one backend")
+        if hedge_ms in ("off", "none"):
+            hedge_ms = None
+        if hedge_ms is not None and hedge_ms != "auto":
+            hedge_ms = float(hedge_ms)
+            if not (hedge_ms >= 0):
+                raise ValueError(f"hedge_ms must be >= 0, got {hedge_ms!r}")
+        self.hedge_ms = hedge_ms
+        self.hedge_floor_ms = float(hedge_floor_ms)
+        self.hedge_min_samples = int(hedge_min_samples)
+        self.supervisor = supervisor
+        if supervisor is not None:
+            supervisor.router = self
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.dispatch_wait_s = float(dispatch_wait_s)
+        self.backends: dict[str, Backend] = {
+            name: Backend(name, path, probe_timeout_s)
+            for name, path in backends.items()
+        }
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        self._latency = LatencyStats(capacity=1024)
+        self._counters = {
+            "requests": 0,
+            "ok": 0,
+            "failovers": 0,
+            "retries": 0,
+            "hedges_started": 0,
+            "hedges_won": 0,
+            "hedges_lost": 0,
+            "queue_full_failovers": 0,
+            "queue_full_returned": 0,
+            "no_backend": 0,
+        }
+        self.obs = Observability(
+            registry,
+            tracing=tracing,
+            trace_sample=trace_sample,
+            trace_slow_ms=trace_slow_ms,
+        )
+        self._executor = ThreadPoolExecutor(
+            max_workers=int(max_concurrency),
+            thread_name_prefix="fleet-dispatch",
+        )
+        self._register_metrics()
+
+    # -- metrics --
+
+    def _register_metrics(self) -> None:
+        reg = self.obs.registry
+        reg.gauge(
+            "fleet_backends_healthy",
+            "Workers currently answering health probes",
+        ).set_fn(
+            lambda: sum(1 for b in self.backends.values() if b.healthy)
+        )
+        reg.gauge(
+            "fleet_backends_total", "Workers configured behind the router"
+        ).set(len(self.backends))
+        reg.gauge(
+            "fleet_outstanding",
+            "Routed requests in flight across all workers",
+        ).set_fn(
+            lambda: sum(b.outstanding for b in self.backends.values())
+        )
+        events = reg.counter(
+            "fleet_requests_total",
+            "Router lifecycle events by kind (requests, ok, failovers, "
+            "retries, hedges_started, hedges_won, hedges_lost, "
+            "queue_full_failovers, queue_full_returned, no_backend)",
+            labels=("event",),
+        )
+        # labeled "backend", not "worker": the fleet scrape merges this
+        # registry under an injected worker="router" label, and a
+        # sample carrying its own "worker" label would emit a duplicate
+        # label name — which a real Prometheus server rejects
+        per_worker = reg.counter(
+            "fleet_backend_requests_total",
+            "Routed requests by backend worker and outcome",
+            labels=("backend", "outcome"),
+        )
+        hist = reg.histogram(
+            "fleet_request_seconds",
+            "Client-visible routed request latency (retries and hedges "
+            "included)",
+        )
+        self._latency_hist = hist
+
+        def collect(_reg) -> None:
+            with self._lock:
+                counters = dict(self._counters)
+                rows = [
+                    (b.name, b.ok, b.failed, b.queue_full)
+                    for b in self.backends.values()
+                ]
+            for k, v in counters.items():
+                events.labels(event=k).sync(v)
+            for name, ok, failed, qf in rows:
+                per_worker.labels(backend=name, outcome="ok").sync(ok)
+                per_worker.labels(backend=name, outcome="failed").sync(
+                    failed
+                )
+                per_worker.labels(backend=name, outcome="queue_full").sync(
+                    qf
+                )
+
+        reg.add_collector(collect)
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self.probe_all()  # synchronous first round: pick() works now
+        if self._probe_thread is None:
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="fleet-prober", daemon=True
+            )
+            self._probe_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join()
+            self._probe_thread = None
+        self._executor.shutdown(wait=False)
+        for backend in self.backends.values():
+            backend.pool.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- health probes --
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            self.probe_all()
+
+    def probe_all(self) -> None:
+        for backend in self.backends.values():
+            self._probe(backend)
+
+    def _probe(self, backend: Backend) -> None:
+        try:
+            row = oneshot(
+                backend.socket_path, {"op": "stats"}, self.probe_timeout_s
+            )
+            stats = row.get("stats") or {}
+            sched = stats.get("scheduler") or {}
+            load = int(sched.get("queue_depth") or 0) + int(
+                sched.get("in_flight") or 0
+            )
+        except (WireError, TypeError, ValueError):
+            with self._lock:
+                backend.probe_failures += 1
+                backend.healthy = False
+            return
+        with self._lock:
+            backend.probe_failures = 0
+            backend.healthy = True
+            backend.probed_load = load
+            backend.last_stats = stats
+
+    # -- dispatch --
+
+    def dispatchable(self, name: str) -> bool:
+        if self.supervisor is not None and not self.supervisor.dispatchable(
+            name
+        ):
+            return False
+        return self.backends[name].healthy
+
+    def pick(self, exclude=frozenset()) -> str | None:
+        """The least-loaded healthy, non-draining worker outside
+        ``exclude`` — the dispatch decision."""
+        with self._lock:
+            candidates = [
+                b
+                for name, b in self.backends.items()
+                if name not in exclude and b.healthy
+            ]
+        candidates = [
+            b for b in candidates if self.dispatchable(b.name)
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda b: (b.load(), b.name)).name
+
+    def outstanding(self, name: str | None = None) -> int:
+        """Routed requests currently in flight (one worker, or all) —
+        the supervisor's drain barrier reads this."""
+        with self._lock:
+            if name is not None:
+                backend = self.backends.get(name)
+                return backend.outstanding if backend is not None else 0
+            return sum(b.outstanding for b in self.backends.values())
+
+    def _attempt(self, backend: Backend, line: str):
+        """One request/response round trip against one worker.
+        Returns ("ok" | "queue_full" | "fail", row_or_reason, dt_s)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            backend.outstanding += 1
+            backend.dispatched += 1
+        try:
+            conn = backend.pool.checkout()
+            try:
+                row = conn.request(line, self.request_timeout_s)
+            except WireError:
+                backend.pool.discard(conn)
+                raise
+            backend.pool.checkin(conn)
+        except WireError as exc:
+            with self._lock:
+                backend.outstanding -= 1
+                backend.failed += 1
+                backend.healthy = False  # fail fast until a probe clears it
+            return ("fail", str(exc), time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            backend.outstanding -= 1
+            if row.get("error") == "queue_full":
+                backend.queue_full += 1
+                return ("queue_full", row, dt)
+            backend.ok += 1
+        return ("ok", row, dt)
+
+    def _hedge_delay_s(self) -> float | None:
+        """Seconds to wait before hedging, or None (hedging off / not
+        enough samples yet for the auto p95)."""
+        if self.hedge_ms is None or len(self.backends) < 2:
+            return None
+        if self.hedge_ms != "auto":
+            return float(self.hedge_ms) / 1000.0
+        snap = self._latency.snapshot()
+        if (snap["count"] or 0) < self.hedge_min_samples:
+            return None
+        return max(snap["p95_ms"], self.hedge_floor_ms) / 1000.0
+
+    def dispatch(self, msg: dict) -> dict:
+        """Route one classification request: pick, attempt (maybe
+        hedged), fail over on death/backpressure.  Always returns a
+        response row for the client."""
+        t0 = time.perf_counter()
+        rid = msg.get("id")
+        trace = self.obs.tracer.start(rid)
+        wire_msg = dict(msg)
+        if trace is not None:
+            wire_msg["trace"] = trace.trace_id
+        line = json.dumps(wire_msg)
+        with self._lock:
+            self._counters["requests"] += 1
+        tried: set[str] = set()
+        queue_full_rows: list[dict] = []
+        last_reason = "no healthy backend"
+        deadline = t0 + self.dispatch_wait_s
+        first_round = True
+        while time.perf_counter() < deadline:
+            name = self.pick(exclude=tried)
+            if name is None:
+                if queue_full_rows:
+                    # no untried replica left and at least one answered
+                    # queue_full: surface the backpressure NOW — the
+                    # client's retry_after backoff beats burning the
+                    # dispatch window hammering shedding workers
+                    break
+                if tried:
+                    # every current backend failed this request; a
+                    # restart may bring one back before the deadline
+                    tried = set()
+                time.sleep(0.05)
+                continue
+            if not first_round:
+                with self._lock:
+                    self._counters["retries"] += 1
+            first_round = False
+            outcome, payload, winner = self._race(name, line, trace, tried)
+            if outcome == "ok":
+                dt = time.perf_counter() - t0
+                self._latency.record(dt)
+                self._latency_hist.observe(dt)
+                with self._lock:
+                    self._counters["ok"] += 1
+                if trace is not None:
+                    self.obs.tracer.finish(trace, "ok")
+                payload.setdefault("id", rid)
+                payload["worker"] = winner
+                return payload
+            if outcome == "queue_full":
+                queue_full_rows.append(payload)
+                with self._lock:
+                    self._counters["queue_full_failovers"] += 1
+                if trace is not None:
+                    trace.add_span(
+                        "failover", 0.0, note=f"queue_full from {winner}"
+                    )
+                continue
+            # death/timeout: retry elsewhere — content requests are
+            # idempotent by construction (pure function of content)
+            last_reason = str(payload)
+            with self._lock:
+                self._counters["failovers"] += 1
+            if trace is not None:
+                trace.add_span(
+                    "failover", 0.0, note=f"{winner}: {last_reason[:120]}"
+                )
+        if queue_full_rows:
+            with self._lock:
+                self._counters["queue_full_returned"] += 1
+            if trace is not None:
+                self.obs.tracer.finish(trace, "queue_full")
+            row = min(
+                queue_full_rows,
+                key=lambda r: r.get("retry_after") or float("inf"),
+            )
+            row.setdefault("id", rid)
+            return row
+        with self._lock:
+            self._counters["no_backend"] += 1
+        if trace is not None:
+            self.obs.tracer.finish(trace, "no_backend")
+        row = {"id": rid, "error": f"no_backend_available: {last_reason}"}
+        if trace is not None:
+            row["trace"] = trace.trace_id
+        return row
+
+    def _race(self, first: str, line: str, trace, tried: set):
+        """One dispatch round: the primary attempt plus, after the
+        hedge delay, an optional duplicate on a second worker.  First
+        answer wins; a failed arm waits for its twin before the round
+        reports failure.  Returns (outcome, payload, worker_name)."""
+        tried.add(first)
+        if trace is not None:
+            trace.add_span(
+                "route", 0.0,
+                note=f"to={first} load={self.backends[first].load()}",
+            )
+        hedge_delay = self._hedge_delay_s()
+        if hedge_delay is None:
+            # no hedge possible this round: run the attempt on the
+            # caller's thread — a thread spawn + queue handoff per
+            # request is pure overhead when nothing races
+            outcome, payload, _dt = self._attempt(
+                self.backends[first], line
+            )
+            return (outcome, payload, first)
+        results: SimpleQueue = SimpleQueue()
+
+        # arms run on fresh daemon threads, deliberately NOT on
+        # self._executor: an arm can block up to request_timeout_s on a
+        # wedged worker, and a bounded shared pool would let a few
+        # stuck arms head-of-line-block every new session dispatch —
+        # the per-spawn cost is paid only on hedge-capable rounds
+        def run(name: str) -> None:
+            results.put((name, self._attempt(self.backends[name], line)))
+
+        threading.Thread(
+            target=run, args=(first,), daemon=True,
+            name=f"fleet-attempt-{first}",
+        ).start()
+        arms = [first]
+        start = time.perf_counter()
+        hedge_at = start + hedge_delay
+        deadline = start + self.request_timeout_s + 1.0
+        seen: dict[str, tuple] = {}
+        while time.perf_counter() < deadline:
+            now = time.perf_counter()
+            # clamp: the clock may cross `deadline` between the loop
+            # check and here, and a negative timeout raises ValueError
+            wait = max(deadline - now, 0.0)
+            if hedge_at is not None:
+                wait = min(wait, max(hedge_at - now, 0.0) + 1e-4)
+            try:
+                name, res = results.get(timeout=wait)
+            except Empty:
+                name = None
+            if name is None:
+                if hedge_at is not None and time.perf_counter() >= hedge_at:
+                    hedge_at = None
+                    second = self.pick(exclude=tried)
+                    if second is not None:
+                        tried.add(second)
+                        arms.append(second)
+                        with self._lock:
+                            self._counters["hedges_started"] += 1
+                        if trace is not None:
+                            trace.add_span(
+                                "hedge", 0.0, note=f"to={second}"
+                            )
+                        threading.Thread(
+                            target=run, args=(second,), daemon=True,
+                            name=f"fleet-hedge-{second}",
+                        ).start()
+                continue
+            outcome, payload, _dt = res
+            seen[name] = res
+            if outcome == "ok":
+                if len(arms) == 2:
+                    won_by_hedge = name == arms[1]
+                    with self._lock:
+                        self._counters[
+                            "hedges_won" if won_by_hedge else "hedges_lost"
+                        ] += 1
+                return ("ok", payload, name)
+            if len(seen) < len(arms):
+                continue  # a twin is still racing: let it finish
+            # every arm answered without a verdict: report the least
+            # severe outcome (queue_full beats a dead connection — the
+            # client can at least back off)
+            for arm_name, (arm_outcome, arm_payload, _d) in seen.items():
+                if arm_outcome == "queue_full":
+                    return ("queue_full", arm_payload, arm_name)
+            return (outcome, payload, name)
+        return ("fail", f"race timeout after {self.request_timeout_s}s",
+                first)
+
+    # -- ops surface (front-socket verbs + CLI) --
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            backends = {
+                name: b.as_dict() for name, b in self.backends.items()
+            }
+        if self.supervisor is not None:
+            sup = self.supervisor.status()
+            for name, row in backends.items():
+                row["supervisor"] = sup.get(name)
+        return {
+            "uptime_s": self.obs.uptime_s(),
+            "router": {
+                **counters,
+                "latency_ms": self._latency.snapshot(),
+                "hedge_ms": self.hedge_ms,
+            },
+            "backends": backends,
+            "tracing": self.obs.tracer.stats(),
+        }
+
+    def prometheus(self) -> str:
+        """The FLEET exposition: the router's own registry plus a live
+        scrape of every healthy worker's exposition, merged with a
+        ``worker`` label per source (obs/export.py)."""
+        per_source = {"router": self.obs.prometheus()}
+        for name, backend in self.backends.items():
+            try:
+                row = oneshot(
+                    backend.socket_path,
+                    {"op": "stats", "format": "prometheus"},
+                    self.probe_timeout_s,
+                )
+            except WireError:
+                continue  # a dead worker exports nothing this scrape
+            text = row.get("prometheus")
+            if isinstance(text, str):
+                per_source[name] = text
+        return merge_expositions(per_source)
+
+    def trace_tail(self, n: int = 20) -> list[dict]:
+        return self.obs.tracer.tail(n)
+
+
+class _RouterSession:
+    """One client session on the front socket: parse lines, dispatch
+    concurrently, answer IN REQUEST ORDER (same contract as a worker
+    session, so clients cannot tell a router from a worker)."""
+
+    def __init__(self, router: Router, write_line):
+        self.router = router
+        self._write_line = write_line
+        self._pending: deque = deque()  # ("fut", Future) | ("op", ...)
+        self._cond = threading.Condition()
+        self._closed = False
+        self.requests = 0
+        self.responses = 0
+        self._writer = threading.Thread(
+            target=self._drain, name="fleet-writer", daemon=True
+        )
+        self._writer.start()
+
+    def _emit(self, kind, payload) -> None:
+        with self._cond:
+            self._pending.append((kind, payload))
+            self._cond.notify_all()
+
+    def _drain(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending and self._closed:
+                    return
+                kind, payload = self._pending.popleft()
+            if kind == "fut":
+                try:
+                    row = payload.result()
+                except Exception as exc:  # noqa: BLE001 — session containment
+                    row = {"id": None, "error": f"internal_error: {exc}"}
+            elif kind == "stats":
+                rid, fmt = payload
+                if fmt == "prometheus":
+                    row = {"id": rid,
+                           "prometheus": self.router.prometheus()}
+                else:
+                    row = {"id": rid, "stats": self.router.stats()}
+            elif kind == "trace":
+                rid, n = payload
+                row = {"id": rid, "traces": self.router.trace_tail(n)}
+            else:
+                row = payload
+            try:
+                self._write_line(json.dumps(row))
+            except (OSError, ValueError):
+                return
+            self.responses += 1
+
+    def handle_line(self, line: str) -> None:
+        line = line.strip()
+        if not line:
+            return
+        self.requests += 1
+        try:
+            msg = json.loads(line)
+            if not isinstance(msg, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as exc:
+            self._emit("raw", {"id": None, "error": f"bad_request: {exc}"})
+            return
+        rid = msg.get("id")
+        op = msg.get("op")
+        if op == "stats":
+            fmt = msg.get("format")
+            if fmt not in (None, "json", "prometheus"):
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": f"bad_request: unknown stats format {fmt!r}"},
+                )
+                return
+            self._emit("stats", (rid, fmt))
+            return
+        if op == "trace":
+            n = msg.get("n", 20)
+            if isinstance(n, bool) or not isinstance(n, int) or n < 0:
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: n must be a non-negative int"},
+                )
+                return
+            self._emit("trace", (rid, n))
+            return
+        if op is not None:
+            self._emit(
+                "raw", {"id": rid, "error": f"bad_request: unknown op {op!r}"}
+            )
+            return
+        # content rows: the WORKER validates the payload (one
+        # validator, serve/server.py) — the router only owns routing
+        self._emit("fut", self.router._executor.submit(
+            self.router.dispatch, msg
+        ))
+
+    def finish(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._writer.join()
+
+
+def route_session(router: Router, lines, write_line) -> dict:
+    """Run one front-socket session over an iterable of lines."""
+    session = _RouterSession(router, write_line)
+    try:
+        for line in lines:
+            session.handle_line(line)
+    finally:
+        session.finish()
+    return {"requests": session.requests, "responses": session.responses}
+
+
+class FrontServer(JsonlUnixServer):
+    """The client-facing Unix socket: one JSONL session per
+    connection, all sharing one router (same transport class as a
+    worker — serve/server.py)."""
+
+    def __init__(self, path: str, router: Router):
+        self.router = router
+        super().__init__(path)
+
+    def run_session(self, lines, write_line) -> None:
+        route_session(self.router, lines, write_line)

@@ -1,0 +1,300 @@
+"""Fleet selftest — the CI smoke behind ``licensee-tpu fleet
+--selftest``.
+
+Boots a REAL fleet on this host: a supervisor spawning 2 serve worker
+processes (CPU-pinned), the router fronting them on a Unix socket, and
+a live client streaming classification traffic through the front door.
+Mid-stream, one worker is SIGKILLed (faults.kill — a real SIGKILL to a
+real process).  The gate:
+
+* ZERO client-visible errors: every request answers with the correct
+  verdict via retry/failover, connection resets and queue losses
+  included;
+* the supervisor restarts the dead worker within its backoff budget
+  and the worker rejoins the rotation (answers probes again);
+* trace IDs propagate: at least one router-minted trace ID (route
+  span) appears verbatim in a worker's ``{"op": "trace"}`` tail;
+* the merged fleet exposition (router registry + per-worker scrapes,
+  ``worker``-labeled) parses clean against the Prometheus grammar;
+* a graceful drain completes with zero in-flight work (the rolling-
+  restart primitive).
+
+``stub=True`` swaps the workers for the protocol-faithful stub
+(faults.py) — same supervisor, router, sockets, and SIGKILL, no JAX
+import per worker — the fast path the unit tests ride.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+from licensee_tpu.fleet import faults
+from licensee_tpu.fleet.router import FrontServer, Router
+from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+from licensee_tpu.fleet.wire import WireError, oneshot
+from licensee_tpu.obs import check_exposition
+
+
+def _stub_argv(name: str, sock: str) -> list[str]:
+    return [
+        sys.executable, "-m", "licensee_tpu.fleet.faults",
+        "--socket", sock, "--name", name, "--service-ms", "10",
+    ]
+
+
+def _serve_argv(name: str, sock: str) -> list[str]:
+    return [
+        sys.executable, "-m", "licensee_tpu.cli.main", "serve",
+        "--socket", sock, "--max-delay-ms", "5",
+        "--trace-sample", "1.0",
+    ]
+
+
+def _client_blobs(stub: bool, n_unique: int = 8) -> list[str]:
+    if stub:
+        return [f"stub blob {i}" for i in range(n_unique)]
+    from licensee_tpu.corpus.license import License
+
+    body = re.sub(
+        r"\[(\w+)\]", "example", License.find("mit").content or ""
+    )
+    # unique Dice-bound variants: defeat the Exact prefilter so rows
+    # cross each worker's device path (the serving path under test)
+    return [f"{body}\nzqfleet{i} zqtail{i}\n" for i in range(n_unique)]
+
+
+def _worker_trace_ids(socket_path: str) -> set[str]:
+    try:
+        row = oneshot(socket_path, {"op": "trace", "n": 100}, 5.0)
+    except WireError:
+        return set()
+    return {
+        t.get("trace") for t in row.get("traces") or [] if t.get("trace")
+    }
+
+
+def selftest(
+    verbose: bool = True,
+    stub: bool = False,
+    n_workers: int = 2,
+    n_requests: int = 120,
+) -> int:
+    problems: list[str] = []
+    tmpdir = tempfile.mkdtemp(prefix="licensee-fleet-")
+    sockets = {
+        f"w{i}": os.path.join(tmpdir, f"w{i}.sock")
+        for i in range(n_workers)
+    }
+    boot_timeout = 20.0 if stub else 240.0
+    req_timeout = 10.0 if stub else 120.0
+    env = worker_env(None, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # the CI contract: CPU workers
+    supervisor = Supervisor(
+        sockets,
+        argv_for=(_stub_argv if stub else _serve_argv),
+        env_for=lambda name, chips: env,
+        probe_interval_s=0.25,
+        backoff_base_s=0.25,
+        backoff_max_s=2.0,
+        startup_grace_s=boot_timeout,
+    )
+    router = Router(
+        sockets,
+        supervisor=supervisor,
+        probe_interval_s=0.25,
+        request_timeout_s=req_timeout,
+        dispatch_wait_s=req_timeout + 30.0,
+        trace_sample=1.0,
+    )
+    front_path = os.path.join(tmpdir, "front.sock")
+    server = None
+    server_thread = None
+    try:
+        supervisor.start()
+        if not supervisor.wait_healthy(boot_timeout):
+            problems.append(
+                f"workers never became healthy: {supervisor.status()}"
+            )
+            raise _Abort()
+        router.start()
+        server = FrontServer(front_path, router)
+        server_thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        server_thread.start()
+
+        blobs = _client_blobs(stub)
+        rows = _drive_traffic(
+            front_path, blobs, n_requests, supervisor, problems,
+            read_timeout=req_timeout + 60.0,
+        )
+        # -- zero client-visible errors, correct verdicts --
+        want_key = "stub-mit" if stub else "mit"
+        errors = [r for r in rows if r.get("error")]
+        if errors:
+            problems.append(
+                f"{len(errors)} client-visible errors, e.g. {errors[:3]}"
+            )
+        wrong = [r for r in rows if not r.get("error")
+                 and r.get("key") != want_key]
+        if wrong:
+            problems.append(f"wrong verdicts, e.g. {wrong[:3]}")
+        if len(rows) != n_requests:
+            problems.append(
+                f"response count {len(rows)} != requests {n_requests}"
+            )
+        # -- the dead worker restarted within the backoff budget --
+        handle = supervisor.workers["w0"]
+        budget = (
+            supervisor.backoff_delay_s(0)
+            + supervisor.backoff_delay_s(1)
+            + boot_timeout
+        )
+        deadline = time.perf_counter() + budget
+        revived = False
+        while time.perf_counter() < deadline:
+            if handle.restarts >= 1 and supervisor.probe("w0") is not None:
+                revived = True
+                break
+            time.sleep(0.1)
+        if not revived:
+            problems.append(
+                f"w0 not restarted within {budget:.1f}s budget: "
+                f"{supervisor.status()}"
+            )
+        # -- the router actually failed over (the kill landed mid-stream) --
+        rstats = router.stats()["router"]
+        if rstats["failovers"] + rstats["retries"] < 1:
+            problems.append(
+                f"no failover recorded — did the kill land? {rstats}"
+            )
+        # -- trace propagation router -> worker --
+        routed_ids = {
+            t["trace"]
+            for t in router.trace_tail(200)
+            if any(s["name"] == "route" for s in t.get("spans", ()))
+        }
+        worker_ids = set()
+        for sock in sockets.values():
+            worker_ids |= _worker_trace_ids(sock)
+        if not routed_ids:
+            problems.append("router retained no routed traces")
+        elif not (routed_ids & worker_ids):
+            problems.append(
+                f"no router trace ID found in any worker tail "
+                f"({len(routed_ids)} routed, {len(worker_ids)} worker-side)"
+            )
+        # -- merged fleet exposition --
+        exposition = router.prometheus()
+        grammar = check_exposition(exposition)
+        if grammar:
+            problems.append(f"merged exposition grammar: {grammar[:3]}")
+        if 'worker="w1"' not in exposition:
+            problems.append("merged exposition missing worker labels")
+        if 'fleet_requests_total{worker="router",event="ok"}' not in (
+            exposition
+        ):
+            problems.append("merged exposition missing router series")
+        # -- graceful drain completes in-flight and stops the worker --
+        drained_clean = supervisor.drain(
+            "w1", timeout_s=30.0, restart=False
+        )
+        if not drained_clean:
+            problems.append("drain of idle w1 was not clean")
+        if supervisor.workers["w1"].state != "stopped":
+            problems.append(
+                f"drained worker state: {supervisor.workers['w1'].state}"
+            )
+    except _Abort:
+        pass
+    except Exception as exc:  # noqa: BLE001 — selftest must report, not die
+        problems.append(f"selftest crashed: {type(exc).__name__}: {exc}")
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if server_thread is not None:
+            server_thread.join(timeout=5.0)
+        router.close()
+        supervisor.stop()
+    if verbose:
+        summary = {
+            "fleet_selftest": "ok" if not problems else "FAIL",
+            "stub_workers": stub,
+            "problems": problems,
+        }
+        sys.stderr.write(json.dumps(summary) + "\n")
+    return 0 if not problems else 1
+
+
+class _Abort(Exception):
+    """Internal early-exit: boot failed, nothing further to assert."""
+
+
+def _drive_traffic(
+    front_path: str,
+    blobs: list[str],
+    n_requests: int,
+    supervisor: Supervisor,
+    problems: list[str],
+    read_timeout: float,
+    kill_at_fraction: float = 1.0 / 3.0,
+) -> list[dict]:
+    """Stream ``n_requests`` through the front socket from a writer
+    thread, SIGKILL worker w0 once a third of the stream is out, and
+    collect every response row."""
+    kill_at = max(1, int(n_requests * kill_at_fraction))
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(front_path)
+    sock.settimeout(read_timeout)
+    f = sock.makefile("rwb")
+
+    def writer() -> None:
+        try:
+            for i in range(n_requests):
+                line = json.dumps({
+                    "id": i,
+                    "content": blobs[i % len(blobs)],
+                    "filename": "LICENSE",
+                })
+                f.write(line.encode("utf-8") + b"\n")
+                f.flush()
+                if i + 1 == kill_at:
+                    pid = supervisor.workers["w0"].pid
+                    if pid is None:
+                        problems.append("w0 had no pid at kill time")
+                    else:
+                        faults.kill(pid)
+                time.sleep(0.005)
+        except OSError as exc:
+            problems.append(f"client writer failed: {exc}")
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    rows: list[dict] = []
+    try:
+        for _ in range(n_requests):
+            raw = f.readline()
+            if not raw:
+                problems.append(
+                    f"front socket closed after {len(rows)} responses"
+                )
+                break
+            rows.append(json.loads(raw.decode("utf-8", errors="replace")))
+    except (OSError, ValueError) as exc:
+        problems.append(f"client reader failed: {exc}")
+    wt.join(timeout=read_timeout)
+    try:
+        f.close()
+        sock.close()
+    except OSError:
+        pass
+    return rows

@@ -1,0 +1,485 @@
+"""Borg-style worker supervision for the serving fleet: spawn N serve
+workers, health-check them, restart crashes/wedges with exponential
+backoff, and drain gracefully for rolling restarts.
+
+Each worker is ONE ``licensee-tpu serve --socket`` process — its own
+failure domain, its own device pipeline, and (with ``chips_per_worker``)
+its own chip subset exported through the SAME env contract the offline
+multi-host path uses: the supervisor sets ``LICENSEE_TPU_VISIBLE_CHIPS``
+in the child environment and runs ``apply_visible_chips`` over that
+dict, so the PJRT visibility vars are derived identically to a
+``batch-detect`` co-located launch (parallel/distributed.py).
+
+Failure handling, in escalation order:
+
+* **crash** — ``proc.poll()`` shows an exit: respawn after the current
+  backoff delay (``backoff_base_s * 2^restarts`` capped at
+  ``backoff_max_s``; the restart counter resets once a worker stays
+  healthy ``stable_after_s``, so a week-old worker's first crash
+  restarts fast).
+* **wedge** — the process is alive but ``{"op": "stats"}`` probes fail
+  ``wedged_after`` consecutive times (a hung compile, a stopped
+  process): SIGKILL, then the crash path above.  A freshly spawned
+  worker gets ``startup_grace_s`` before probe failures count — JAX
+  import and corpus load legitimately take seconds.
+* **drain** — the rolling-restart verb: mark the worker draining (the
+  router stops dispatching to it), wait until the worker reports zero
+  queued/in-flight work AND the router reports zero outstanding routed
+  requests, then SIGTERM (the serve loop shuts down cleanly and
+  unlinks its socket), escalating to SIGKILL only on a stuck exit.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from licensee_tpu.fleet.wire import WireError, oneshot
+from licensee_tpu.parallel.distributed import (
+    apply_visible_chips,
+    chips_for_worker,
+)
+
+# worker lifecycle states (status()/metrics surface)
+STARTING = "starting"
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+DOWN = "down"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+
+def default_worker_argv(
+    socket_path: str, serve_args: tuple[str, ...] = ()
+) -> list[str]:
+    """The production worker command: the existing serve loop on its
+    own Unix socket."""
+    return [
+        sys.executable, "-m", "licensee_tpu.cli.main", "serve",
+        "--socket", socket_path, *serve_args,
+    ]
+
+
+def worker_env(
+    base_env: dict | None, chips: list[str] | None
+) -> dict[str, str]:
+    """The child environment for one worker.
+
+    With ``chips``, exports ``LICENSEE_TPU_VISIBLE_CHIPS`` and derives
+    the runtime visibility vars through ``apply_visible_chips`` on the
+    CHILD's env dict — the same translation, validation, and CPU
+    rehearsal the offline co-located launch gets, without touching this
+    process's environment.  Also pins PYTHONPATH to the package root so
+    ``-m licensee_tpu...`` resolves regardless of the child's cwd."""
+    env = dict(os.environ if base_env is None else base_env)
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    existing = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = (
+        pkg_root if not existing else pkg_root + os.pathsep + existing
+    )
+    if chips:
+        env["LICENSEE_TPU_VISIBLE_CHIPS"] = ",".join(chips)
+        apply_visible_chips(env)
+    return env
+
+
+class WorkerHandle:
+    """One supervised worker: its spec, its live process, and the
+    restart/health bookkeeping.  Mutated only by the supervisor (under
+    its lock); the router reads ``state``/``draining`` lock-free —
+    a stale read costs one failed dispatch attempt, which fails over."""
+
+    def __init__(self, name: str, socket_path: str, argv, env):
+        self.name = name
+        self.socket_path = socket_path
+        self.argv = list(argv)
+        self.env = dict(env)
+        self.proc: subprocess.Popen | None = None
+        self.state = STARTING
+        self.draining = False
+        self.restarts = 0
+        self.probe_failures = 0
+        self.spawned_at: float | None = None
+        self.healthy_since: float | None = None
+        self.next_spawn_at: float = 0.0
+        self.last_stats: dict = {}
+        self.exit_codes: list[int] = []  # recent exits, newest last
+
+    @property
+    def pid(self) -> int | None:
+        return self.proc.pid if self.proc is not None else None
+
+    def as_dict(self) -> dict:
+        sched = (self.last_stats.get("scheduler") or {})
+        return {
+            "socket": self.socket_path,
+            "pid": self.pid,
+            "state": self.state,
+            "draining": self.draining,
+            "restarts": self.restarts,
+            "probe_failures": self.probe_failures,
+            "queue_depth": sched.get("queue_depth"),
+            "in_flight": sched.get("in_flight"),
+            "completed": sched.get("completed"),
+            "exit_codes": self.exit_codes[-5:],
+        }
+
+
+class Supervisor:
+    """Spawn + monitor + restart + drain a set of serve workers.
+
+    ``workers`` maps name -> socket path; ``argv_for(name, socket)``
+    builds each worker's command (defaults to the serve CLI), so tests
+    and the fault harness supervise stub workers through the exact
+    production restart machinery."""
+
+    def __init__(
+        self,
+        workers: dict[str, str],
+        *,
+        argv_for=None,
+        env_for=None,
+        chips_per_worker: int | None = None,
+        serve_args: tuple[str, ...] = (),
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 2.0,
+        wedged_after: int = 3,
+        startup_grace_s: float = 120.0,
+        backoff_base_s: float = 0.25,
+        backoff_max_s: float = 10.0,
+        stable_after_s: float = 10.0,
+        base_env: dict | None = None,
+    ):
+        if not workers:
+            raise ValueError("need at least one worker")
+        if chips_per_worker is not None and chips_per_worker < 1:
+            raise ValueError(
+                f"chips_per_worker must be >= 1, got {chips_per_worker!r}"
+            )
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.wedged_after = int(wedged_after)
+        self.startup_grace_s = float(startup_grace_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.stable_after_s = float(stable_after_s)
+        # the router attaches itself here (fleet CLI): drain then also
+        # waits for the router's outstanding count to hit zero
+        self.router = None
+        self.workers: dict[str, WorkerHandle] = {}
+        for i, (name, sock) in enumerate(workers.items()):
+            chips = None
+            if chips_per_worker is not None:
+                chips = chips_for_worker(i, chips_per_worker)
+            env = (
+                env_for(name, chips)
+                if env_for is not None
+                else worker_env(base_env, chips)
+            )
+            argv = (
+                argv_for(name, sock)
+                if argv_for is not None
+                else default_worker_argv(sock, serve_args)
+            )
+            self.workers[name] = WorkerHandle(name, sock, argv, env)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        with self._lock:
+            for handle in self.workers.values():
+                if handle.proc is None and handle.state != STOPPED:
+                    self._spawn(handle)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._monitor, name="fleet-supervisor", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, *, sigterm_timeout_s: float = 5.0) -> None:
+        """Stop monitoring and terminate every worker (SIGTERM, then
+        SIGKILL after ``sigterm_timeout_s``)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        with self._lock:
+            handles = list(self.workers.values())
+        for handle in handles:
+            self._terminate(handle, sigterm_timeout_s)
+            handle.state = STOPPED
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- spawn / kill primitives (lock held by callers where noted) --
+
+    def _spawn(self, handle: WorkerHandle) -> None:
+        """Start (or restart) one worker process.  Lock held."""
+        handle.proc = subprocess.Popen(
+            handle.argv,
+            env=handle.env,
+            stdin=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        handle.spawned_at = time.perf_counter()
+        handle.healthy_since = None
+        handle.probe_failures = 0
+        handle.state = STARTING
+
+    def _terminate(
+        self, handle: WorkerHandle, sigterm_timeout_s: float
+    ) -> None:
+        proc = handle.proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            proc.terminate()
+            proc.wait(timeout=sigterm_timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5.0)
+        except OSError:
+            pass
+
+    def _schedule_restart(self, handle: WorkerHandle) -> None:
+        """Record the death and arm the backoff timer.  Lock held."""
+        delay = min(
+            self.backoff_base_s * (2 ** handle.restarts),
+            self.backoff_max_s,
+        )
+        handle.restarts += 1
+        handle.next_spawn_at = time.perf_counter() + delay
+        handle.state = DOWN
+        handle.proc = None
+
+    def backoff_delay_s(self, restarts: int) -> float:
+        """The delay before restart number ``restarts + 1`` — exposed
+        so tests and the selftest can name the backoff budget."""
+        return min(
+            self.backoff_base_s * (2 ** restarts), self.backoff_max_s
+        )
+
+    # -- the monitor loop --
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        """One supervision pass over every worker (public so tests can
+        drive supervision deterministically without the timer)."""
+        with self._lock:
+            handles = list(self.workers.values())
+        now = time.perf_counter()
+        for handle in handles:
+            with self._lock:
+                if handle.state == STOPPED or handle.draining:
+                    continue
+                proc = handle.proc
+                if proc is not None and proc.poll() is not None:
+                    handle.exit_codes.append(proc.returncode)
+                    self._schedule_restart(handle)
+                    continue
+                if proc is None:
+                    if now >= handle.next_spawn_at:
+                        self._spawn(handle)
+                    continue
+            # probe OUTSIDE the lock: a 2-second probe timeout must not
+            # freeze supervision of every other worker
+            stats = self.probe(handle.name)
+            with self._lock:
+                if stats is not None:
+                    handle.last_stats = stats
+                    handle.probe_failures = 0
+                    if handle.healthy_since is None:
+                        handle.healthy_since = time.perf_counter()
+                    elif (
+                        handle.restarts
+                        and time.perf_counter() - handle.healthy_since
+                        >= self.stable_after_s
+                    ):
+                        handle.restarts = 0  # earned a fresh backoff
+                    handle.state = HEALTHY
+                    continue
+                handle.healthy_since = None
+                in_grace = (
+                    handle.spawned_at is not None
+                    and time.perf_counter() - handle.spawned_at
+                    < self.startup_grace_s
+                )
+                if handle.state == STARTING and in_grace:
+                    continue  # still booting: not a failure yet
+                handle.probe_failures += 1
+                if handle.probe_failures >= self.wedged_after:
+                    # alive but unresponsive: wedged.  SIGKILL — a
+                    # stopped/hung process won't honor SIGTERM
+                    proc = handle.proc
+                    if proc is not None and proc.poll() is None:
+                        try:
+                            proc.kill()
+                            proc.wait(timeout=5.0)
+                        except (OSError, subprocess.TimeoutExpired):
+                            pass
+                    if proc is not None and proc.poll() is not None:
+                        handle.exit_codes.append(proc.returncode)
+                    self._schedule_restart(handle)
+                else:
+                    handle.state = UNHEALTHY
+
+    # -- probes --
+
+    def probe(self, name: str) -> dict | None:
+        """One ``{"op": "stats"}`` round trip to a worker; the stats
+        dict, or None when the worker cannot answer."""
+        handle = self.workers[name]
+        try:
+            row = oneshot(
+                handle.socket_path, {"op": "stats"}, self.probe_timeout_s
+            )
+        except WireError:
+            return None
+        stats = row.get("stats")
+        return stats if isinstance(stats, dict) else None
+
+    def wait_healthy(self, timeout_s: float = 120.0) -> bool:
+        """Block until every non-stopped worker answers probes (fleet
+        boot barrier); False on timeout."""
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            pending = [
+                h.name
+                for h in self.workers.values()
+                if h.state != STOPPED and self.probe(h.name) is None
+            ]
+            if not pending:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def dispatchable(self, name: str) -> bool:
+        """May the router send NEW work to this worker?  (The router
+        additionally applies its own probe-based health view.)"""
+        handle = self.workers.get(name)
+        return (
+            handle is not None
+            and not handle.draining
+            and handle.state not in (STOPPED, DOWN)
+        )
+
+    # -- drain / rolling restart --
+
+    def drain(
+        self,
+        name: str,
+        *,
+        timeout_s: float = 30.0,
+        restart: bool = True,
+        sigterm_timeout_s: float = 5.0,
+    ) -> bool:
+        """Gracefully take one worker out of service: stop dispatch,
+        wait for in-flight work, SIGTERM, optionally respawn.
+
+        Returns True when the worker drained clean (every in-flight
+        request finished before the SIGTERM); False when the timeout
+        forced termination with work possibly still in flight."""
+        handle = self.workers[name]
+        with self._lock:
+            handle.draining = True
+            handle.state = DRAINING
+        clean = False
+        try:
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                stats = self.probe(name)
+                sched = (stats or {}).get("scheduler") or {}
+                worker_idle = (
+                    stats is not None
+                    and sched.get("queue_depth") == 0
+                    and sched.get("in_flight") == 0
+                )
+                router = self.router
+                router_idle = (
+                    router is None or router.outstanding(name) == 0
+                )
+                if stats is None and handle.proc is not None and (
+                    handle.proc.poll() is not None
+                ):
+                    # died mid-drain: in-flight work died with it (the
+                    # router's retries own it now) — not a clean drain
+                    break
+                if worker_idle and router_idle:
+                    clean = True
+                    break
+                time.sleep(0.05)
+            self._terminate(handle, sigterm_timeout_s)
+        finally:
+            with self._lock:
+                if handle.proc is not None and (
+                    handle.proc.poll() is not None
+                ):
+                    handle.exit_codes.append(handle.proc.returncode)
+                handle.proc = None
+                if restart:
+                    self._spawn(handle)
+                else:
+                    handle.state = STOPPED
+                handle.draining = False
+        return clean
+
+    def rolling_restart(self, *, timeout_s: float = 30.0) -> dict:
+        """Drain-and-respawn every worker IN SEQUENCE — at most one
+        replica out of service at a time, the zero-downtime restart."""
+        out = {}
+        for name in list(self.workers):
+            out[name] = self.drain(name, timeout_s=timeout_s, restart=True)
+            # wait for the replacement before touching the next replica
+            deadline = time.perf_counter() + max(timeout_s, 60.0)
+            while time.perf_counter() < deadline:
+                if self.probe(name) is not None:
+                    break
+                time.sleep(0.1)
+        return out
+
+    # -- introspection --
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                name: handle.as_dict()
+                for name, handle in self.workers.items()
+            }
+
+
+def kill_worker(handle: WorkerHandle) -> None:
+    """SIGKILL a supervised worker — the crash fault (faults.py rides
+    this same path for real processes)."""
+    proc = handle.proc
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+
+
+def hang_worker(handle: WorkerHandle) -> None:
+    """SIGSTOP — the wedge fault: the process stays alive but stops
+    answering probes, exercising the supervisor's wedged path."""
+    if handle.pid is not None:
+        os.kill(handle.pid, signal.SIGSTOP)
+
+
+def resume_worker(handle: WorkerHandle) -> None:
+    if handle.pid is not None:
+        os.kill(handle.pid, signal.SIGCONT)

@@ -1,0 +1,137 @@
+"""Fleet-side JSONL wire helpers: one-shot control requests (health
+probes, scrapes) and a per-backend connection pool for the router's
+request path.
+
+Every worker speaks the serve transport (serve/server.py): one JSON
+object per line in, one per line out, in request order.  The fleet tier
+talks to workers over the same contract — a probe is just a session of
+one ``{"op": "stats"}`` line, and a routed request is a session of one
+classification line.  Pooled connections carry ONE in-flight request at
+a time, so the worker's in-order response guarantee is trivially the
+router's per-request correctness; a sick connection is closed, never
+reused.
+
+House rules (script/lint): monotonic clocks only, no print.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from collections import deque
+
+
+class WireError(OSError):
+    """The backend could not answer: connect/send/recv failed or timed
+    out, or the response line was not JSON.  The router treats every
+    WireError the same way — the attempt failed, fail over."""
+
+
+class Connection:
+    """One Unix-socket JSONL connection: send a line, read a line."""
+
+    def __init__(self, path: str, timeout: float):
+        self.path = path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            self._sock.settimeout(timeout)
+            self._sock.connect(path)
+            self._file = self._sock.makefile("rwb")
+        except OSError as exc:
+            self._sock.close()
+            raise WireError(f"connect {path!r}: {exc}") from exc
+
+    def request(self, line: str, timeout: float) -> dict:
+        """Send one request line, block for one response row."""
+        try:
+            self._sock.settimeout(timeout)
+            self._file.write(line.encode("utf-8") + b"\n")
+            self._file.flush()
+            raw = self._file.readline()
+        except OSError as exc:
+            raise WireError(f"io {self.path!r}: {exc}") from exc
+        if not raw:
+            raise WireError(f"{self.path!r}: peer closed the connection")
+        try:
+            row = json.loads(raw.decode("utf-8", errors="replace"))
+            if not isinstance(row, dict):
+                raise ValueError("response must be a JSON object")
+        except ValueError as exc:
+            raise WireError(f"{self.path!r}: bad response: {exc}") from exc
+        return row
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ConnectionPool:
+    """Idle-connection stack for one backend socket.
+
+    ``checkout`` reuses the most recently parked connection (warmest
+    path through the worker's per-connection session threads) or dials
+    a fresh one; ``checkin`` parks a HEALTHY connection back, up to
+    ``max_idle``; a connection that saw any error is closed instead —
+    its stream position is unknowable, and the next request would read
+    the previous one's orphaned response."""
+
+    def __init__(
+        self, path: str, *, max_idle: int = 8, connect_timeout: float = 2.0
+    ):
+        self.path = path
+        self.max_idle = int(max_idle)
+        self.connect_timeout = float(connect_timeout)
+        self._idle: deque[Connection] = deque()
+        self._lock = threading.Lock()
+
+    def checkout(self) -> Connection:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return Connection(self.path, self.connect_timeout)
+
+    def checkin(self, conn: Connection) -> None:
+        with self._lock:
+            if len(self._idle) < self.max_idle:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def discard(self, conn: Connection) -> None:
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = list(self._idle), deque()
+        for conn in idle:
+            conn.close()
+
+    def request(self, payload: dict, timeout: float) -> dict:
+        """Pooled single request/response round trip."""
+        conn = self.checkout()
+        try:
+            row = conn.request(json.dumps(payload), timeout)
+        except WireError:
+            self.discard(conn)
+            raise
+        self.checkin(conn)
+        return row
+
+
+def oneshot(path: str, payload: dict, timeout: float = 2.0) -> dict:
+    """Un-pooled request/response on a fresh connection — the probe
+    primitive (supervisor health checks, stats scrapes).  A fresh
+    connection per probe means a probe can never be queued behind a
+    stuck request on a shared stream."""
+    conn = Connection(path, timeout)
+    try:
+        return conn.request(json.dumps(payload), timeout)
+    finally:
+        conn.close()

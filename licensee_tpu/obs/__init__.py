@@ -15,6 +15,7 @@ import time
 from licensee_tpu.obs.export import (
     NativeProfileSource,
     check_exposition,
+    merge_expositions,
     render_prometheus,
 )
 from licensee_tpu.obs.registry import (
@@ -35,7 +36,8 @@ from licensee_tpu.obs.tracing import (
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Trace", "Tracer", "NullTracer", "get_tracer",
-    "render_prometheus", "check_exposition", "NativeProfileSource",
+    "render_prometheus", "check_exposition", "merge_expositions",
+    "NativeProfileSource",
     "DEFAULT_LATENCY_BUCKETS", "Observability",
 ]
 

@@ -1,7 +1,9 @@
 """Exporters: Prometheus text exposition over a registry snapshot, a
-text-format grammar checker (the CI gate for the exposition), and the
-delta collector that folds the native ``profile_dump()`` counters into
-a registry without double-counting across scrapes.
+text-format grammar checker (the CI gate for the exposition), the
+fleet-level exposition merger (per-worker scrapes -> one
+``worker``-labeled exposition, the router's aggregate), and the delta
+collector that folds the native ``profile_dump()`` counters into a
+registry without double-counting across scrapes.
 
 Prometheus exposition format (text format 0.0.4):
 
@@ -118,6 +120,88 @@ def check_exposition(text: str) -> list[str]:
             problems.append(f"line {i}: does not match exposition grammar: "
                             f"{line!r}")
     return problems
+
+
+# one sample line, split into (name, optional {labels}, value+rest) —
+# the merge rewriter injects a source label between name and labels
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?( .+)$"
+)
+_COMMENT_RE = re.compile(r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*)(.*)$")
+
+
+def merge_expositions(
+    per_source: dict[str, str], label: str = "worker"
+) -> str:
+    """Merge several text expositions into ONE fleet-level exposition,
+    tagging every sample with ``label="<source>"`` — the router's
+    aggregate scrape over its per-worker registries.
+
+    Same-named families merge into one block (HELP/TYPE emitted once,
+    first source wins) because Prometheus rejects a scrape that repeats
+    a TYPE comment; the injected label keeps every worker's series
+    distinct under the shared family name.  Histogram child lines
+    (``_bucket``/``_sum``/``_count``) follow their family via the
+    source exposition's comment structure, so they land in the right
+    block without name surgery."""
+    families: dict[str, dict] = {}  # name -> {help, kind, samples: []}
+    order: list[str] = []
+
+    def family(name: str) -> dict:
+        fam = families.get(name)
+        if fam is None:
+            fam = {"help": None, "kind": None, "samples": []}
+            families[name] = fam
+            order.append(name)
+        return fam
+
+    for source, text in per_source.items():
+        current: dict | None = None
+        escaped = _escape_label(source)
+        for line in (text or "").splitlines():
+            if not line:
+                continue
+            comment = _COMMENT_RE.match(line)
+            if comment:
+                verb, name, rest = comment.groups()
+                current = family(name)
+                if verb == "HELP" and current["help"] is None:
+                    current["help"] = rest
+                elif verb == "TYPE" and current["kind"] is None:
+                    current["kind"] = rest
+                continue
+            sample = _SAMPLE_RE.match(line)
+            if sample is None:
+                continue  # not exposition grammar: drop, never corrupt
+            name, labels, rest = sample.groups()
+            tag = f'{label}="{escaped}"'
+            if labels and re.search(
+                rf'(?:\{{|,){re.escape(label)}="', labels
+            ):
+                # the sample already carries the merge label (a source
+                # exporting per-worker series of its own): injecting a
+                # second copy would emit a duplicate label name, which
+                # a real Prometheus server rejects scrape-wide
+                rewritten = f"{name}{labels}{rest}"
+            elif labels:
+                rewritten = f"{name}{{{tag},{labels[1:-1]}}}{rest}"
+            else:
+                rewritten = f"{name}{{{tag}}}{rest}"
+            # a bare sample before any comment (hand-rolled exporters)
+            # anchors its own family block
+            target = current if current is not None else family(name)
+            target["samples"].append(rewritten)
+    lines: list[str] = []
+    for name in order:
+        fam = families[name]
+        if not fam["samples"]:
+            continue
+        if fam["help"] is not None:
+            lines.append(f"# HELP {name}{fam['help']}")
+        if fam["kind"] is not None:
+            lines.append(f"# TYPE {name}{fam['kind']}")
+        lines.extend(fam["samples"])
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 class NativeProfileSource:

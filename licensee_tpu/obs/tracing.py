@@ -6,6 +6,15 @@ chunk in ``BatchProject``) and its ID rides the request to the response
 row, so one slow request can be followed through
 admission -> cache probe -> featurize -> queue -> device -> respond.
 
+Cross-process propagation: ``Tracer.start(trace_id=...)`` ADOPTS a
+caller-supplied ID instead of minting one.  The fleet router
+(fleet/router.py) mints the ID, records its own ``route`` / ``hedge`` /
+``failover`` spans under it, and forwards it on the wire (the request's
+``"trace"`` field); the worker's MicroBatcher adopts it, so the SAME
+16-hex ID shows up in both processes' ``{"op":"trace"}`` tails — the
+router tail holds the routing story, the worker tail the serving story,
+joined by the ID.
+
 Retention is two-tier, after Dapper's aggressive-head-sampling lesson:
 
 * **head sampling** — every Nth trace (deterministic, not random: a
@@ -138,12 +147,18 @@ class Tracer:
         self.retained = 0
         self.slow = 0
 
-    def start(self, request_id=None) -> Trace:
+    def start(self, request_id=None, trace_id: str | None = None) -> Trace:
+        """Mint a trace — or, with ``trace_id``, ADOPT an upstream hop's
+        ID (the fleet router forwards its ID to the worker so both tails
+        join on it).  Adoption changes only the ID; sampling/retention
+        stay local decisions, so a worker never retains every router-
+        sampled trace just because the ID arrived from outside."""
         with self._lock:
             self._seq += 1
             seq = self._seq
             self.started += 1
-        trace_id = f"{(self._base + seq) & 0xFFFFFFFFFFFFFFFF:016x}"
+        if trace_id is None:
+            trace_id = f"{(self._base + seq) & 0xFFFFFFFFFFFFFFFF:016x}"
         sampled = self._stride > 0 and (seq % self._stride == 0)
         return Trace(trace_id, request_id, time.perf_counter(), sampled)
 
@@ -213,7 +228,7 @@ class NullTracer:
     slow_ms = float("inf")
     log_path = None
 
-    def start(self, request_id=None):
+    def start(self, request_id=None, trace_id=None):
         return None
 
     def finish(self, trace, status="ok") -> bool:

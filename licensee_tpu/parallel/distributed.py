@@ -84,7 +84,11 @@ def apply_visible_chips(env=None) -> list[str] | None:
         return chips
     import sys
 
-    if "jax" in sys.modules:  # best-effort live-backend guard
+    # the live-backend guard protects THIS process's visibility; a
+    # dict env is a dry run or a CHILD's environment (the fleet
+    # supervisor derives worker envs from a process whose own backend
+    # is legitimately live) and cannot change this process's devices
+    if is_process_env and "jax" in sys.modules:
         try:
             from jax._src import xla_bridge
 
@@ -211,6 +215,29 @@ def maybe_initialize(env=None) -> tuple[int, int]:
             jax.distributed.initialize()
         _initialized = True
     return jax.process_index(), jax.process_count()
+
+
+def chips_for_worker(
+    worker_index: int, chips_per_worker: int
+) -> list[str]:
+    """The chip-id subset for co-located worker ``worker_index`` when
+    every worker owns ``chips_per_worker`` chips: the contiguous range
+    ``[i*K, (i+1)*K)``, as the string ids
+    ``LICENSEE_TPU_VISIBLE_CHIPS`` wants.
+
+    One derivation for both co-located launch shapes: the offline
+    manifest-striped ranks (the README launch recipe) and the serving
+    fleet's supervisor (fleet/supervisor.py), which exports the result
+    into each worker's child environment and translates it with
+    ``apply_visible_chips`` over that same dict."""
+    if worker_index < 0:
+        raise ValueError(f"worker_index must be >= 0, got {worker_index!r}")
+    if chips_per_worker < 1:
+        raise ValueError(
+            f"chips_per_worker must be >= 1, got {chips_per_worker!r}"
+        )
+    lo = worker_index * chips_per_worker
+    return [str(c) for c in range(lo, lo + chips_per_worker)]
 
 
 def manifest_stripe(n: int, process_index: int, process_count: int) -> tuple[int, int]:

@@ -23,16 +23,46 @@ from collections import OrderedDict
 from dataclasses import replace
 
 
+def result_bytes(key, result) -> int:
+    """Approximate resident bytes of one cache entry: the key tuple
+    (dispatch + 20-byte sha1), the BlobResult's strings, and the
+    ``closest`` tuples, plus a fixed per-entry overhead for the dict
+    slot and object headers.  An estimate, not a census — the bound
+    exists so a week-long fleet worker's cache stays O(max_bytes), and
+    a consistent estimate bounds exactly as well as a perfect one."""
+    n = 160  # OrderedDict slot + BlobResult header + key tuple overhead
+    for part in (result.key, result.matcher, result.attribution):
+        if part is not None:
+            n += 56 + len(part)
+    if result.closest is not None:
+        n += 56
+        for k, _conf in result.closest:
+            n += 120 + len(k or "")  # (str, float) tuple
+    return n
+
+
 class ResultCache:
     """Thread-safe LRU of content-key -> BlobResult with hit/miss/
-    eviction counters."""
+    eviction counters.
 
-    def __init__(self, capacity: int = 65536):
+    Two independent bounds, either of which evicts LRU-first:
+    ``capacity`` (entry count, as always) and optional ``max_bytes``
+    (estimated resident bytes via :func:`result_bytes`) — entry count
+    alone lets 65536 fat ``closest``-annotated results grow a fleet
+    worker without bound, while the byte bound holds memory flat no
+    matter the per-entry shape."""
+
+    def __init__(self, capacity: int = 65536, max_bytes: int | None = None):
         if capacity < 0:
             raise ValueError(f"capacity must be >= 0, got {capacity!r}")
+        if max_bytes is not None and max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes!r}")
         self.capacity = int(capacity)
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
         self._data: OrderedDict = OrderedDict()
+        self._sizes: dict = {}  # key -> result_bytes at insert time
         self._lock = threading.Lock()
+        self.bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -64,13 +94,22 @@ class ResultCache:
                 else None
             ),
         )
+        size = result_bytes(key, frozen)
+        if self.max_bytes is not None and size > self.max_bytes:
+            return  # one oversized entry must not wipe the whole cache
         with self._lock:
             if key in self._data:
                 self._data.move_to_end(key)
-            elif len(self._data) >= self.capacity:
-                self._data.popitem(last=False)
-                self.evictions += 1
+                self.bytes -= self._sizes[key]
             self._data[key] = frozen
+            self._sizes[key] = size
+            self.bytes += size
+            while len(self._data) > self.capacity or (
+                self.max_bytes is not None and self.bytes > self.max_bytes
+            ):
+                old_key, _ = self._data.popitem(last=False)
+                self.bytes -= self._sizes.pop(old_key)
+                self.evictions += 1
 
     def __len__(self) -> int:
         with self._lock:
@@ -82,6 +121,8 @@ class ResultCache:
             return {
                 "entries": len(self._data),
                 "capacity": self.capacity,
+                "bytes": self.bytes,
+                "max_bytes": self.max_bytes,
                 "hits": hits,
                 "misses": misses,
                 "evictions": self.evictions,
@@ -104,6 +145,10 @@ class ResultCache:
         registry.gauge(
             "serve_cache_capacity", "Result-cache capacity"
         ).set(self.capacity)
+        registry.gauge(
+            "serve_cache_bytes",
+            "Estimated resident bytes of cached results",
+        ).set_fn(lambda: self.bytes)
         events = registry.counter(
             "serve_cache_events_total",
             "Result-cache hits / misses / evictions",

@@ -129,6 +129,7 @@ class MicroBatcher:
         max_delay_ms: float = 5.0,
         queue_depth: int = 1024,
         cache_entries: int = 65536,
+        cache_bytes: int | None = None,
         deadline_ms: float = 0.0,
         threshold: float | None = None,
         buckets: tuple[int, ...] | None = None,
@@ -170,7 +171,7 @@ class MicroBatcher:
             if threshold is None
             else float(threshold)
         )
-        self.cache = ResultCache(cache_entries)
+        self.cache = ResultCache(cache_entries, max_bytes=cache_bytes)
         self.buckets = self._resolve_buckets(buckets)
         # -- observability: one registry + tracer per batcher.  The
         # fresh default registry keeps repeated instances (tests,
@@ -399,11 +400,14 @@ class MicroBatcher:
         filename: str | None = None,
         request_id=None,
         deadline_ms: float | None = None,
+        trace_id: str | None = None,
     ) -> ServeRequest:
         """Admit one request.  Returns a ServeRequest whose ``done``
         event fires when ``result`` is set — immediately for cache hits
         and host-finished rows.  Raises QueueFullError when the bounded
-        queue cannot take another Dice-bound row."""
+        queue cannot take another Dice-bound row.  ``trace_id`` adopts
+        an upstream hop's trace ID (the fleet router's) instead of
+        minting one, joining the two processes' trace tails."""
         t0 = time.perf_counter()
         raw = (
             content
@@ -423,9 +427,9 @@ class MicroBatcher:
             request_id=request_id,
             created=t0,
         )
-        # trace minted at admission: its ID follows the request through
-        # every span below and is echoed on the response row
-        trace = self.obs.tracer.start(request_id)
+        # trace minted (or adopted) at admission: its ID follows the
+        # request through every span below and is echoed on the response
+        trace = self.obs.tracer.start(request_id, trace_id=trace_id)
         if trace is not None:
             req.trace = trace
         ms = self.deadline_ms if deadline_ms is None else deadline_ms
@@ -754,6 +758,7 @@ class MicroBatcher:
                 "max_delay_ms": self.max_delay * 1000.0,
                 "queue_depth": self.queue_depth,
                 "cache_entries": self.cache.capacity,
+                "cache_bytes": self.cache.max_bytes,
                 "deadline_ms": self.deadline_ms,
                 "buckets": list(self.buckets),
                 "threshold": self.threshold,

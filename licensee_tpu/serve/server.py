@@ -9,7 +9,9 @@ coalesces them), while a writer thread blocks only on the OLDEST
 in-flight request — completed younger requests queue behind it.
 
 Request lines:
-  {"content": "...", "id": ..., "filename": ..., "deadline_ms": ...}
+  {"content": "...", "id": ..., "filename": ..., "deadline_ms": ...,
+   "trace": "16-hex"}                # trace: adopt an upstream hop's
+                                     # trace ID (the fleet router's)
   {"content_b64": "...", ...}        # raw bytes, base64
   {"op": "stats", "id": ...}         # dump scheduler/cache/latency JSON
   {"op": "stats", "format": "prometheus", "id": ...}  # text exposition
@@ -35,11 +37,17 @@ from __future__ import annotations
 import base64
 import json
 import os
+import re
+import socket
 import socketserver
+import stat
 import threading
 from collections import deque
 
 from licensee_tpu.serve.scheduler import MicroBatcher, QueueFullError
+
+# an upstream hop's trace ID (the fleet router's): 16 lowercase hex
+TRACE_ID_RE = re.compile(r"\A[0-9a-f]{16}\Z")
 
 
 def _render_result(req) -> dict:
@@ -192,12 +200,23 @@ class _Session:
                 },
             )
             return
+        trace_id = msg.get("trace")
+        if trace_id is not None and (
+            not isinstance(trace_id, str) or not TRACE_ID_RE.match(trace_id)
+        ):
+            self._emit(
+                "raw",
+                {"id": rid,
+                 "error": "bad_request: trace must be 16 lowercase hex"},
+            )
+            return
         try:
             req = self.batcher.submit(
                 content,
                 filename=filename,
                 request_id=rid,
                 deadline_ms=deadline_ms,
+                trace_id=trace_id,
             )
         except QueueFullError as exc:
             row = {
@@ -254,18 +273,93 @@ def serve_stdio(batcher: MicroBatcher, stdin=None, stdout=None) -> dict:
     return serve_session(batcher, stdin, write_line)
 
 
-class UnixServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
-    """One JSONL session per connection, all sharing one batcher (and
-    therefore one cache and one device pipeline)."""
+class SocketInUseError(OSError):
+    """The Unix socket path is owned by a LIVE server (a connect
+    succeeded), or by something that is not a socket at all — binding
+    over it would hijack a running worker or destroy a user's file."""
+
+
+def prepare_unix_socket_path(path: str) -> None:
+    """Make ``path`` bindable: unlink a STALE socket file (the leftover
+    of a SIGKILLed worker — bind would otherwise fail with EADDRINUSE
+    forever), but refuse to touch a live server's socket or a
+    non-socket file.  Liveness is probed by connecting: a dead owner's
+    socket refuses (ECONNREFUSED), a live one accepts."""
+    try:
+        st = os.lstat(path)
+    except OSError:
+        return  # nothing there: bind will create it
+    if not stat.S_ISSOCK(st.st_mode):
+        raise SocketInUseError(
+            f"{path!r} exists and is not a socket; refusing to unlink"
+        )
+    import errno
+
+    probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        probe.settimeout(1.0)
+        probe.connect(path)
+    except socket.timeout:
+        # a listener that is merely SLOW to accept (wedged worker with
+        # a full backlog) is still an owner — hijacking it on a probe
+        # timeout would be exactly the theft this function prevents
+        raise SocketInUseError(
+            f"{path!r}: liveness probe timed out (a wedged owner?); "
+            "refusing to unlink"
+        ) from None
+    except OSError as exc:
+        if exc.errno == errno.ENOENT:
+            return  # unlinked between lstat and connect: bindable now
+        if exc.errno not in (errno.ECONNREFUSED, errno.ECONNRESET):
+            # EACCES and friends: we cannot PROVE the owner is dead,
+            # so the conservative answer is refusal, not unlink
+            raise SocketInUseError(
+                f"{path!r}: liveness probe failed ({exc}); "
+                "refusing to unlink"
+            ) from exc
+        # ECONNREFUSED/ECONNRESET: provably no accepting owner — the
+        # leftover of a SIGKILLed worker.  Reclaim the path.
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    else:
+        raise SocketInUseError(
+            f"{path!r} is owned by a live server; refusing to unlink"
+        )
+    finally:
+        probe.close()
+
+
+class JsonlUnixServer(
+    socketserver.ThreadingMixIn, socketserver.UnixStreamServer
+):
+    """A threading Unix-socket server speaking one JSONL session per
+    connection.  Subclasses implement ``run_session(lines, write_line)``
+    — the serve worker runs the batcher session, the fleet router runs
+    its routing session, over the same transport plumbing."""
 
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, path: str, batcher: MicroBatcher):
-        if os.path.exists(path):
-            os.unlink(path)  # a stale socket from a dead server
-        self.batcher = batcher
+    def __init__(self, path: str):
+        prepare_unix_socket_path(path)
         super().__init__(path, _UnixHandler)
+
+    def run_session(self, lines, write_line) -> None:
+        raise NotImplementedError
+
+
+class UnixServer(JsonlUnixServer):
+    """One JSONL session per connection, all sharing one batcher (and
+    therefore one cache and one device pipeline)."""
+
+    def __init__(self, path: str, batcher: MicroBatcher):
+        self.batcher = batcher
+        super().__init__(path)
+
+    def run_session(self, lines, write_line) -> None:
+        serve_session(self.batcher, lines, write_line)
 
 
 class _UnixHandler(socketserver.StreamRequestHandler):
@@ -278,12 +372,29 @@ class _UnixHandler(socketserver.StreamRequestHandler):
                 self.wfile.flush()
 
         lines = (raw.decode("utf-8", errors="replace") for raw in self.rfile)
-        serve_session(self.server.batcher, lines, write_line)
+        self.server.run_session(lines, write_line)
 
 
 def serve_unix(batcher: MicroBatcher, path: str) -> None:
-    """Serve forever on a Unix domain socket (Ctrl-C to stop)."""
+    """Serve forever on a Unix domain socket (Ctrl-C or SIGTERM to
+    stop).  SIGTERM triggers a clean shutdown — the fleet supervisor's
+    drain protocol ends with SIGTERM and expects the socket file
+    unlinked and in-flight sessions completed, not an abort."""
+    import signal
+
     with UnixServer(path, batcher) as server:
+        def _term(*_):
+            # shutdown() blocks until serve_forever exits, and the
+            # handler runs ON serve_forever's thread — spawn the call
+            # or the two deadlock waiting on each other
+            threading.Thread(target=server.shutdown, daemon=True).start()
+
+        try:
+            # only the main thread may set signal handlers; anywhere
+            # else (tests driving serve_unix from a thread) skip it
+            signal.signal(signal.SIGTERM, _term)
+        except ValueError:
+            pass
         try:
             server.serve_forever(poll_interval=0.2)
         finally:

@@ -94,6 +94,16 @@ def _fat_details() -> dict:
                 "uptime_s": 99999.999,
             },
         },
+        "fleet": {
+            "requests": 99_999_999,
+            "rps_1w": 99_999_999.9,
+            "errors_1w": 99_999_999,
+            "rps_2w": 99_999_999.9,
+            "errors_2w": 99_999_999,
+            "failover_errors": 99_999_999,
+            "failover_max_stall_s": 99999.999,
+            "restart_recovery_s": 99999.999,
+        },
         "host_model": {
             "z" * 30: 9.9,
             "featurize_us_per_blob": 99_999_999.9,
@@ -151,6 +161,9 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["at_scale_auto"]["files_per_sec"] == 8_748_728.9
     assert d["e2e_files_per_sec"]["readme"] == 8_748_728.9
     assert d["serve_path"]["cached_rps"] == 99_999_999.9
+    assert d["fleet"]["rps_2w"] == 99_999_999.9
+    assert d["fleet"]["failover_errors"] == 99_999_999
+    assert d["fleet"]["restart_recovery_s"] == 99999.999
     assert d["obs"]["prom_lines"] == 99_999_999
     assert d["obs"]["traces"] == 99_999_999
     assert d["host_model"]["featurize_us_per_blob"] == 99_999_999.9
@@ -165,9 +178,10 @@ def test_headline_survives_missing_rows(bench_mod):
     balloon."""
     details = _fat_details()
     for k in ("end_to_end_1m", "end_to_end_1m_auto", "scalar_agreement",
-              "end_to_end_readme", "serve_path"):
+              "end_to_end_readme", "serve_path", "fleet"):
         details[k] = None
     headline = bench_mod.make_headline("m", 1.0, 1.0, details)
     assert headline["details"]["at_scale_license"]["resume_ok"] is None
     assert headline["details"]["e2e_files_per_sec"]["readme"] is None
     assert headline["details"]["serve_path"]["cached_rps"] is None
+    assert headline["details"]["fleet"]["rps_2w"] is None

@@ -255,3 +255,161 @@ def test_batch_detect_output_preflight(tmp_path, capsys):
     inside = blocker / "out.jsonl"
     assert main(["batch-detect", str(manifest), "--output", str(inside)]) == 1
     assert "is not a directory" in capsys.readouterr().err
+
+
+def _serve_worker(tmp_path, name):
+    """A live in-process serve worker on a Unix socket (for stats
+    scrape tests); returns (socket_path, server, thread, batcher)."""
+    import threading
+
+    from licensee_tpu.serve.scheduler import MicroBatcher
+    from licensee_tpu.serve.server import UnixServer
+
+    path = str(tmp_path / f"{name}.sock")
+    batcher = MicroBatcher(max_delay_ms=5.0, buckets=(4,), mesh=None)
+    server = UnixServer(path, batcher)
+    thread = threading.Thread(
+        target=server.serve_forever, kwargs={"poll_interval": 0.05},
+        daemon=True,
+    )
+    thread.start()
+    return path, server, thread, batcher
+
+
+def test_stats_multiple_sockets_print_one_merged_table(tmp_path, capsys):
+    """The fleet operator view: two --socket flags produce ONE table
+    with a row per worker."""
+    mit = fixture_contents("mit/LICENSE.txt")
+    workers = []
+    try:
+        for name in ("alpha", "beta"):
+            workers.append(_serve_worker(tmp_path, name))
+        workers[0][3].classify(mit, "LICENSE")  # alpha has 1 completed
+        rc, out = run_cli(
+            ["stats", "--socket", workers[0][0],
+             "--socket", workers[1][0]],
+            capsys,
+        )
+    finally:
+        for _path, server, thread, batcher in workers:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+            batcher.close()
+    assert rc == 0
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert lines[0].split()[:4] == ["WORKER", "UP_S", "DONE", "Q"]
+    rows = {ln.split()[0]: ln.split() for ln in lines[1:]}
+    assert set(rows) == {"alpha.sock", "beta.sock"}
+    assert rows["alpha.sock"][2] == "1"  # DONE column
+    assert rows["beta.sock"][2] == "0"
+
+
+def test_stats_watch_redraws_and_computes_rate(tmp_path, capsys):
+    """--watch re-scrapes at the interval; the second frame carries a
+    REQ_S column derived from the completed-counter delta."""
+    mit = fixture_contents("mit/LICENSE.txt")
+    path, server, thread, batcher = _serve_worker(tmp_path, "w")
+    try:
+        batcher.classify(mit, "LICENSE")
+        rc, out = run_cli(
+            ["stats", "--socket", path, "--watch", "0.1",
+             "--watch-iterations", "2"],
+            capsys,
+        )
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5.0)
+        batcher.close()
+    assert rc == 0
+    frames = [ln for ln in out.splitlines() if ln.startswith("WORKER")]
+    assert len(frames) == 2  # two redraws
+    data_rows = [ln for ln in out.splitlines() if ln.startswith("w.sock")]
+    assert len(data_rows) == 2
+    # first frame has no previous sample to difference against
+    assert data_rows[0].split()[-1] == "-"
+    assert data_rows[1].split()[-1] != "down"
+
+
+def test_stats_down_worker_renders_as_down_row(tmp_path, capsys):
+    rc, out = run_cli(
+        ["stats", "--socket", str(tmp_path / "gone-a.sock"),
+         "--socket", str(tmp_path / "gone-b.sock")],
+        capsys,
+    )
+    assert rc == 0
+    rows = [ln for ln in out.splitlines() if "down" in ln]
+    assert len(rows) == 2
+
+
+def test_stats_multi_socket_prometheus_merges_with_worker_labels(
+    tmp_path, capsys
+):
+    from licensee_tpu.obs import check_exposition
+
+    workers = []
+    try:
+        for name in ("alpha", "beta"):
+            workers.append(_serve_worker(tmp_path, name))
+        rc, out = run_cli(
+            ["stats", "--socket", workers[0][0],
+             "--socket", workers[1][0], "--format", "prometheus"],
+            capsys,
+        )
+    finally:
+        for _path, server, thread, batcher in workers:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+            batcher.close()
+    assert rc == 0
+    assert check_exposition(out) == []
+    assert 'worker="alpha.sock"' in out
+    assert 'worker="beta.sock"' in out
+    assert out.count("# TYPE serve_queue_depth gauge") == 1
+
+
+def test_stats_table_rows_unit():
+    from licensee_tpu.cli.main import stats_table_rows
+
+    snaps = {
+        "w0": {
+            "uptime_s": 12.3,
+            "scheduler": {"completed": 30, "queue_depth": 2,
+                          "in_flight": 1},
+            "cache": {"hit_rate": 0.25},
+            "latency_ms": {"total": {"p50_ms": 1.5, "p99_ms": 9.0}},
+        },
+        "w1": None,  # unreachable
+    }
+    prev = {
+        "w0": {"scheduler": {"completed": 10}},
+    }
+    rows = stats_table_rows(snaps, prev, dt=2.0)
+    assert rows[0][0] == "WORKER"
+    w0 = rows[1]
+    assert w0[0] == "w0" and w0[2] == "30" and w0[5] == "25.0"
+    assert w0[-1] == "10.0"  # (30-10)/2s
+    assert rows[2][0] == "w1" and rows[2][-1] == "down"
+
+
+def test_fleet_selftest_flag_parses():
+    from licensee_tpu.cli.main import build_parser
+
+    args = build_parser().parse_args(["fleet", "--selftest", "--stub"])
+    assert args.selftest and args.stub
+    args = build_parser().parse_args(
+        ["fleet", "--workers", "4", "--chips-per-worker", "2",
+         "--socket", "/tmp/f.sock", "--hedge-ms", "auto"]
+    )
+    assert args.workers == 4
+    assert args.chips_per_worker == 2
+    assert args.hedge_ms == "auto"
+
+
+def test_fleet_requires_socket_or_selftest(capsys):
+    rc = main(["fleet"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "--socket" in err

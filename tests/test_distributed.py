@@ -269,7 +269,7 @@ def test_apply_visible_chips_unset_is_noop():
     assert distributed.apply_visible_chips(env={}) is None
 
 
-def test_apply_visible_chips_rejects_empty_and_live_backend():
+def test_apply_visible_chips_rejects_empty_and_live_backend(monkeypatch):
     from licensee_tpu.parallel import distributed
 
     with pytest.raises(ValueError):
@@ -277,16 +277,23 @@ def test_apply_visible_chips_rejects_empty_and_live_backend():
             env={"LICENSEE_TPU_VISIBLE_CHIPS": " , "}
         )
     # this test process has a live CPU backend (conftest) and no prior
-    # successful apply: setting chips now must refuse loudly, not
-    # silently fail to take effect
+    # successful apply: setting chips on the PROCESS env now must
+    # refuse loudly, not silently fail to take effect
     if distributed._chips_applied is None:
         import jax
 
         jax.devices()  # ensure the backend really is live
+        monkeypatch.setenv("LICENSEE_TPU_VISIBLE_CHIPS", "0")
         with pytest.raises(RuntimeError):
-            distributed.apply_visible_chips(
-                env={"LICENSEE_TPU_VISIBLE_CHIPS": "0"}
-            )
+            distributed.apply_visible_chips()
+    # a DICT env is a dry run or a CHILD's environment (the fleet
+    # supervisor derives worker envs from a process whose own backend
+    # is live): the guard must NOT fire, and the derivation lands in
+    # the dict only
+    env = {"LICENSEE_TPU_VISIBLE_CHIPS": "0,1"}
+    assert distributed.apply_visible_chips(env=env) == ["0", "1"]
+    assert env["TPU_VISIBLE_DEVICES"] == "0,1"
+    assert os.environ.get("TPU_VISIBLE_DEVICES") != "0,1"
 
 
 def test_apply_visible_chips_exports_runtime_vars():
@@ -417,3 +424,21 @@ def test_apply_visible_chips_dict_env_never_touches_os_environ():
     )
     assert result.returncode == 0, result.stderr[-2000:]
     assert json.loads(result.stdout.strip().splitlines()[-1]) == {"ok": True}
+
+
+def test_chips_for_worker_partitions_disjoint_contiguous_ranges():
+    """The fleet supervisor and the offline co-located launch derive
+    worker chip subsets from ONE function: contiguous, disjoint,
+    complete, in LICENSEE_TPU_VISIBLE_CHIPS string form."""
+    from licensee_tpu.parallel.distributed import chips_for_worker
+
+    assert chips_for_worker(0, 2) == ["0", "1"]
+    assert chips_for_worker(3, 2) == ["6", "7"]
+    assert chips_for_worker(1, 1) == ["1"]
+    # a 4-worker x 2-chip fleet tiles the v5e-8 host exactly
+    claimed = [c for w in range(4) for c in chips_for_worker(w, 2)]
+    assert claimed == [str(c) for c in range(8)]
+    with pytest.raises(ValueError):
+        chips_for_worker(-1, 2)
+    with pytest.raises(ValueError):
+        chips_for_worker(0, 0)

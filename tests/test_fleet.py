@@ -1,0 +1,532 @@
+"""The fleet tier (licensee_tpu/fleet/): supervisor restart/backoff/
+drain, router least-loaded dispatch, failover under SIGKILL, hedged
+requests, backpressure failover, trace-ID propagation, and the merged
+Prometheus exposition.
+
+All CPU-only (JAX_PLATFORMS=cpu via conftest) and fast: workers are
+REAL subprocesses speaking the real JSONL protocol over real Unix
+sockets — but they are the protocol-faithful stub from fleet/faults.py,
+so a worker boots in ~0.3 s instead of a JAX import, and SIGKILL is a
+real SIGKILL."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from licensee_tpu.fleet import faults
+from licensee_tpu.fleet.router import FrontServer, Router
+from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+from licensee_tpu.fleet.wire import WireError, oneshot
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB_ENV = {**os.environ, "PYTHONPATH": REPO_ROOT}
+
+
+def stub_argv(sock: str, name: str = "stub", *extra: str) -> list[str]:
+    return [
+        sys.executable, "-m", "licensee_tpu.fleet.faults",
+        "--socket", sock, "--name", name, *extra,
+    ]
+
+
+def wait_answering(sock: str, timeout: float = 15.0) -> None:
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        try:
+            oneshot(sock, {"op": "stats"}, 1.0)
+            return
+        except WireError:
+            time.sleep(0.02)
+    raise AssertionError(f"stub on {sock} never answered")
+
+
+class StubFleet:
+    """Spawn stub workers on demand; kill whatever survives the test."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(self, name: str, *extra: str) -> str:
+        sock = str(self.tmp_path / f"{name}.sock")
+        self.procs[name] = subprocess.Popen(
+            stub_argv(sock, name, *extra), env=STUB_ENV,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        wait_answering(sock)
+        return sock
+
+    def cleanup(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+@pytest.fixture()
+def stub_fleet(tmp_path):
+    fleet = StubFleet(tmp_path)
+    yield fleet
+    fleet.cleanup()
+
+
+# -- router: routing, failover, hedging, backpressure --
+
+
+def test_router_dispatches_to_least_loaded(stub_fleet):
+    # w_idle reports queue_depth 0, w_busy a standing queue of 50: every
+    # request must land on the idle worker
+    sockets = {
+        "w_busy": stub_fleet.spawn("w_busy", "--report-load", "50"),
+        "w_idle": stub_fleet.spawn("w_idle"),
+    }
+    with Router(sockets, probe_interval_s=0.05) as router:
+        rows = [
+            router.dispatch({"id": i, "content": f"b{i}"})
+            for i in range(6)
+        ]
+    assert all(r.get("key") == "stub-mit" for r in rows)
+    assert {r["worker"] for r in rows} == {"w_idle"}
+
+
+def test_router_failover_on_worker_sigkill(stub_fleet):
+    """Continuous load, one worker SIGKILLed mid-stream: zero client-
+    visible errors — the dead worker's in-flight requests retry on the
+    survivor."""
+    sockets = {
+        name: stub_fleet.spawn(name, "--service-ms", "20")
+        for name in ("w0", "w1")
+    }
+    with Router(
+        sockets, probe_interval_s=0.05, request_timeout_s=10.0,
+        dispatch_wait_s=15.0,
+    ) as router:
+        rows: list[dict] = []
+        lock = threading.Lock()
+
+        def send(k: int) -> None:
+            for i in range(k):
+                row = router.dispatch({"id": i, "content": f"c{i}"})
+                with lock:
+                    rows.append(row)
+
+        threads = [
+            threading.Thread(target=send, args=(25,)) for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)  # several requests in flight on each worker
+        faults.kill(stub_fleet.procs["w0"].pid)
+        for t in threads:
+            t.join(timeout=60.0)
+        assert len(rows) == 100
+        errors = [r for r in rows if r.get("error")]
+        assert errors == []
+        stats = router.stats()
+        assert stats["router"]["failovers"] >= 1
+        assert stats["backends"]["w0"]["healthy"] is False
+
+
+def test_router_fails_over_on_queue_full(stub_fleet):
+    sockets = {
+        "w_full": stub_fleet.spawn("w_full", "--queue-full"),
+        "w_ok": stub_fleet.spawn("w_ok", "--report-load", "10"),
+    }
+    # w_full reports load 0 so it is picked FIRST; its queue_full must
+    # fail over to w_ok rather than reach the client
+    with Router(sockets, probe_interval_s=0.05) as router:
+        row = router.dispatch({"id": 1, "content": "x"})
+        assert row.get("key") == "stub-mit"
+        assert row["worker"] == "w_ok"
+        stats = router.stats()["router"]
+        assert stats["queue_full_failovers"] >= 1
+
+
+def test_router_surfaces_queue_full_when_every_replica_sheds(stub_fleet):
+    sockets = {
+        "a": stub_fleet.spawn("a", "--queue-full"),
+        "b": stub_fleet.spawn("b", "--queue-full"),
+    }
+    with Router(sockets, probe_interval_s=0.05) as router:
+        row = router.dispatch({"id": 9, "content": "x"})
+    assert row["error"] == "queue_full"
+    assert row["retry_after"] > 0
+    assert row["id"] == 9
+
+
+def test_hedged_request_winner_and_loser_accounting(stub_fleet):
+    """Slow primary + fixed 50 ms hedge: the duplicate on the fast twin
+    answers first (hedges_won); with the slow/fast roles flipped the
+    primary answers first (hedges_lost)."""
+    sockets = {
+        "w_slow": stub_fleet.spawn("w_slow", "--service-ms", "800"),
+        "w_fast": stub_fleet.spawn("w_fast", "--report-load", "5"),
+    }
+    # load 0 vs 5: the slow worker is picked first, the fast one hedges
+    with Router(
+        sockets, probe_interval_s=0.05, hedge_ms=50.0,
+        request_timeout_s=10.0,
+    ) as router:
+        t0 = time.perf_counter()
+        row = router.dispatch({"id": 1, "content": "hedge-me"})
+        dt = time.perf_counter() - t0
+        assert row.get("key") == "stub-mit"
+        assert row["worker"] == "w_fast"  # the hedge won
+        assert dt < 5.0  # nowhere near the slow worker's 800 ms
+        stats = router.stats()["router"]
+        assert stats["hedges_started"] == 1
+        assert stats["hedges_won"] == 1
+        assert stats["hedges_lost"] == 0
+
+    # flipped roles: primary answers at 200 ms — after the 50 ms hedge
+    # fires (so a hedge definitely starts) but long before the hedge
+    # target's 800 ms service — the primary wins, the hedge loses
+    sockets_flipped = {
+        "w_mid": stub_fleet.spawn("w_mid", "--service-ms", "200"),
+        "w_slow2": stub_fleet.spawn("w_slow2", "--service-ms", "800",
+                                    "--report-load", "5"),
+    }
+    with Router(
+        sockets_flipped, probe_interval_s=0.05, hedge_ms=50.0,
+        request_timeout_s=10.0,
+    ) as router:
+        row = router.dispatch({"id": 2, "content": "hedge-me-2"})
+        assert row["worker"] == "w_mid"  # the primary won
+        stats = router.stats()["router"]
+        assert stats["hedges_started"] == 1
+        assert stats["hedges_lost"] == 1
+        assert stats["hedges_won"] == 0
+
+
+def test_hedge_rescues_a_hung_worker(stub_fleet):
+    """A worker that goes silent AFTER its health probe looks fine is
+    exactly what hedging exists for (health checks cannot see it)."""
+    sockets = {
+        "w_wedge": stub_fleet.spawn("w_wedge", "--hang-after", "1"),
+        "w_live": stub_fleet.spawn("w_live", "--report-load", "5"),
+    }
+    with Router(
+        sockets, probe_interval_s=0.05, hedge_ms=50.0,
+        request_timeout_s=20.0,
+    ) as router:
+        first = router.dispatch({"id": 1, "content": "warm"})
+        assert first["worker"] == "w_wedge"  # answer #1, then silence
+        t0 = time.perf_counter()
+        row = router.dispatch({"id": 2, "content": "now-hangs"})
+        dt = time.perf_counter() - t0
+    assert row.get("key") == "stub-mit"
+    assert row["worker"] == "w_live"
+    assert dt < 10.0  # hedge delay + service, not the request timeout
+
+
+def test_trace_id_propagates_router_to_worker(stub_fleet):
+    """The router-minted 16-hex ID must appear on the client row, in
+    the router's trace tail (with a route span), and in the WORKER's
+    own trace tail — the cross-process join."""
+    sockets = {"w0": stub_fleet.spawn("w0")}
+    with Router(sockets, probe_interval_s=0.05, trace_sample=1.0) as router:
+        rows = [
+            router.dispatch({"id": i, "content": f"t{i}"})
+            for i in range(3)
+        ]
+        router_tail = router.trace_tail(10)
+    client_ids = [r.get("trace") for r in rows]
+    assert all(
+        isinstance(t, str) and len(t) == 16 for t in client_ids
+    )
+    assert len(set(client_ids)) == 3
+    routed = {
+        t["trace"]: [s["name"] for s in t["spans"]] for t in router_tail
+    }
+    for trace_id in client_ids:
+        assert "route" in routed[trace_id]
+    worker_tail = oneshot(sockets["w0"], {"op": "trace", "n": 10}, 2.0)
+    worker_ids = {t["trace"] for t in worker_tail["traces"]}
+    assert set(client_ids) <= worker_ids
+
+
+def test_front_socket_session_end_to_end(stub_fleet, tmp_path):
+    """A client session through the FrontServer: ordered responses,
+    fleet stats verb, merged prometheus verb, trace verb, bad lines."""
+    sockets = {"w0": stub_fleet.spawn("w0")}
+    front = str(tmp_path / "front.sock")
+    with Router(sockets, probe_interval_s=0.05, trace_sample=1.0) as router:
+        server = FrontServer(front, router)
+        thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        thread.start()
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(front)
+                s.settimeout(10.0)
+                f = s.makefile("rwb")
+                for row in (
+                    {"id": 1, "content": "one"},
+                    {"id": 2, "content": "two"},
+                    {"id": 3, "op": "stats"},
+                    {"id": 4, "op": "stats", "format": "prometheus"},
+                    {"id": 5, "op": "trace", "n": 5},
+                    {"id": 6, "op": "nope"},
+                ):
+                    f.write(json.dumps(row).encode() + b"\n")
+                f.flush()
+                rows = [json.loads(f.readline()) for _ in range(6)]
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+    assert [r["id"] for r in rows] == [1, 2, 3, 4, 5, 6]
+    assert rows[0]["key"] == "stub-mit" and rows[1]["key"] == "stub-mit"
+    fleet_stats = rows[2]["stats"]
+    assert fleet_stats["router"]["ok"] >= 2
+    assert fleet_stats["backends"]["w0"]["healthy"] is True
+    from licensee_tpu.obs import check_exposition
+
+    merged = rows[3]["prometheus"]
+    assert check_exposition(merged) == []
+    assert 'worker="w0"' in merged and 'worker="router"' in merged
+    # the router's per-backend series use a "backend" label so the
+    # merge's injected worker label is never duplicated
+    assert 'fleet_backend_requests_total{worker="router",backend="w0"' in (
+        merged
+    )
+    for line in merged.splitlines():
+        assert line.count('worker="') <= 1, line
+    assert rows[4]["traces"]
+    assert rows[5]["error"].startswith("bad_request")
+
+
+# -- supervisor: restart, backoff, wedge, drain --
+
+
+def test_supervisor_restarts_crashed_worker(tmp_path):
+    sockets = {"w0": str(tmp_path / "w0.sock")}
+    with Supervisor(
+        sockets,
+        argv_for=lambda name, sock: stub_argv(sock, name),
+        env_for=lambda name, chips: dict(STUB_ENV),
+        probe_interval_s=0.05, backoff_base_s=0.1, backoff_max_s=1.0,
+        startup_grace_s=15.0,
+    ) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        first_pid = supervisor.workers["w0"].pid
+        faults.kill(first_pid)
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            handle = supervisor.workers["w0"]
+            if (
+                handle.restarts >= 1
+                and handle.pid not in (None, first_pid)
+                and supervisor.probe("w0") is not None
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"w0 never restarted: {supervisor.status()}"
+            )
+        assert supervisor.workers["w0"].exit_codes[-1] == -9
+
+
+def test_supervisor_backoff_schedule_is_exponential_and_capped():
+    sup = Supervisor(
+        {"w0": "/nonexistent.sock"},
+        argv_for=lambda name, sock: ["true"],
+        backoff_base_s=0.25, backoff_max_s=10.0,
+    )
+    delays = [sup.backoff_delay_s(n) for n in range(8)]
+    assert delays[:4] == [0.25, 0.5, 1.0, 2.0]
+    assert delays[-1] == 10.0  # capped
+    assert all(b >= a for a, b in zip(delays, delays[1:]))
+
+
+def test_supervisor_kills_wedged_worker(tmp_path):
+    """SIGSTOP: the process is alive, probes time out — the supervisor
+    must declare it wedged, SIGKILL it, and bring up a replacement."""
+    sockets = {"w0": str(tmp_path / "w0.sock")}
+    with Supervisor(
+        sockets,
+        argv_for=lambda name, sock: stub_argv(sock, name),
+        env_for=lambda name, chips: dict(STUB_ENV),
+        probe_interval_s=0.05, probe_timeout_s=0.3, wedged_after=2,
+        backoff_base_s=0.1, backoff_max_s=1.0, startup_grace_s=15.0,
+    ) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        frozen_pid = supervisor.workers["w0"].pid
+        faults.hang(frozen_pid)
+        deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < deadline:
+            handle = supervisor.workers["w0"]
+            if handle.pid not in (None, frozen_pid) and (
+                supervisor.probe("w0") is not None
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"wedged w0 never replaced: {supervisor.status()}"
+            )
+        assert supervisor.workers["w0"].restarts >= 1
+
+
+def test_drain_completes_in_flight_before_sigterm(tmp_path):
+    """Drain must (1) stop the router dispatching to the worker,
+    (2) wait for the in-flight request to answer, and only then
+    (3) SIGTERM — the client sees a verdict, never a reset."""
+    sockets = {"w0": str(tmp_path / "w0.sock")}
+    with Supervisor(
+        sockets,
+        argv_for=lambda name, sock: stub_argv(
+            sock, name, "--service-ms", "400"
+        ),
+        env_for=lambda name, chips: dict(STUB_ENV),
+        probe_interval_s=0.05, startup_grace_s=15.0,
+    ) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        with Router(
+            sockets, supervisor=supervisor, probe_interval_s=0.05,
+        ) as router:
+            result: dict = {}
+
+            def slow_request() -> None:
+                result.update(router.dispatch(
+                    {"id": 1, "content": "slow"}
+                ))
+
+            t = threading.Thread(target=slow_request)
+            t.start()
+            # the request is mid-service (400 ms) when drain begins
+            time.sleep(0.1)
+            t_drain = time.perf_counter()
+            clean = supervisor.drain("w0", timeout_s=10.0, restart=False)
+            drain_s = time.perf_counter() - t_drain
+            t.join(timeout=10.0)
+            assert clean is True
+            assert result.get("key") == "stub-mit"  # in-flight answered
+            assert drain_s >= 0.2  # drain WAITED for the in-flight work
+            assert supervisor.workers["w0"].state == "stopped"
+            assert supervisor.workers["w0"].exit_codes[-1] == -15  # SIGTERM
+            # a drained (stopped) worker must never be picked again
+            assert router.pick() is None
+
+
+def test_worker_env_exports_chip_subset_via_apply_visible_chips():
+    """The fleet worker env contract IS the offline co-located
+    contract: LICENSEE_TPU_VISIBLE_CHIPS -> TPU_VISIBLE_DEVICES +
+    the CPU-rehearsal XLA flag, derived in the CHILD env dict."""
+    env = worker_env({"PATH": "/bin"}, ["4", "5"])
+    assert env["LICENSEE_TPU_VISIBLE_CHIPS"] == "4,5"
+    assert env["TPU_VISIBLE_DEVICES"] == "4,5"
+    assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+    assert "PYTHONPATH" in env
+    # and the translation never leaked into THIS process
+    assert os.environ.get("TPU_VISIBLE_DEVICES") != "4,5"
+
+
+def test_supervisor_assigns_disjoint_chip_ranges(tmp_path):
+    sup = Supervisor(
+        {
+            "w0": str(tmp_path / "w0.sock"),
+            "w1": str(tmp_path / "w1.sock"),
+        },
+        argv_for=lambda name, sock: ["true"],
+        chips_per_worker=2,
+    )
+    chips = [
+        sup.workers[w].env["LICENSEE_TPU_VISIBLE_CHIPS"]
+        for w in ("w0", "w1")
+    ]
+    assert chips == ["0,1", "2,3"]
+    devices = [
+        sup.workers[w].env["TPU_VISIBLE_DEVICES"] for w in ("w0", "w1")
+    ]
+    assert devices == ["0,1", "2,3"]
+
+
+# -- merged exposition (obs/export.py merge) --
+
+
+def test_merge_expositions_labels_and_grammar():
+    from licensee_tpu.obs import (
+        MetricsRegistry,
+        check_exposition,
+        merge_expositions,
+        render_prometheus,
+    )
+
+    per = {}
+    for worker in ("w0", "w1"):
+        reg = MetricsRegistry()
+        reg.counter("serve_requests_total", "Reqs", labels=("event",)) \
+            .labels(event="submitted").inc(3)
+        reg.gauge("serve_queue_depth", "Depth").set(2)
+        reg.histogram("serve_stage_seconds", "Lat", labels=("stage",)) \
+            .labels(stage="total").observe(0.01)
+        per[worker] = render_prometheus(reg)
+    merged = merge_expositions(per)
+    assert check_exposition(merged) == []
+    assert (
+        'serve_requests_total{worker="w0",event="submitted"} 3' in merged
+    )
+    assert (
+        'serve_requests_total{worker="w1",event="submitted"} 3' in merged
+    )
+    assert 'serve_queue_depth{worker="w1"} 2' in merged
+    # histogram children land under their family with the label injected
+    assert 'serve_stage_seconds_bucket{worker="w0",stage="total",' in merged
+    assert 'serve_stage_seconds_count{worker="w0",stage="total"} 1' in merged
+    # HELP/TYPE emitted once per family, not once per source
+    assert merged.count("# TYPE serve_requests_total counter") == 1
+
+
+def test_merge_expositions_never_duplicates_the_merge_label():
+    """A source already exporting series WITH the merge label (the
+    router's own per-backend families once did) must not gain a second
+    'worker' label — Prometheus rejects duplicate label names
+    scrape-wide."""
+    from licensee_tpu.obs import check_exposition, merge_expositions
+
+    merged = merge_expositions({
+        "router": (
+            "# TYPE x counter\n"
+            'x{worker="w0",outcome="ok"} 3\n'
+            'x{outcome="failed"} 1\n'
+        ),
+    })
+    assert check_exposition(merged) == []
+    assert 'x{worker="w0",outcome="ok"} 3' in merged  # kept as-is
+    assert 'x{worker="router",outcome="failed"} 1' in merged  # injected
+    assert 'worker="router",worker=' not in merged
+
+
+def test_merge_expositions_handles_empty_and_unlabeled_sources():
+    from licensee_tpu.obs import check_exposition, merge_expositions
+
+    merged = merge_expositions({
+        "a": "# HELP x X.\n# TYPE x counter\nx 1\n",
+        "b": "",
+        "c": "bare_metric 7\n",  # no comments: still merged + labeled
+    })
+    assert check_exposition(merged) == []
+    assert 'x{worker="a"} 1' in merged
+    assert 'bare_metric{worker="c"} 7' in merged
+    assert merge_expositions({}) == ""
+
+
+# -- the full story, in one go --
+
+
+def test_fleet_selftest_stub_mode_passes():
+    from licensee_tpu.fleet.selftest import selftest
+
+    assert selftest(verbose=False, stub=True) == 0

@@ -7,6 +7,7 @@ scheduler's clocks are monotonic and every wait has a generous bound.
 from __future__ import annotations
 
 import json
+import os
 import re
 import socket
 import threading
@@ -748,3 +749,172 @@ def test_registry_absorbs_cache_and_flush_counters(clf, mit_body):
     assert value("serve_bucket_flush_total", bucket="4") == 1
     hist = value("serve_stage_seconds", stage="total")
     assert hist["count"] == 2
+
+
+# -- stale-socket reclaim (fleet satellite: rebind after SIGKILL) --
+
+
+def test_unix_server_reclaims_stale_socket(clf, tmp_path):
+    """A SIGKILLed worker leaves its socket file behind; a restarted
+    worker must bind over the STALE file instead of dying with
+    EADDRINUSE (the supervisor restart path depends on this)."""
+    path = str(tmp_path / "serve.sock")
+    stale = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    stale.bind(path)  # bound but never accepting: a dead owner's file
+    stale.close()
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        server = UnixServer(path, b)  # must not raise
+        server.server_close()
+
+
+def test_unix_server_refuses_live_socket(clf, tmp_path):
+    """The flip side: a LIVE server's socket must never be unlinked —
+    binding over it would silently hijack a running worker."""
+    from licensee_tpu.serve.server import SocketInUseError
+
+    path = str(tmp_path / "serve.sock")
+    owner = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    owner.bind(path)
+    owner.listen(1)
+    try:
+        with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+            with pytest.raises(SocketInUseError):
+                UnixServer(path, b)
+        assert os.path.exists(path)  # the live socket survived
+    finally:
+        owner.close()
+
+
+def test_unix_server_refuses_non_socket_path(clf, tmp_path):
+    from licensee_tpu.serve.server import SocketInUseError
+
+    path = tmp_path / "serve.sock"
+    path.write_text("precious user data")
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        with pytest.raises(SocketInUseError):
+            UnixServer(str(path), b)
+    assert path.read_text() == "precious user data"
+
+
+# -- trace adoption (fleet satellite: router -> worker propagation) --
+
+
+def test_session_adopts_upstream_trace_id(clf, mit_body):
+    """A request line carrying a 16-hex "trace" field (the fleet
+    router's) must answer under THAT ID and retain it in the worker's
+    own tail — the cross-process join."""
+    upstream = "deadbeef00c0ffee"
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), trace_sample=1.0,
+    ) as b:
+        out: list[str] = []
+        serve_session(
+            b,
+            [json.dumps({
+                "id": 1, "content": dice_blob(mit_body, "adopt"),
+                "filename": "LICENSE", "trace": upstream,
+            })],
+            out.append,
+        )
+        row = json.loads(out[0])
+        assert row["key"] == "mit"
+        assert row["trace"] == upstream
+        assert upstream in {t["trace"] for t in b.trace_tail(10)}
+
+
+def test_session_rejects_malformed_trace_field(clf, mit_body):
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        out: list[str] = []
+        serve_session(
+            b,
+            [
+                json.dumps({"id": 1, "content": "x", "trace": "nope"}),
+                json.dumps({"id": 2, "content": "x", "trace": 42}),
+                json.dumps({"id": 3, "content": "x",
+                            "trace": "DEADBEEF00C0FFEE"}),  # uppercase
+            ],
+            out.append,
+        )
+    rows = [json.loads(line) for line in out]
+    assert all("bad_request" in r["error"] for r in rows)
+
+
+# -- ResultCache byte bound (fleet satellite: bounded worker memory) --
+
+
+def _fat_result(n_closest: int = 0):
+    from licensee_tpu.kernels.batch import BlobResult
+
+    closest = [(f"lic-{i}", 50.0 + i) for i in range(n_closest)] or None
+    return BlobResult("mit", "dice", 99.0, closest=closest)
+
+
+def test_result_cache_byte_accounting_tracks_entries():
+    from licensee_tpu.serve.cache import ResultCache, result_bytes
+
+    cache = ResultCache(capacity=100, max_bytes=100_000)
+    r = _fat_result(3)
+    cache.put("a", r)
+    frozen = cache.get("a")
+    assert cache.bytes == result_bytes("a", frozen)
+    cache.put("b", r)
+    assert cache.bytes == 2 * result_bytes("a", frozen)
+    # replacing a key re-accounts instead of double-counting
+    cache.put("a", _fat_result(0))
+    assert cache.bytes == result_bytes("a", frozen) + result_bytes(
+        "a", cache.get("a")
+    )
+    stats = cache.stats()
+    assert stats["bytes"] == cache.bytes
+    assert stats["max_bytes"] == 100_000
+
+
+def test_result_cache_evicts_lru_by_bytes_not_count():
+    from licensee_tpu.serve.cache import ResultCache, result_bytes
+
+    r = _fat_result(4)
+    one = result_bytes("k", r)
+    # room for ~3 fat entries, far below the 1000-entry count bound
+    cache = ResultCache(capacity=1000, max_bytes=3 * one + one // 2)
+    for key in ("a", "b", "c"):
+        cache.put(key, r)
+    assert cache.evictions == 0
+    cache.get("a")  # a is now most-recent: LRU order b, c, a
+    cache.put("d", r)  # over budget: evicts "b", the LRU
+    assert cache.get("b") is None
+    assert cache.get("a") is not None
+    assert cache.evictions == 1
+    assert cache.bytes <= cache.max_bytes
+    assert len(cache) == 3
+
+
+def test_result_cache_rejects_oversized_entry_without_wiping():
+    from licensee_tpu.serve.cache import ResultCache, result_bytes
+
+    small = _fat_result(0)
+    cache = ResultCache(capacity=10, max_bytes=result_bytes("k", small) * 2)
+    cache.put("keep", small)
+    huge = _fat_result(500)  # alone bigger than the whole budget
+    cache.put("huge", huge)
+    assert cache.get("huge") is None  # refused
+    assert cache.get("keep") is not None  # and nothing was evicted for it
+    assert len(cache) == 1
+
+
+def test_result_cache_max_bytes_zero_and_validation():
+    from licensee_tpu.serve.cache import ResultCache
+
+    with pytest.raises(ValueError):
+        ResultCache(capacity=10, max_bytes=-1)
+    cache = ResultCache(capacity=10, max_bytes=0)
+    cache.put("a", _fat_result(0))
+    assert cache.get("a") is None  # a 0-byte budget stores nothing
+
+
+def test_micro_batcher_wires_cache_bytes(clf):
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, cache_bytes=4096
+    ) as b:
+        assert b.cache.max_bytes == 4096
+        assert b.stats()["config"]["cache_bytes"] == 4096
+        assert b.stats()["cache"]["max_bytes"] == 4096
