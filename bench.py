@@ -471,12 +471,22 @@ def bench_host_model(
             total = stats.total
 
     us = lambda s: round(s / n_files * 1e6, 1)  # noqa: E731
-    serial_s = st.get("dispatch", 0) + st.get("score", 0) + st.get("write", 0)
+    # the JSONL finish/write loop moved onto a dedicated writer thread
+    # (projects/batch_project.py, r6): the main thread's serial section
+    # is dispatch+score only.  The writer is its OWN single-thread lane
+    # — not divisible across cores like read/featurize — so the
+    # per-process ceiling is 1/max(serial_pb, writer_pb): today the
+    # writer (~1.4 us/blob) sits far under the serial section, but the
+    # formula must price the day a slow disk inverts that
+    serial_s = st.get("dispatch", 0) + st.get("score", 0)
+    writer_s = st.get("write", 0)
     parallel_s = st.get("read", 0) + st.get("featurize", 0)
     serial_pb = serial_s / total
+    writer_pb = writer_s / total
     parallel_pb = parallel_s / total
     target = 10_000_000 / 60
-    amdahl_ceiling = 1 / serial_pb if serial_pb else float("inf")
+    lane_pb = max(serial_pb, writer_pb)
+    amdahl_ceiling = 1 / lane_pb if lane_pb else float("inf")
     # one process cannot beat 1/serial_pb no matter the cores — but the
     # distributed path (parallel/distributed.py) stripes the manifest
     # AND the writer per PROCESS, and processes can share one machine
@@ -488,6 +498,7 @@ def bench_host_model(
     procs = max(1, int(np.ceil(target / amdahl_ceiling)))
     model = {
         "serial_us_per_blob": round(serial_pb * 1e6, 1),
+        "writer_us_per_blob": round(writer_pb * 1e6, 1),
         "parallel_us_per_blob": round(parallel_pb * 1e6, 1),
         "serial_fraction": round(serial_pb / (serial_pb + parallel_pb), 4),
         "amdahl_ceiling_files_per_sec": round(amdahl_ceiling, 0),
@@ -526,6 +537,114 @@ def bench_host_model(
         "pipeline_stage_seconds": {k: round(v, 3) for k, v in st.items()},
         "scaling_model": model,
     }
+
+
+def bench_stripes(
+    n_files: int = 16384, host_model: dict | None = None
+) -> dict:
+    """The striped scale-out, measured: the SAME manifest through
+    ``batch-detect --stripes``-style runs at 1 stripe and N stripes
+    (real worker subprocesses under the production StripeRunner), with
+    the merged N-stripe output checked bit-identical to the 1-stripe
+    run.
+
+    Children pin ``JAX_PLATFORMS=cpu`` so N stripes can share a
+    single-chip host (chip subsets via ``--chips-per-stripe`` are a
+    real-TPU-host concern); both runs pay the same pin, so the speedup
+    isolates exactly what striping buys: one serial section PER STRIPE
+    instead of one per host.  ``files_per_sec`` uses each stripe's own
+    steady-state ``elapsed`` (max across stripes — they start together),
+    excluding the per-child JAX boot that a real 50M-file run amortizes
+    to nothing; wall-clock rates ride along unamortized.
+
+    ``host_model``: a bench_host_model() row — its scaling model prices
+    the PREDICTED speedup (each stripe carries its own serial section,
+    cores split N ways):  R(P) = min(P/serial_pb, cores/parallel_pb),
+    predicted = R(N)/R(1)."""
+    import hashlib
+    import os
+    import tempfile
+
+    from licensee_tpu.parallel.stripes import (
+        StripeRunner,
+        auto_stripe_count,
+    )
+
+    cores = os.cpu_count() or 1
+    auto_n = auto_stripe_count(cores=cores)
+    n_stripes = max(2, min(4, auto_n))
+    out: dict = {
+        "files": n_files,
+        "host_cores": cores,
+        "auto_stripes": auto_n,
+        "stripes": n_stripes,
+    }
+    with tempfile.TemporaryDirectory() as tmpdir:
+        paths = write_bench_corpus(tmpdir, n_files, "license", unique=True)
+        manifest = os.path.join(tmpdir, "manifest.txt")
+        with open(manifest, "w", encoding="utf-8") as f:
+            f.write("\n".join(paths) + "\n")
+        digests = {}
+        for k in (1, n_stripes):
+            dest = os.path.join(tmpdir, f"out-{k}.jsonl")
+            runner = StripeRunner(
+                manifest,
+                dest,
+                k,
+                # same per-stripe core split the production
+                # `batch-detect --stripes` launch forwards — the
+                # measured speedup must be the configuration the real
+                # command runs, not an oversubscribed variant
+                forward_args=(
+                    "--batch-size", "4096",
+                    "--workers", str(max(1, cores // k)),
+                ),
+                base_env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            t0 = time.perf_counter()
+            summary = runner.run()
+            wall = time.perf_counter() - t0
+            elapsed = [
+                ((row.get("stats") or {}).get("stage_seconds") or {}).get(
+                    "elapsed"
+                )
+                for row in summary["per_stripe"]
+            ]
+            elapsed = [e for e in elapsed if e]
+            steady = max(elapsed) if elapsed else wall
+            label = "1_stripe" if k == 1 else f"{k}_stripes"
+            out[label] = {
+                "rows": summary["rows_written"],
+                "files_per_sec": round(n_files / steady, 1),
+                "wall_files_per_sec": round(n_files / wall, 1),
+                "restarts": sum(
+                    row["restarts"] for row in summary["per_stripe"]
+                ),
+            }
+            with open(dest, "rb") as f:
+                digests[k] = hashlib.sha256(f.read()).hexdigest()
+    out["identical_output"] = digests[1] == digests[n_stripes]
+    r1 = out["1_stripe"]["files_per_sec"]
+    rn = out[f"{n_stripes}_stripes"]["files_per_sec"]
+    if r1:
+        out["speedup"] = round(rn / r1, 2)
+    model = (host_model or {}).get("scaling_model") or {}
+    serial_pb = model.get("serial_us_per_blob")
+    parallel_pb = model.get("parallel_us_per_blob")
+    if serial_pb and parallel_pb:
+        # the per-process lane is max(serial, writer): each stripe
+        # carries one dispatch/score loop AND one writer thread
+        lane_pb = max(serial_pb, model.get("writer_us_per_blob") or 0)
+
+        def rate(p: int) -> float:
+            return min(
+                p / (lane_pb * 1e-6), cores / (parallel_pb * 1e-6)
+            )
+
+        out["predicted_speedup"] = round(
+            rate(n_stripes) / rate(1), 2
+        )
+    return out
 
 
 def bench_reference_fallback(reps: int = 300) -> dict:
@@ -1075,6 +1194,9 @@ def make_headline(
     serve = details.get("serve_path") or {}
     fleet = details.get("fleet") or {}
     hm = details.get("host_model") or {}
+    stripes = details.get("stripes") or {}
+    n_str = stripes.get("stripes")
+    stripes_n_row = stripes.get(f"{n_str}_stripes") or {} if n_str else {}
     return {
         "metric": metric,
         "value": round(value, 1),
@@ -1137,13 +1259,29 @@ def make_headline(
                     "retained"
                 ),
             },
-            # the host-featurize trajectory: crossing us/blob and the
-            # single-process Amdahl ceiling it implies
+            # the host-featurize trajectory: crossing us/blob, the
+            # per-stripe serial cost, and the single-process Amdahl
+            # ceiling they imply
             "host_model": {
                 "featurize_us_per_blob": hm.get("featurize_us_per_blob"),
+                "serial_us_per_blob": (
+                    hm.get("scaling_model") or {}
+                ).get("serial_us_per_blob"),
                 "amdahl_ceiling_files_per_sec": (
                     hm.get("scaling_model") or {}
                 ).get("amdahl_ceiling_files_per_sec"),
+            },
+            # the striped scale-out: 1 vs N co-located stripes over the
+            # same manifest (full row: details.stripes)
+            "stripes": {
+                "n": n_str,
+                "files_per_sec_1": (
+                    stripes.get("1_stripe") or {}
+                ).get("files_per_sec"),
+                "files_per_sec_n": stripes_n_row.get("files_per_sec"),
+                "speedup": stripes.get("speedup"),
+                "predicted_speedup": stripes.get("predicted_speedup"),
+                "identical_output": stripes.get("identical_output"),
             },
             "details_file": "BENCH_DETAILS.json",
         },
@@ -1260,6 +1398,9 @@ def main() -> None:
     serve_path = run_safe("serve_path", bench_serve_path)
     fleet = run_safe("fleet", bench_fleet)
     host_model = run_safe("host_model", bench_host_model, e2e=end_to_end)
+    stripes = run_safe(
+        "stripes", bench_stripes, host_model=host_model
+    )
     reference_fallback = run_safe(
         "reference_fallback", bench_reference_fallback
     )
@@ -1300,6 +1441,7 @@ def main() -> None:
         "serve_path": serve_path,
         "fleet": fleet,
         "host_model": host_model,
+        "stripes": stripes,
         "reference_fallback": reference_fallback,
         "tp_width": tp_width,
         "scalar_agreement": agreement,

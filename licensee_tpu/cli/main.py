@@ -249,22 +249,330 @@ def cmd_help(args) -> int:
     return 0
 
 
+def _atomic_write(path: str, text: str) -> None:
+    """Write-then-replace so a supervisor polling ``path`` never reads
+    a torn file (the --stats-file/--prom-file contract)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def _check_output_dir(output: str) -> str | None:
+    """Preflight the one --output misconfiguration we can name
+    precisely; returns the error message, or None when fine.  Shared by
+    the single-process and striped paths so the two cannot drift."""
+    out_dir = os.path.dirname(os.path.abspath(output))
+    if os.path.isdir(out_dir):
+        return None
+    problem = (
+        "is not a directory" if os.path.exists(out_dir) else "does not exist"
+    )
+    return f"output directory {problem}: {out_dir}"
+
+
+def _run_striped(args) -> int:
+    """`batch-detect --stripes N|auto`: the one-command co-located
+    scale-out (parallel/stripes.py).  This process never initializes a
+    backend — it only supervises N child batch-detect workers (each a
+    manifest stripe writing its own resume-safe shard) and merges their
+    shards/stats/expositions when they all finish."""
+    from licensee_tpu.parallel.stripes import (
+        StripeError,
+        StripeRunner,
+        parse_stripes_arg,
+    )
+
+    if os.environ.get("LICENSEE_TPU_COORDINATOR") or os.environ.get(
+        "LICENSEE_TPU_DISTRIBUTED"
+    ):
+        print(
+            "error: --stripes is the single-host co-located launcher; "
+            "it cannot run under the multi-host env contract "
+            "(LICENSEE_TPU_COORDINATOR / LICENSEE_TPU_DISTRIBUTED) — "
+            "launch one striped runner per host instead",
+            file=sys.stderr,
+        )
+        return 1
+    if args.stripe_index is not None or args.stripe_count is not None:
+        print(
+            "error: --stripes cannot be combined with the internal "
+            "--stripe-index/--stripe-count worker flags",
+            file=sys.stderr,
+        )
+        return 1
+    if not args.output:
+        print(
+            "error: --stripes needs --output (per-stripe JSONL shards "
+            "merge there)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.profile:
+        print(
+            "error: --profile traces one process; run the worker "
+            "directly (--stripe-index/--stripe-count) to profile a "
+            "single stripe",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        n_stripes = parse_stripes_arg(args.stripes)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    # preflight the cheap misconfigurations here instead of paying one
+    # restart-backoff cycle per stripe for them
+    dir_err = _check_output_dir(args.output)
+    if dir_err:
+        print(f"error: {dir_err}", file=sys.stderr)
+        return 1
+    if args.corpus not in ("vendored", "spdx") and not os.path.isdir(
+        args.corpus
+    ):
+        print(
+            f"error: cannot load corpus {args.corpus!r}: not a directory",
+            file=sys.stderr,
+        )
+        return 1
+    # resume-config preflight over the merged output's sidecar: the
+    # single-process path refuses a resume whose row-shaping config
+    # changed (ResumeConfigError), and each stripe worker enforces the
+    # same over its own shard — but a COMPLETE merged output would
+    # otherwise short-circuit before any worker runs, silently handing
+    # back rows of the old shape.  Run the REAL check (corpus
+    # fingerprint included): building the probe project compiles the
+    # corpus once in this process (~seconds), paid only when a resume
+    # target exists — and a mismatch fails here instead of through one
+    # restart-backoff cycle per stripe.
+    if not args.no_resume and os.path.exists(args.output) and (
+        os.path.exists(f"{args.output}.meta.json")
+    ):
+        kwargs, err = _load_corpus(args.corpus)
+        if err:
+            print(f"error: {err}", file=sys.stderr)
+            return 1
+        from licensee_tpu.kernels.batch import BatchClassifier
+        from licensee_tpu.projects.batch_project import (
+            BatchProject,
+            ResumeConfigError,
+        )
+
+        try:
+            # device=False: the probe needs only the compiled corpus
+            # fingerprint — the supervisor process must never claim a
+            # chip (libtpu visibility is exclusive; the stripes own it)
+            classifier = BatchClassifier(
+                corpus=kwargs.get("corpus"),
+                method=args.method,
+                pad_batch_to=args.batch_size,
+                mesh=None,
+                mode=args.mode,
+                closest=args.closest,
+                device=False,
+            )
+            probe = BatchProject(
+                [],
+                classifier=classifier,
+                batch_size=args.batch_size,
+                threshold=args.confidence,
+                attribution=args.attribution,
+                process_index=0,
+                process_count=1,
+                tracer=False,
+            )
+            probe._check_resume_config(args.output, resume=True)
+        except ResumeConfigError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+
+    # everything row-shaping or perf-relevant forwards verbatim to the
+    # workers; --workers splits the host's cores across stripes unless
+    # the operator pinned a per-stripe count
+    forward: list[str] = ["--batch-size", str(args.batch_size)]
+    workers = args.workers or max(
+        1, (os.cpu_count() or 1) // n_stripes
+    )
+    forward += ["--workers", str(workers)]
+    for flag, value, default in (
+        ("--corpus", args.corpus, "vendored"),
+        ("--method", args.method, "auto"),
+        ("--mode", args.mode, "license"),
+        ("--mesh", args.mesh, None),
+        ("--confidence", args.confidence, None),
+        ("--coalesce-batches", args.coalesce_batches, 32),
+    ):
+        if value is not None and value != default:
+            forward += [flag, str(value)]
+    if args.closest:
+        forward += ["--closest", str(args.closest)]
+    if args.attribution:
+        forward += ["--attribution"]
+    if args.no_dedupe:
+        forward += ["--no-dedupe"]
+    if args.featurize_procs:
+        forward += ["--featurize-procs", str(args.featurize_procs)]
+
+    def event(message: str) -> None:
+        print(f"stripes: {message}", file=sys.stderr, flush=True)
+
+    try:
+        runner = StripeRunner(
+            args.manifest,
+            args.output,
+            n_stripes,
+            forward_args=tuple(forward),
+            resume=not args.no_resume,
+            auto_clamp=args.stripes == "auto",
+            chips_per_stripe=args.chips_per_stripe,
+            progress_every=args.progress,
+            on_event=event,
+        )
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+
+    import signal as signallib
+
+    previous = {}
+
+    def _stop(signum, _frame):
+        event(f"signal {signum}: draining stripes (resume-safe)")
+        runner.request_stop()
+
+    for sig in (signallib.SIGTERM, signallib.SIGINT):
+        try:
+            previous[sig] = signallib.signal(sig, _stop)
+        except ValueError:
+            pass  # not the main thread (tests drive this in-process)
+    try:
+        summary = runner.run()
+    except StripeError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        for sig, handler in previous.items():
+            try:
+                signallib.signal(sig, handler)
+            except ValueError:
+                pass
+    # --stats-file / --prom-file apply at the MERGED level here (each
+    # worker's per-shard dumps are the runner's internal merge inputs):
+    # the operator-requested paths must exist when the flags were given
+    if args.stats_file:
+        if summary.get("stats") is not None:
+            _atomic_write(
+                args.stats_file, json.dumps(summary["stats"]) + "\n"
+            )
+        else:
+            event(
+                f"warning: no merged stats available; {args.stats_file} "
+                "not written"
+            )
+    if args.prom_file:
+        if summary.get("prom"):
+            if os.path.abspath(args.prom_file) != os.path.abspath(
+                summary["prom"]
+            ):
+                import shutil
+
+                tmp = f"{args.prom_file}.tmp"
+                shutil.copyfile(summary["prom"], tmp)
+                os.replace(tmp, args.prom_file)
+        else:
+            event(
+                f"warning: no merged exposition available; "
+                f"{args.prom_file} not written"
+            )
+    if args.stats and summary.get("stats") is not None:
+        print(json.dumps(summary["stats"]), file=sys.stderr)
+    event(
+        f"done: {summary['rows_written']} rows in "
+        f"{summary.get('elapsed_s', 0.0)}s"
+        + (
+            f" ({summary['files_per_sec']} files/sec)"
+            if summary.get("files_per_sec")
+            else ""
+        )
+    )
+    return 0
+
+
+def _dump_run_artifacts(args, stats) -> None:
+    """--stats-file / --prom-file: machine-readable per-run dumps (the
+    stripe runner's merge inputs, also useful standalone).  Atomic
+    replace so a supervisor never reads a torn file."""
+    if args.stats_file:
+        _atomic_write(
+            args.stats_file, json.dumps(stats.as_dict()) + "\n"
+        )
+    if args.prom_file:
+        from licensee_tpu.obs import (
+            NativeProfileSource,
+            get_registry,
+            render_prometheus,
+        )
+
+        registry = get_registry()
+        # fold the native featurizer's profile counters in, and publish
+        # the run's stage seconds / result counters so a striped fleet's
+        # merged exposition carries the per-stripe pipeline split
+        NativeProfileSource(registry)
+        stage_g = registry.gauge(
+            "batch_stage_seconds",
+            "Per-stage seconds of the last batch run (thread-seconds "
+            "for read/featurize, wall for elapsed)",
+            labels=("stage",),
+        )
+        for stage, seconds in stats.stage_seconds.items():
+            stage_g.labels(stage=stage).set(seconds)
+        rows_g = registry.gauge(
+            "batch_rows",
+            "Result counters of the last batch run",
+            labels=("kind",),
+        )
+        for kind in (
+            "total", "dice_matched", "reference_matched",
+            "package_matched", "prefiltered_copyright",
+            "prefiltered_exact", "unmatched", "read_errors",
+            "featurize_errors", "dedupe_hits",
+        ):
+            rows_g.labels(kind=kind).set(getattr(stats, kind))
+        _atomic_write(args.prom_file, render_prometheus(registry))
+
+
 def cmd_batch_detect(args) -> int:
     """Batch classification of a manifest of files via the TPU Dice kernel.
 
     Without --output, rows print to stdout (small manifests).  With
     --output, the full pipelined BatchProject runs: featurization worker
     threads, double-buffered device dispatch, resume-on-restart, and
-    per-stage timers (--stats)."""
-    kwargs, err = _load_corpus(args.corpus)
-    if err:
-        print(f"error: {err}", file=sys.stderr)
+    per-stage timers (--stats).  --stripes N|auto scales out across N
+    co-located worker processes (parallel/stripes.py)."""
+    if args.selftest:
+        from licensee_tpu.parallel.stripes import selftest
+
+        return selftest()
+    if not args.manifest:
+        print(
+            "error: need a manifest (one path per line), or --selftest",
+            file=sys.stderr,
+        )
         return 1
     if not os.path.exists(args.manifest):
         print(
             f"error: cannot read manifest: {args.manifest!r} not found",
             file=sys.stderr,
         )
+        return 1
+    if args.stripes is not None:
+        return _run_striped(args)
+    kwargs, err = _load_corpus(args.corpus)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
         return 1
 
     mesh = "auto"
@@ -292,6 +600,41 @@ def cmd_batch_detect(args) -> int:
             file=sys.stderr,
         )
         return 1
+    # the stripe-worker rank (internal: the --stripes runner spawns
+    # workers with these): same striping math as the multi-host path,
+    # minus the jax.distributed bootstrap — co-located stripes share no
+    # collectives, so no coordinator is needed
+    if (args.stripe_index is None) != (args.stripe_count is None):
+        print(
+            "error: --stripe-index and --stripe-count must be given "
+            "together",
+            file=sys.stderr,
+        )
+        return 1
+    if args.stripe_index is not None:
+        if process_count > 1:
+            print(
+                "error: stripe-worker flags cannot be combined with the "
+                "multi-host env contract",
+                file=sys.stderr,
+            )
+            return 1
+        if not args.output:
+            print(
+                "error: stripe workers need --output (the shard path "
+                "derives from it)",
+                file=sys.stderr,
+            )
+            return 1
+        if not 0 <= args.stripe_index < args.stripe_count:
+            print(
+                f"error: --stripe-index {args.stripe_index} out of range "
+                f"for --stripe-count {args.stripe_count}",
+                file=sys.stderr,
+            )
+            return 1
+        kwargs["process_index"] = args.stripe_index
+        kwargs["process_count"] = args.stripe_count
 
     from licensee_tpu.projects.batch_project import BatchProject
 
@@ -335,17 +678,9 @@ def cmd_batch_detect(args) -> int:
             # everything else surfaces as a neutral I/O failure (run()
             # touches much more than the output file — resume reads,
             # JAX caches — so the message must not overclaim)
-            out_dir = os.path.dirname(os.path.abspath(args.output))
-            if not os.path.isdir(out_dir):
-                problem = (
-                    "is not a directory"
-                    if os.path.exists(out_dir)
-                    else "does not exist"
-                )
-                print(
-                    f"error: output directory {problem}: {out_dir}",
-                    file=sys.stderr,
-                )
+            dir_err = _check_output_dir(args.output)
+            if dir_err:
+                print(f"error: {dir_err}", file=sys.stderr)
                 return 1
             from licensee_tpu.projects.batch_project import (
                 ResumeConfigError,
@@ -389,6 +724,7 @@ def cmd_batch_detect(args) -> int:
 
             jax.profiler.stop_trace()
             print(f"profile trace written to {profiler}", file=sys.stderr)
+    _dump_run_artifacts(args, stats)
     if args.stats:
         print(json.dumps(stats.as_dict()), file=sys.stderr)
     return 0
@@ -883,7 +1219,10 @@ def build_parser() -> argparse.ArgumentParser:
     batch = sub.add_parser(
         "batch-detect", help=_COMMAND_HELP["batch-detect"]
     )
-    batch.add_argument("manifest", help="File with one path per line")
+    batch.add_argument(
+        "manifest", nargs="?", default=None,
+        help="File with one path per line",
+    )
     batch.add_argument(
         "--corpus",
         default="vendored",
@@ -1002,6 +1341,63 @@ def build_parser() -> argparse.ArgumentParser:
             "device rows (dedupe-heavy manifests) accumulate into full "
             "device chunks — amortizes the per-dispatch round trip; 1 "
             "disables coalescing (default 32)"
+        ),
+    )
+    batch.add_argument(
+        "--stripes", default=None, metavar="N|auto",
+        help=(
+            "Scale out across N co-located worker processes, each "
+            "classifying a contiguous manifest stripe into its own "
+            "resume-safe shard under a supervisor (crash restart with "
+            "backoff resumes the dead stripe; SIGTERM drains), then "
+            "merge shards/stats/metrics deterministically — the merged "
+            "output is bit-identical to a 1-process run.  'auto' sizes "
+            "from the host core count and the bench scaling model "
+            "(BENCH_DETAILS.json).  Needs --output"
+        ),
+    )
+    batch.add_argument(
+        "--chips-per-stripe", type=bounded(int, 1), default=None,
+        metavar="K",
+        help=(
+            "With --stripes: give stripe i chips [i*K, (i+1)*K) via the "
+            "LICENSEE_TPU_VISIBLE_CHIPS env contract "
+            "(parallel/distributed.py chips_for_worker + "
+            "apply_visible_chips over each CHILD's env dict); default: "
+            "stripes share default device visibility"
+        ),
+    )
+    # internal: the rank flags the stripe runner spawns workers with
+    batch.add_argument(
+        "--stripe-index", type=nonneg(int), default=None,
+        help=argparse.SUPPRESS,
+    )
+    batch.add_argument(
+        "--stripe-count", type=bounded(int, 1), default=None,
+        help=argparse.SUPPRESS,
+    )
+    batch.add_argument(
+        "--stats-file", default=None, metavar="PATH",
+        help=(
+            "Write the run's stats JSON to PATH (atomic replace) — the "
+            "machine-readable twin of --stats; the stripe runner merges "
+            "these per shard"
+        ),
+    )
+    batch.add_argument(
+        "--prom-file", default=None, metavar="PATH",
+        help=(
+            "Write a Prometheus text exposition of the run (pipeline "
+            "stage seconds, result counters, native featurize profile) "
+            "to PATH; the stripe runner merges these stripe-labeled"
+        ),
+    )
+    batch.add_argument(
+        "--selftest", action="store_true",
+        help=(
+            "Run the 2-stripe CPU smoke (real worker subprocesses over "
+            "a synthetic corpus; merged output must be bit-identical "
+            "to a 1-stripe run) and exit 0/1 — the CI smoke"
         ),
     )
     batch.add_argument("--stats", action="store_true",

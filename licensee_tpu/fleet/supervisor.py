@@ -53,6 +53,46 @@ DRAINING = "draining"
 STOPPED = "stopped"
 
 
+class BackoffPolicy:
+    """Capped exponential restart backoff — the spawn/backoff core
+    shared by this serving supervisor and the offline stripe runner
+    (parallel/stripes.py): restart number ``r + 1`` waits
+    ``base_s * 2^r`` seconds, capped at ``max_s``; a worker that stays
+    healthy ``stable_after_s`` earns its restart counter back so a
+    week-old process's first crash restarts fast."""
+
+    def __init__(
+        self,
+        base_s: float = 0.25,
+        max_s: float = 10.0,
+        stable_after_s: float = 10.0,
+    ):
+        self.base_s = float(base_s)
+        self.max_s = float(max_s)
+        self.stable_after_s = float(stable_after_s)
+
+    def delay_s(self, restarts: int) -> float:
+        """The delay before restart number ``restarts + 1``."""
+        return min(self.base_s * (2 ** restarts), self.max_s)
+
+
+def terminate_process(
+    proc: subprocess.Popen | None, sigterm_timeout_s: float = 5.0
+) -> None:
+    """SIGTERM, escalate to SIGKILL after ``sigterm_timeout_s`` — the
+    one graceful-stop primitive (shared with the stripe runner)."""
+    if proc is None or proc.poll() is not None:
+        return
+    try:
+        proc.terminate()
+        proc.wait(timeout=sigterm_timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=5.0)
+    except OSError:
+        pass
+
+
 def default_worker_argv(
     socket_path: str, serve_args: tuple[str, ...] = ()
 ) -> list[str]:
@@ -166,9 +206,15 @@ class Supervisor:
         self.probe_timeout_s = float(probe_timeout_s)
         self.wedged_after = int(wedged_after)
         self.startup_grace_s = float(startup_grace_s)
-        self.backoff_base_s = float(backoff_base_s)
-        self.backoff_max_s = float(backoff_max_s)
-        self.stable_after_s = float(stable_after_s)
+        # the shared spawn/backoff core (also driving the offline
+        # stripe runner, parallel/stripes.py) — self.backoff is the
+        # single source of truth; the read-only properties below keep
+        # the long-standing attribute names without a second copy
+        self.backoff = BackoffPolicy(
+            base_s=backoff_base_s,
+            max_s=backoff_max_s,
+            stable_after_s=stable_after_s,
+        )
         # the router attaches itself here (fleet CLI): drain then also
         # waits for the router's outstanding count to hit zero
         self.router = None
@@ -191,6 +237,18 @@ class Supervisor:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    @property
+    def backoff_base_s(self) -> float:
+        return self.backoff.base_s
+
+    @property
+    def backoff_max_s(self) -> float:
+        return self.backoff.max_s
+
+    @property
+    def stable_after_s(self) -> float:
+        return self.backoff.stable_after_s
 
     # -- lifecycle --
 
@@ -244,24 +302,11 @@ class Supervisor:
     def _terminate(
         self, handle: WorkerHandle, sigterm_timeout_s: float
     ) -> None:
-        proc = handle.proc
-        if proc is None or proc.poll() is not None:
-            return
-        try:
-            proc.terminate()
-            proc.wait(timeout=sigterm_timeout_s)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait(timeout=5.0)
-        except OSError:
-            pass
+        terminate_process(handle.proc, sigterm_timeout_s)
 
     def _schedule_restart(self, handle: WorkerHandle) -> None:
         """Record the death and arm the backoff timer.  Lock held."""
-        delay = min(
-            self.backoff_base_s * (2 ** handle.restarts),
-            self.backoff_max_s,
-        )
+        delay = self.backoff.delay_s(handle.restarts)
         handle.restarts += 1
         handle.next_spawn_at = time.perf_counter() + delay
         handle.state = DOWN
@@ -270,9 +315,7 @@ class Supervisor:
     def backoff_delay_s(self, restarts: int) -> float:
         """The delay before restart number ``restarts + 1`` — exposed
         so tests and the selftest can name the backoff budget."""
-        return min(
-            self.backoff_base_s * (2 ** restarts), self.backoff_max_s
-        )
+        return self.backoff.delay_s(restarts)
 
     # -- the monitor loop --
 

@@ -240,6 +240,22 @@ def chips_for_worker(
     return [str(c) for c in range(lo, lo + chips_per_worker)]
 
 
+def count_manifest_entries(manifest: str) -> int:
+    """Non-blank line count — the ONE striping denominator.
+
+    Both sides of the shard row-count contract ride this: the stripe
+    runner (parallel/stripes.py) sizes stripe spans from it, and
+    ``BatchProject.from_manifest_file`` counts with it before
+    collecting a span — so what counts as "an entry" can never drift
+    between supervisor and worker."""
+    n = 0
+    with open(manifest, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                n += 1
+    return n
+
+
 def manifest_stripe(n: int, process_index: int, process_count: int) -> tuple[int, int]:
     """[lo, hi) bounds of this process's contiguous manifest stripe.
 

@@ -1,0 +1,891 @@
+"""One-command striped scale-out for the offline batch path.
+
+The measured host scaling model (bench.py ``bench_host_model``, the ADR
+in projects/batch_project.py) says one process's pipeline is bounded by
+its serial section no matter the core count, and that the 10M-files-in-
+60s north star therefore needs >=3 manifest-striped processes.  Striping
+has existed since PR 0 as a hand-assembled env contract
+(parallel/distributed.py: ``LICENSEE_TPU_COORDINATOR`` /
+``_NUM_PROCESSES`` / ``_PROCESS_ID`` / ``_VISIBLE_CHIPS``); this module
+makes the documented scaling lever ONE command::
+
+    licensee-tpu batch-detect manifest.txt --output out.jsonl --stripes 4
+
+The runner spawns N co-located worker processes on this host, each
+classifying a contiguous stripe of the manifest (the same
+``manifest_stripe`` math the multi-host path uses, so a stripe IS a
+rank) and writing its own resume-safe JSONL shard.  No
+``jax.distributed`` bootstrap is involved: the scoring workload has no
+cross-blob collectives, so co-located stripes need no coordinator — the
+stripe index/count ride the child's argv and chip subsets ride the SAME
+``LICENSEE_TPU_VISIBLE_CHIPS`` dict-env contract the serving fleet's
+supervisor uses (``chips_for_worker`` + ``apply_visible_chips`` over the
+CHILD's env dict — this process's environment is never touched).
+
+Supervision reuses the PR-4 fleet patterns via the extracted core in
+fleet/supervisor.py: crash restart with capped exponential backoff
+(``BackoffPolicy``) — a restarted stripe RESUMES from its own shard's
+``_resume_point``, never re-scoring rows another stripe owns — a
+progress probe that SIGKILLs a wedged worker (alive but its shard has
+not grown past the stall timeout), and a graceful SIGTERM drain
+(``request_stop()`` forwards SIGTERM and waits; a mid-write kill leaves
+at most one torn line, which the per-shard resume truncates).
+
+When every stripe exits clean the runner deterministically merges:
+
+* **rows** — shards concatenate in stripe order into ``<output>``
+  (atomic ``os.replace``), after verifying each shard's newline-
+  terminated row count equals its stripe span, so the merged file is
+  bit-identical to a single-process run over the same manifest;
+* **stats** — per-stripe ``BatchStats`` JSON sums into one dict
+  (``merge_stats``);
+* **metrics** — per-stripe Prometheus expositions merge into
+  ``<output>.prom`` via the fleet's ``merge_expositions`` with a
+  ``stripe`` label.
+
+House rules (script/lint): monotonic clocks only, and nothing is ever
+printed from this module — progress surfaces through the ``on_event``
+callback (the CLI points it at stderr), so the runner can never corrupt
+a pipeline that shares its stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from licensee_tpu.fleet.supervisor import (
+    BackoffPolicy,
+    terminate_process,
+    worker_env,
+)
+from licensee_tpu.parallel.distributed import (
+    chips_for_worker,
+    count_manifest_entries,
+    manifest_stripe,
+    shard_output_path,
+)
+
+__all__ = [
+    "StripeError",
+    "StripeRunner",
+    "auto_stripe_count",
+    "count_manifest_entries",
+    "load_scaling_model",
+    "merge_stats",
+    "parse_stripes_arg",
+    "selftest",
+    "stripe_argv",
+]
+
+# how many cores one stripe can productively use before its own serial
+# section caps it: parallel/serial ~= 255.9/6 us per blob post-writer-
+# thread (BENCH_DETAILS.json host_model.scaling_model) — but the USEFUL
+# lower bound is 2 (one core feeding produce workers, one for the
+# dispatch/finish loop + writer), which is what auto sizing guarantees
+CORES_PER_STRIPE_MIN = 2
+AUTO_STRIPE_CAP = 16
+
+
+class StripeError(RuntimeError):
+    """A stripe failed permanently (restart budget exhausted), a shard
+    failed verification at merge time, or the runner was stopped."""
+
+
+def load_scaling_model(details_path: str | None = None) -> dict | None:
+    """The bench's measured host scaling model
+    (``details.host_model.scaling_model`` in BENCH_DETAILS.json), or
+    None when no bench artifact is readable — auto sizing then falls
+    back to pure core-count math."""
+    if details_path is None:
+        details_path = os.path.join(
+            os.path.dirname(
+                os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                )
+            ),
+            "BENCH_DETAILS.json",
+        )
+    try:
+        with open(details_path, encoding="utf-8") as f:
+            details = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    model = ((details.get("details") or {}).get("host_model") or {}).get(
+        "scaling_model"
+    )
+    return model if isinstance(model, dict) else None
+
+
+def auto_stripe_count(
+    cores: int | None = None, scaling_model: dict | None = None
+) -> int:
+    """``--stripes auto``: how many stripes THIS host should run.
+
+    Every stripe needs at least ``CORES_PER_STRIPE_MIN`` cores to
+    overlap its produce workers with its serial loop, so the host
+    supports ``cores // 2`` stripes, capped at ``AUTO_STRIPE_CAP``.
+    When the bench scaling model is available, its
+    ``striped_processes_needed_10M_60s`` floor applies whenever the
+    cores allow it — the north-star target must never be under-sized by
+    auto on a host that can afford it (with the measured model the
+    core-count rule already clears the floor, so the floor only matters
+    if a future model demands more stripes than ``AUTO_STRIPE_CAP``)."""
+    if cores is None:
+        cores = os.cpu_count() or 1
+    by_cores = max(1, cores // CORES_PER_STRIPE_MIN)
+    n = min(by_cores, AUTO_STRIPE_CAP)
+    if scaling_model:
+        need = scaling_model.get("striped_processes_needed_10M_60s")
+        if isinstance(need, (int, float)) and need >= 1:
+            n = max(n, min(int(need), by_cores))
+    return n
+
+
+def parse_stripes_arg(value: str) -> int:
+    """CLI ``--stripes`` value: a positive int, or ``auto``."""
+    if value == "auto":
+        return auto_stripe_count(scaling_model=load_scaling_model())
+    try:
+        n = int(value)
+    except ValueError:
+        raise ValueError(
+            f"--stripes wants a positive integer or 'auto', got {value!r}"
+        ) from None
+    if n < 1:
+        raise ValueError(f"--stripes must be >= 1, got {n}")
+    return n
+
+
+def stripe_argv(
+    manifest: str,
+    output: str,
+    index: int,
+    count: int,
+    forward: tuple[str, ...] = (),
+    *,
+    resume: bool = True,
+) -> list[str]:
+    """The child command for one stripe: the batch-detect CLI with the
+    internal stripe rank args plus the per-stripe stats/metrics dump
+    paths the merge reads.  ``resume=False`` (first spawn of a
+    ``--no-resume`` run) restarts the shard from scratch; RESTARTS
+    always resume — that is the whole point of the per-shard
+    ``_resume_point``."""
+    shard = shard_output_path(output, index, count)
+    argv = [
+        sys.executable, "-m", "licensee_tpu.cli.main", "batch-detect",
+        manifest,
+        "--output", output,
+        "--stripe-index", str(index),
+        "--stripe-count", str(count),
+        "--stats-file", f"{shard}.stats.json",
+        "--prom-file", f"{shard}.prom",
+    ]
+    if not resume:
+        argv.append("--no-resume")
+    argv.extend(forward)
+    return argv
+
+
+def merge_stats(stats_list: list[dict]) -> dict:
+    """Sum per-stripe ``BatchStats.as_dict()`` rows into one fleet-level
+    dict: integer counters add, ``routed`` adds per route, and
+    ``stage_seconds`` adds per stage (they are already thread-seconds,
+    so cross-process addition keeps the same unit; ``elapsed`` becomes
+    the sum of per-stripe elapsed — the runner reports wall clock
+    separately).
+
+    Resume semantics, same as a single-process resumed run: each
+    stripe's stats count the rows ITS FINAL INCARNATION classified, so
+    after a crash-restart the merged ``total`` is less than
+    ``rows_written`` (the rows the dead incarnation already wrote are
+    on disk, not re-scored).  ``rows_written`` in the runner summary is
+    the completeness guarantee; the stats are the work accounting."""
+    merged: dict = {}
+    routed: dict = {}
+    stages: dict = {}
+    for stats in stats_list:
+        for key, value in stats.items():
+            if key == "routed":
+                for route, n in value.items():
+                    routed[route] = routed.get(route, 0) + n
+            elif key == "stage_seconds":
+                for stage, s in value.items():
+                    stages[stage] = round(stages.get(stage, 0.0) + s, 4)
+            elif isinstance(value, (int, float)):
+                merged[key] = merged.get(key, 0) + value
+    if routed:
+        merged["routed"] = routed
+    merged["stage_seconds"] = stages
+    return merged
+
+
+class _StripeHandle:
+    """One supervised stripe worker: its argv/env, live process, and
+    restart/progress bookkeeping (the offline twin of the fleet's
+    WorkerHandle)."""
+
+    def __init__(self, index: int, shard: str, argv_first, argv_resume, env):
+        self.index = index
+        self.shard = shard
+        self.argv_first = list(argv_first)
+        self.argv_resume = list(argv_resume)
+        self.env = dict(env)
+        self.proc: subprocess.Popen | None = None
+        self.log: str = f"{shard}.log"
+        self.done = False
+        # restarts is the BACKOFF-WINDOW counter (reset after sustained
+        # progress, like the fleet supervisor's stable_after_s earn-
+        # back); total_restarts is the lifetime count status reports
+        self.restarts = 0
+        self.total_restarts = 0
+        self.spawned_at: float | None = None
+        self.next_spawn_at = 0.0
+        self.exit_codes: list[int] = []
+        # progress probe state: (last observed shard size, when it last
+        # changed) — a live process whose shard stops growing is wedged
+        self.last_size = -1
+        self.last_growth_t: float | None = None
+        # deterministic-failure detector: consecutive nonzero exits
+        # whose incarnation never CHANGED the shard at all (a config
+        # error, a broken argv — those die before touching the file) —
+        # burning the whole restart-backoff budget on those only delays
+        # the real error message.  "Changed", not "grew": a --no-resume
+        # child legitimately truncates a stale shard below its old size
+        # and must still count as progress.
+        self.size_at_spawn = -1
+        self.changed_since_spawn = False
+        self.no_growth_failures = 0
+
+    @property
+    def pid(self) -> int | None:
+        return self.proc.pid if self.proc is not None else None
+
+    def as_dict(self) -> dict:
+        return {
+            "shard": self.shard,
+            "pid": self.pid,
+            "done": self.done,
+            "restarts": self.total_restarts,
+            "exit_codes": self.exit_codes[-5:],
+        }
+
+
+class StripeRunner:
+    """Spawn + supervise + merge N manifest-striped batch workers.
+
+    ``argv_for(index, count, resume)`` / ``env_for(index, chips)``
+    override the child command/environment so tests and the fault
+    harness can drive the exact production restart/merge machinery over
+    stub workers (the fleet Supervisor's ``argv_for`` pattern)."""
+
+    def __init__(
+        self,
+        manifest: str,
+        output: str,
+        stripes: int,
+        *,
+        forward_args: tuple[str, ...] = (),
+        resume: bool = True,
+        auto_clamp: bool = False,
+        chips_per_stripe: int | None = None,
+        argv_for=None,
+        env_for=None,
+        base_env: dict | None = None,
+        max_restarts: int = 5,
+        backoff: BackoffPolicy | None = None,
+        stall_timeout_s: float = 600.0,
+        startup_grace_s: float = 180.0,
+        poll_interval_s: float = 0.25,
+        sigterm_timeout_s: float = 10.0,
+        progress_every: float = 0,
+        on_event=None,
+    ):
+        if stripes < 1:
+            raise ValueError(f"stripes must be >= 1, got {stripes!r}")
+        if chips_per_stripe is not None and chips_per_stripe < 1:
+            raise ValueError(
+                f"chips_per_stripe must be >= 1, got {chips_per_stripe!r}"
+            )
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts!r}"
+            )
+        self.manifest = manifest
+        self.output = output
+        self.n_entries = count_manifest_entries(manifest)
+        if stripes > max(1, self.n_entries):
+            if auto_clamp:
+                # `--stripes auto` sized from the HOST; a small manifest
+                # simply can't use that many — clamp, don't lecture the
+                # operator about a number they never chose
+                stripes = max(1, self.n_entries)
+            else:
+                raise ValueError(
+                    f"more stripes ({stripes}) than manifest entries "
+                    f"({self.n_entries}); an empty stripe would write "
+                    "an empty shard forever — lower --stripes"
+                )
+        self.stripes = int(stripes)
+        self.resume = bool(resume)
+        self.max_restarts = int(max_restarts)
+        self.backoff = backoff or BackoffPolicy(base_s=0.5, max_s=30.0)
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.sigterm_timeout_s = float(sigterm_timeout_s)
+        # CLI --progress: emit a shard-growth event at most every SECS
+        # (per-stripe shard BYTES — cheap stat()s, no row counting on
+        # the supervision path); 0 disables
+        self.progress_every = float(progress_every or 0)
+        if not (self.progress_every >= 0):  # rejects negatives AND NaN
+            raise ValueError(
+                f"progress_every must be >= 0, got {progress_every!r}"
+            )
+        self._on_event = on_event
+        self._stop_requested = False
+        self.handles: list[_StripeHandle] = []
+        for i in range(self.stripes):
+            shard = shard_output_path(output, i, self.stripes)
+            chips = (
+                chips_for_worker(i, chips_per_stripe)
+                if chips_per_stripe is not None
+                else None
+            )
+            env = (
+                env_for(i, chips)
+                if env_for is not None
+                else worker_env(base_env, chips)
+            )
+            if argv_for is not None:
+                argv_first = argv_for(i, self.stripes, resume=self.resume)
+                argv_resume = argv_for(i, self.stripes, resume=True)
+            else:
+                argv_first = stripe_argv(
+                    manifest, output, i, self.stripes, forward_args,
+                    resume=self.resume,
+                )
+                argv_resume = stripe_argv(
+                    manifest, output, i, self.stripes, forward_args,
+                    resume=True,
+                )
+            self.handles.append(
+                _StripeHandle(i, shard, argv_first, argv_resume, env)
+            )
+
+    # -- events --
+
+    def _event(self, message: str) -> None:
+        if self._on_event is not None:
+            self._on_event(message)
+
+    # -- lifecycle primitives --
+
+    def _spawn(self, handle: _StripeHandle, *, first: bool) -> None:
+        argv = handle.argv_first if first else handle.argv_resume
+        log = open(handle.log, "ab")
+        try:
+            handle.proc = subprocess.Popen(
+                argv,
+                env=handle.env,
+                stdin=subprocess.DEVNULL,
+                stdout=subprocess.DEVNULL,
+                stderr=log,
+            )
+        finally:
+            log.close()  # the child holds its own descriptor
+        now = time.perf_counter()
+        handle.spawned_at = now
+        handle.last_growth_t = now
+        handle.last_size = self._shard_size(handle)
+        handle.size_at_spawn = handle.last_size
+        handle.changed_since_spawn = False
+
+    def _shard_size(self, handle: _StripeHandle) -> int:
+        try:
+            return os.path.getsize(handle.shard)
+        except OSError:
+            return -1
+
+    def _schedule_restart(self, handle: _StripeHandle, why: str) -> None:
+        delay = self.backoff.delay_s(handle.restarts)
+        handle.restarts += 1
+        handle.total_restarts += 1
+        handle.next_spawn_at = time.perf_counter() + delay
+        handle.proc = None
+        self._event(
+            f"stripe {handle.index}: {why}; restart "
+            f"{handle.restarts}/{self.max_restarts} in {delay:.2f}s "
+            "(resuming from its shard's completed prefix)"
+        )
+
+    def request_stop(self) -> None:
+        """Ask the run loop to drain: forward SIGTERM to every live
+        stripe, wait for exits, and return without merging.  Signal-
+        handler safe (sets a flag only)."""
+        self._stop_requested = True
+
+    def _drain(self) -> None:
+        for handle in self.handles:
+            proc = handle.proc
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.perf_counter() + self.sigterm_timeout_s
+        for handle in self.handles:
+            proc = handle.proc
+            if proc is None:
+                continue
+            budget = deadline - time.perf_counter()
+            try:
+                proc.wait(timeout=max(0.05, budget))
+            except subprocess.TimeoutExpired:
+                pass
+            terminate_process(proc, 0.5)
+
+    def _abort(self, why: str) -> None:
+        self._drain()
+        raise StripeError(why)
+
+    def _log_tail(self, handle: _StripeHandle, n: int = 800) -> str:
+        try:
+            with open(handle.log, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - n))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    # -- the run loop --
+
+    def run(self) -> dict:
+        """Run every stripe to completion, then merge.  Returns the
+        summary dict (rows written, merged stats, per-stripe detail).
+        Raises StripeError on permanent failure or an operator stop."""
+        t0 = time.perf_counter()
+        if self.resume and self._already_complete():
+            rows = self.n_entries
+            self._event(
+                f"{self.output}: already complete ({rows} rows); "
+                "nothing to do"
+            )
+            # the merge persisted the run's stats/exposition beside the
+            # output, so even a no-op rerun surfaces them (an operator's
+            # --stats-file/--prom-file contract must not silently lapse)
+            stats = None
+            try:
+                with open(
+                    f"{self.output}.stats.json", encoding="utf-8"
+                ) as f:
+                    stats = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass
+            prom = f"{self.output}.prom"
+            return {
+                "stripes": self.stripes,
+                "files": self.n_entries,
+                "rows_written": rows,
+                "already_complete": True,
+                "elapsed_s": 0.0,
+                "stats": stats,
+                "prom": prom if os.path.exists(prom) else None,
+                "per_stripe": [],
+            }
+        for handle in self.handles:
+            try:
+                self._spawn(handle, first=True)
+            except OSError as exc:
+                # drain whatever already spawned: a supervisor that
+                # dies mid-boot must not orphan half a fleet
+                self._abort(
+                    f"stripe {handle.index}: spawn failed: {exc}"
+                )
+            self._event(
+                f"stripe {handle.index}/{self.stripes}: pid "
+                f"{handle.pid} -> {os.path.basename(handle.shard)}"
+            )
+        t_progress = t0
+        while not all(h.done for h in self.handles):
+            if self._stop_requested:
+                self._drain()
+                raise StripeError(
+                    "stopped by operator before completion; shards are "
+                    "resume-safe — rerun the same command to continue"
+                )
+            now = time.perf_counter()
+            for handle in self.handles:
+                if handle.done:
+                    continue
+                proc = handle.proc
+                if proc is None:
+                    if now >= handle.next_spawn_at:
+                        try:
+                            self._spawn(handle, first=False)
+                        except OSError as exc:
+                            self._abort(
+                                f"stripe {handle.index}: respawn "
+                                f"failed: {exc}"
+                            )
+                        self._event(
+                            f"stripe {handle.index}: respawned as pid "
+                            f"{handle.pid}"
+                        )
+                    continue
+                rc = proc.poll()
+                if rc is not None:
+                    handle.exit_codes.append(rc)
+                    if rc == 0:
+                        handle.done = True
+                        handle.proc = None
+                        self._event(
+                            f"stripe {handle.index}: complete"
+                        )
+                        continue
+                    changed = (
+                        handle.changed_since_spawn
+                        or self._shard_size(handle)
+                        != handle.size_at_spawn
+                    )
+                    if changed:
+                        handle.no_growth_failures = 0
+                    elif rc >= 0:
+                        # signal deaths (rc < 0: OOM kill, a stray
+                        # SIGKILL) are environmental, not a config
+                        # error — they use the backoff budget and never
+                        # feed the deterministic-failure counter
+                        handle.no_growth_failures += 1
+                    if handle.no_growth_failures >= 2:
+                        # two consecutive failures without a single row
+                        # written: deterministic (bad corpus path, a
+                        # resume-config mismatch, broken argv) — more
+                        # backoff cycles only delay the real error
+                        tail = self._log_tail(handle)
+                        self._abort(
+                            f"stripe {handle.index} is failing "
+                            "deterministically (repeated exits with no "
+                            f"shard progress, exit codes "
+                            f"{handle.exit_codes[-5:]}); giving up. "
+                            f"Last stderr:\n{tail}"
+                        )
+                    if handle.restarts >= self.max_restarts:
+                        tail = self._log_tail(handle)
+                        self._abort(
+                            f"stripe {handle.index} failed "
+                            f"{handle.restarts + 1} times (exit codes "
+                            f"{handle.exit_codes[-5:]}); giving up. "
+                            f"Last stderr:\n{tail}"
+                        )
+                    self._schedule_restart(handle, f"exit {rc}")
+                    continue
+                # progress probe: the offline twin of the fleet's stats
+                # probe — a live worker whose shard has stopped growing
+                # past the stall timeout is wedged (hung compile,
+                # stopped process) and gets the SIGKILL + restart path
+                size = self._shard_size(handle)
+                if size != handle.last_size:
+                    handle.last_size = size
+                    handle.last_growth_t = now
+                    handle.changed_since_spawn = True
+                    if handle.restarts and (
+                        now - (handle.spawned_at or now)
+                        >= self.backoff.stable_after_s
+                    ):
+                        # sustained progress earns the backoff counter
+                        # back (the fleet supervisor's stable_after_s
+                        # rule): an isolated transient crash per hour
+                        # must never exhaust a lifetime budget mid-run
+                        handle.restarts = 0
+                elif self.stall_timeout_s > 0 and (
+                    now - (handle.spawned_at or now) > self.startup_grace_s
+                ) and (
+                    now - (handle.last_growth_t or now)
+                    > self.stall_timeout_s
+                ):
+                    try:
+                        proc.kill()
+                        proc.wait(timeout=5.0)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+                    if proc.poll() is None:
+                        # still not dead (e.g. wedged in uninterruptible
+                        # sleep): do NOT respawn over a process that may
+                        # wake and keep appending to the shard — retry
+                        # the kill on the next poll instead
+                        self._event(
+                            f"stripe {handle.index}: wedged and "
+                            "SIGKILL has not taken effect yet; "
+                            "retrying before respawn"
+                        )
+                        continue
+                    handle.exit_codes.append(proc.returncode)
+                    if handle.restarts >= self.max_restarts:
+                        self._abort(
+                            f"stripe {handle.index} wedged (no shard "
+                            f"growth for {self.stall_timeout_s:.0f}s) "
+                            "and out of restarts"
+                        )
+                    self._schedule_restart(
+                        handle,
+                        f"wedged (no shard growth for "
+                        f"{self.stall_timeout_s:.0f}s) — SIGKILLed",
+                    )
+            if (
+                self.progress_every
+                and now - t_progress >= self.progress_every
+            ):
+                t_progress = now
+                sizes = " ".join(
+                    f"{h.index}:{max(0, self._shard_size(h))}B"
+                    + ("(done)" if h.done else "")
+                    for h in self.handles
+                )
+                self._event(
+                    f"progress: {sum(h.done for h in self.handles)}/"
+                    f"{self.stripes} stripes done; shards {sizes}"
+                )
+            time.sleep(self.poll_interval_s)
+        summary = self._merge()
+        summary["elapsed_s"] = round(time.perf_counter() - t0, 3)
+        files = summary["rows_written"]
+        if summary["elapsed_s"] > 0:
+            summary["files_per_sec"] = round(
+                files / summary["elapsed_s"], 1
+            )
+        return summary
+
+    # -- completion + merge --
+
+    def _count_complete_rows(self, path: str) -> int:
+        """Newline-terminated line count (a torn tail does not count —
+        the same definition as BatchProject._resume_point, without the
+        truncation side effect)."""
+        n = 0
+        try:
+            with open(path, "rb") as f:
+                for line in f:
+                    if line.endswith(b"\n"):
+                        n += 1
+        except OSError:
+            return 0
+        return n
+
+    def _already_complete(self) -> bool:
+        return (
+            os.path.exists(self.output)
+            and self._count_complete_rows(self.output) == self.n_entries
+        )
+
+    def _merge(self) -> dict:
+        """Deterministic shard -> output merge: verify every shard's
+        row count equals its stripe span, concatenate in stripe order
+        (atomic replace), merge stats and Prometheus expositions, then
+        remove the per-stripe files.  With one stripe the child already
+        wrote ``output`` directly (shard_output_path keeps the plain
+        path at count<=1) and only the bookkeeping merges."""
+        per_stripe = {h.index: h.as_dict() for h in self.handles}
+        total = 0
+        for handle in self.handles:
+            lo, hi = manifest_stripe(
+                self.n_entries, handle.index, self.stripes
+            )
+            rows = self._count_complete_rows(handle.shard)
+            if rows != hi - lo:
+                raise StripeError(
+                    f"shard {handle.shard} has {rows} complete rows, "
+                    f"expected {hi - lo} (stripe [{lo}, {hi})); refusing "
+                    "to merge a short shard"
+                )
+            total += rows
+        if self.stripes > 1:
+            tmp = f"{self.output}.merge.tmp"
+            with open(tmp, "wb") as out:
+                for handle in self.handles:
+                    with open(handle.shard, "rb") as f:
+                        while True:
+                            block = f.read(1 << 20)
+                            if not block:
+                                break
+                            out.write(block)
+            os.replace(tmp, self.output)
+            # the merged output is a complete single-file run: carry
+            # shard 0's config sidecar so a later single-process resume
+            # of this output file sees the config that produced it
+            shard0_meta = f"{self.handles[0].shard}.meta.json"
+            if os.path.exists(shard0_meta):
+                os.replace(shard0_meta, f"{self.output}.meta.json")
+        stats_rows = []
+        expositions: dict[str, str] = {}
+        for handle in self.handles:
+            stats_path = f"{handle.shard}.stats.json"
+            try:
+                with open(stats_path, encoding="utf-8") as f:
+                    row = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                row = None
+            if row is not None:
+                stats_rows.append(row)
+                # per-stripe detail rides the summary (the bench reads
+                # each stripe's steady-state elapsed from here)
+                per_stripe[handle.index]["stats"] = row
+            prom_path = f"{handle.shard}.prom"
+            try:
+                with open(prom_path, encoding="utf-8") as f:
+                    expositions[f"stripe{handle.index}"] = f.read()
+            except OSError:
+                pass
+        merged_stats = merge_stats(stats_rows) if stats_rows else None
+        if merged_stats is not None:
+            # persist beside the output (atomic) so a rerun over the
+            # complete output can still surface the run's stats
+            tmp = f"{self.output}.stats.json.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(merged_stats, f)
+                f.write("\n")
+            os.replace(tmp, f"{self.output}.stats.json")
+        prom_out = None
+        if expositions:
+            from licensee_tpu.obs import merge_expositions
+
+            prom_out = f"{self.output}.prom"
+            with open(prom_out, "w", encoding="utf-8") as f:
+                f.write(merge_expositions(expositions, label="stripe"))
+        self._cleanup()
+        self._event(
+            f"merged {self.stripes} shard(s) -> {self.output} "
+            f"({total} rows)"
+        )
+        return {
+            "stripes": self.stripes,
+            "files": self.n_entries,
+            "rows_written": total,
+            "already_complete": False,
+            "stats": merged_stats,
+            "prom": prom_out,
+            "per_stripe": [per_stripe[i] for i in sorted(per_stripe)],
+        }
+
+    def _cleanup(self) -> None:
+        """Remove per-stripe intermediates after a successful merge (the
+        work is complete and lives in ``output``; stale shards would
+        otherwise confuse the next striped run's resume).  The shard
+        sweep is a GLOB over ``<output>.shard-*`` — an earlier aborted
+        run at a DIFFERENT stripe count left shards this run's handles
+        don't name, and a future run at that count must never resume
+        from months-stale rows.  Merge products themselves are kept —
+        with one stripe the shard paths ARE the output paths."""
+        import glob as globlib
+
+        keep = {
+            self.output,
+            f"{self.output}.prom",
+            f"{self.output}.meta.json",
+            f"{self.output}.stats.json",
+        }
+        doomed = set(
+            globlib.glob(f"{globlib.escape(self.output)}.shard-*")
+        )
+        for handle in self.handles:
+            for suffix in ("", ".meta.json", ".stats.json", ".prom",
+                           ".log"):
+                doomed.add(f"{handle.shard}{suffix}")
+        for path in doomed:
+            if path in keep:
+                continue
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def selftest(stream=None) -> int:
+    """The 2-stripe CPU smoke for script/cibuild: a small synthetic
+    corpus runs once single-striped and once 2-striped through REAL
+    batch-detect child processes; the merged 2-stripe output must be
+    bit-identical to the 1-stripe run, stats must sum to the manifest
+    length, and the merged exposition must parse.  Returns 0/1."""
+    import tempfile
+
+    stream = stream if stream is not None else sys.stderr
+
+    def say(msg: str) -> None:
+        stream.write(f"stripes-selftest: {msg}\n")
+        stream.flush()
+
+    import re
+
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.obs import check_exposition
+
+    bodies = [
+        re.sub(r"\[(\w+)\]", "example", License.find(k).content or "")
+        for k in ("mit", "isc", "bsd-3-clause")
+    ]
+    with tempfile.TemporaryDirectory(prefix="licensee-stripes-") as tmpdir:
+        paths = []
+        for i in range(42):
+            p = os.path.join(tmpdir, f"LICENSE_{i}")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(
+                    f"Copyright (c) {2000 + i} Example Author {i}\n\n"
+                    + bodies[i % len(bodies)]
+                )
+            paths.append(p)
+        manifest = os.path.join(tmpdir, "manifest.txt")
+        with open(manifest, "w", encoding="utf-8") as f:
+            f.write("\n".join(paths) + "\n")
+        base_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        forward = ("--batch-size", "16", "--mesh", "none")
+        outputs = {}
+        for n in (1, 2):
+            out = os.path.join(tmpdir, f"out-{n}.jsonl")
+            runner = StripeRunner(
+                manifest, out, n,
+                forward_args=forward,
+                base_env=base_env,
+                on_event=say,
+            )
+            summary = runner.run()
+            if summary["rows_written"] != len(paths):
+                say(
+                    f"FAIL: {n}-stripe run wrote "
+                    f"{summary['rows_written']} rows, want {len(paths)}"
+                )
+                return 1
+            if n > 1:
+                stats = summary["stats"] or {}
+                if stats.get("total") != len(paths):
+                    say(f"FAIL: merged stats total {stats.get('total')}")
+                    return 1
+                prom = summary.get("prom")
+                if prom:
+                    with open(prom, encoding="utf-8") as f:
+                        problems = check_exposition(f.read())
+                    if problems:
+                        say(f"FAIL: merged exposition: {problems[:3]}")
+                        return 1
+            outputs[n] = open(out, "rb").read()
+        if outputs[1] != outputs[2]:
+            say("FAIL: 2-stripe merged output != 1-stripe output")
+            return 1
+        rows = [
+            json.loads(line)
+            for line in outputs[2].decode().splitlines()
+        ]
+        seen_paths = [r["path"] for r in rows]
+        if len(set(seen_paths)) != len(paths):
+            say("FAIL: duplicate paths across shards")
+            return 1
+        matched = sum(1 for r in rows if r.get("key"))
+        say(
+            f"OK: 2-stripe merge bit-identical to 1-stripe "
+            f"({len(rows)} rows, {matched} matched)"
+        )
+    return 0

@@ -47,13 +47,24 @@ incl. the libtpu co-location vars (exported per contract; real
 multi-chip hardware is not available to this build env).  bench.py prints the live
 model (serial_fraction, amdahl ceiling, striped-process count) under
 details.host_model on every run.
+
+Update r6: the JSONL finish/write loop moved off the main thread onto a
+bounded writer thread (see run() — order preserved by sequence numbers,
+resume invariant unchanged), so the per-process serial section is now
+dispatch+finish only and the Amdahl ceiling rises accordingly; and the
+manifest-striping contract became a one-command launcher
+(`licensee-tpu batch-detect --stripes N`, parallel/stripes.py) that
+spawns co-located stripe processes under a supervisor and merges their
+shards/stats/expositions deterministically.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
 import sys
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -331,13 +342,15 @@ class BatchProject:
             except Exception:
                 process_count, process_index = 1, 0
         if process_count > 1:
-            from licensee_tpu.parallel.distributed import manifest_stripe
+            from licensee_tpu.parallel.distributed import (
+                count_manifest_entries,
+                manifest_stripe,
+            )
 
-            n = 0
-            with open(manifest_file, encoding="utf-8") as f:
-                for line in f:
-                    if line.strip():
-                        n += 1
+            # the SHARED counter (also the stripe runner's span
+            # denominator): supervisor and worker must agree on what an
+            # entry is, or the merge's row-count check fails
+            n = count_manifest_entries(manifest_file)
             lo, hi = manifest_stripe(n, process_index, process_count)
             paths = []
             k = 0
@@ -577,120 +590,87 @@ class BatchProject:
                             )
                 pending.append((batches, merged, device_out))
 
-            while futures or pending or gather:
-                # pull produced batches into the coalescing buffer; keep
-                # up to 2 dispatched groups in flight before draining
-                while futures and len(pending) < 2:
-                    (chunk, read_errs, keys, preset, dup_of, routes, prepared,
-                     contents, pre_rows,
-                     (t_read, t_feat)) = futures.popleft().result()
-                    submit_next()
-                    self.stats.add_stage("read", t_read)
-                    self.stats.add_stage("featurize", t_feat)
-                    trace = None
-                    if self._tracer is not None:
-                        chunk_no += 1
-                        trace = self._tracer.start(
-                            request_id=f"chunk-{chunk_no}"
-                        )
-                        # the produce stages ran on a worker BEFORE the
-                        # trace existed: rebase t_start so their spans
-                        # sit at t>=0 and the trace duration covers the
-                        # chunk's whole pipeline residency
-                        trace.t_start -= t_read + t_feat
-                        trace.add_span("read", t_read, t0=trace.t_start)
-                        trace.add_span(
-                            "featurize", t_feat, t0=trace.t_start + t_read
-                        )
-                    if self.dedupe:
-                        # re-probe the cross-batch cache on the main
-                        # thread: rows produced during the pipeline /
-                        # coalescing lag (and, in process mode, every
-                        # row — the worker can't see the parent's cache)
-                        # pick up results finished since their produce
-                        cache = self._dedupe_cache
-                        hit = False
-                        for i, k in enumerate(keys):
-                            if k is not None and preset[i] is None:
-                                cached = cache.get(k)
-                                if cached is not None:
-                                    preset[i] = cached
-                                    prepared.results[i] = cached
-                                    hit = True
-                        if hit:
-                            prepared.todo = [
-                                i
-                                for i, r in enumerate(prepared.results)
-                                if r is None
-                            ]
-                    if len(prepared.todo) < len(prepared.results):
-                        # free the dense feature arrays while the batch
-                        # waits in the buffer; merge becomes a concat
-                        prepared.compact_features()
-                    gather.append(
-                        (chunk, read_errs, keys, preset, dup_of, routes,
-                         prepared, contents, pre_rows, trace)
-                    )
-                    gather_todo += len(prepared.todo)
-                    if (
-                        gather_todo >= self.classifier.pad_batch_to
-                        or len(gather) >= self.coalesce_batches
-                        or gather_todo == 0
-                    ):
-                        # a group with no device rows finishes instantly
-                        # — holding it back would only delay its writes
-                        # (and the dedupe-cache fills they produce)
-                        dispatch_gathered()
+            # -- the writer thread --
+            #
+            # The finish/write loop (dup resolution, attribution, stats,
+            # dedupe-cache fills, row rendering, the JSONL write) used
+            # to run on the main thread, where it was part of the
+            # pipeline's SERIAL section — Amdahl's ceiling for one
+            # process (the scaling-model ADR above) included every one
+            # of those microseconds.  It now runs on a dedicated writer
+            # thread behind a BOUNDED handoff queue: the main thread
+            # only coalesces/dispatches/finishes device chunks and hands
+            # each batch over tagged with a sequence number; the writer
+            # asserts the numbers arrive contiguous, so rows land in
+            # manifest order and the resume invariant (line count ==
+            # completed prefix of the stripe) is untouched.  The queue
+            # bound keeps memory flat when scoring outruns the disk.
+            #
+            # Sharing notes: the writer is the ONLY mutator of the
+            # result counters and the only INSERTER into the dedupe
+            # cache; the main thread's cache re-probe and the produce
+            # workers' reads are GIL-atomic dict ops, and a fill that is
+            # still in the queue merely costs a duplicate device score
+            # with a bit-identical result.
+            write_q: queue.Queue = queue.Queue(maxsize=8)
+            writer_err: list[BaseException] = []
+            next_seq = 0
 
-                if not pending:
-                    # stream tail (or an under-filled group with nothing
-                    # else in flight): dispatch what we have
-                    dispatch_gathered()
-                batches, merged, device_out = pending.popleft()
-                t0 = time.perf_counter()
-                if merged is not None:
-                    self.classifier.finish_chunks(
-                        merged, device_out, self.threshold
-                    )
-                    self.classifier.scatter_merged(
-                        [b[6] for b in batches], merged
-                    )
-                dt_score = time.perf_counter() - t0
-                self.stats.add_stage("score", dt_score)
-                if merged is not None:
-                    for b in batches:
-                        if b[9] is not None:
-                            b[9].add_span("score", dt_score, t0=t0)
-                for (chunk, read_errs, keys, preset, dup_of, routes, prepared,
-                     contents, pre_rows, trace) in batches:
-                    results = prepared.results
-                    for i, j in dup_of.items():
-                        results[i] = results[j]
-                    t1 = time.perf_counter()
-                    cache = self._dedupe_cache
-                    lines: list[str] = []
-                    for k, (path, is_err, result) in enumerate(
-                        zip(chunk, read_errs, results)
-                    ):
-                        error = None
-                        if is_err:
-                            # distinguish "could not read" from "no
-                            # license"
-                            error = "read_error"
-                            self.stats.read_errors += 1
-                        elif result.error:
-                            # poisoned blob: contained per-row, run
-                            # continues
-                            error = result.error
-                            self.stats.featurize_errors += 1
-                        else:
-                            if (
-                                self.attribution
-                                and preset[k] is None
-                                and result.key is not None
-                            ):
-                                result.attribution = (
-                                    self.classifier.attribution_for(
+            def write_loop() -> None:
+                nonlocal t_progress
+                expect_seq = 0
+                stats = self.stats
+                cache = self._dedupe_cache
+                dedupe = self.dedupe
+                dedupe_cap = self.dedupe_cap
+                attribution = self.attribution
+                attribution_for = self.classifier.attribution_for
+                count = self._count
+                while True:
+                    item = write_q.get()
+                    if item is None:
+                        return
+                    if writer_err:
+                        continue  # drain: the producer must never block
+                    try:
+                        seq, batch = item
+                        if seq != expect_seq:
+                            raise RuntimeError(
+                                f"writer sequence gap: got {seq}, "
+                                f"expected {expect_seq} — manifest order "
+                                "(the resume invariant) would break"
+                            )
+                        expect_seq += 1
+                        (chunk, read_errs, keys, preset, dup_of, routes,
+                         prepared, contents, pre_rows, trace) = batch
+                        results = prepared.results
+                        for i, j in dup_of.items():
+                            results[i] = results[j]
+                        t1 = time.perf_counter()
+                        read_errors = featurize_errors = dedupe_hits = 0
+                        lines: list[str] = []
+                        append = lines.append
+                        for k, (path, is_err, result) in enumerate(
+                            zip(chunk, read_errs, results)
+                        ):
+                            error = None
+                            if is_err:
+                                # distinguish "could not read" from "no
+                                # license"
+                                error = "read_error"
+                                read_errors += 1
+                            elif result.error:
+                                # poisoned blob: contained per-row, run
+                                # continues
+                                error = result.error
+                                featurize_errors += 1
+                            else:
+                                if (
+                                    attribution
+                                    and preset[k] is None
+                                    and result.key is not None
+                                ):
+                                    result.attribution = attribution_for(
                                         contents[k],
                                         os.path.basename(path),
                                         result,
@@ -700,71 +680,192 @@ class BatchProject:
                                             else None
                                         ),
                                     )
-                                )
-                            self._count(result)
-                            if routes is not None and routes[k] is None:
-                                pass  # unrecognized filename: no cache
-                            elif preset[k] is not None:
-                                self.stats.dedupe_hits += 1
-                            elif self.dedupe and keys[k] is not None:
-                                if len(cache) >= self.dedupe_cap:
-                                    # FIFO bound
-                                    cache.pop(next(iter(cache)))
-                                # snapshot, not alias: the cached result
-                                # will be handed out as a preset row many
-                                # times — a copy with a tuple closest
-                                # list means no later batch-finishing (or
-                                # future per-row annotation) can reach
-                                # back and corrupt it
-                                cache[keys[k]] = replace(
-                                    result,
-                                    closest=(
-                                        tuple(result.closest)
-                                        if result.closest is not None
-                                        else None
-                                    ),
-                                )
-                        self.stats.total += 1
-                        if routes is not None:
-                            self.stats.add_route(routes[k])
-                        # preset rows were rendered on the produce worker
-                        # (_produce_batch pre_rows); everything else
-                        # renders here, after finish/attribution
+                                count(result)
+                                if (
+                                    routes is not None
+                                    and routes[k] is None
+                                ):
+                                    pass  # unrecognized name: no cache
+                                elif preset[k] is not None:
+                                    dedupe_hits += 1
+                                elif dedupe and keys[k] is not None:
+                                    if len(cache) >= dedupe_cap:
+                                        # FIFO bound
+                                        cache.pop(next(iter(cache)))
+                                    # snapshot, not alias: the cached
+                                    # result will be handed out as a
+                                    # preset row many times — a copy
+                                    # with a tuple closest list means no
+                                    # later batch-finishing (or future
+                                    # per-row annotation) can reach back
+                                    # and corrupt it
+                                    cache[keys[k]] = replace(
+                                        result,
+                                        closest=(
+                                            tuple(result.closest)
+                                            if result.closest is not None
+                                            else None
+                                        ),
+                                    )
+                            if routes is not None:
+                                stats.add_route(routes[k])
+                            # preset rows were rendered on the produce
+                            # worker (_produce_batch pre_rows);
+                            # everything else renders here, after
+                            # finish/attribution
+                            if (
+                                pre_rows is not None
+                                and pre_rows[k] is not None
+                                and error is None  # insurance; see above
+                            ):
+                                append(pre_rows[k])
+                            else:
+                                append(_jsonl_row(path, result, error))
+                        append("")
+                        out.write("\n".join(lines))
+                        out.flush()
+                        # batched bookkeeping: one counter update per
+                        # batch instead of one per row
+                        stats.total += len(chunk)
+                        stats.read_errors += read_errors
+                        stats.featurize_errors += featurize_errors
+                        stats.dedupe_hits += dedupe_hits
+                        t2 = time.perf_counter()
+                        stats.add_stage("write", t2 - t1)
+                        if trace is not None:
+                            trace.add_span("write", t2 - t1, t0=t1)
+                            self._tracer.finish(trace)
                         if (
-                            pre_rows is not None
-                            and pre_rows[k] is not None
-                            and error is None  # insurance; see above
+                            self.progress_every
+                            and t2 - t_progress >= self.progress_every
                         ):
-                            lines.append(pre_rows[k])
-                        else:
-                            lines.append(_jsonl_row(path, result, error))
-                    lines.append("")
-                    out.write("\n".join(lines))
-                    out.flush()
-                    t2 = time.perf_counter()
-                    self.stats.add_stage("write", t2 - t1)
-                    if trace is not None:
-                        trace.add_span("write", t2 - t1, t0=t1)
-                        self._tracer.finish(trace)
-                    if (
-                        self.progress_every
-                        and t2 - t_progress >= self.progress_every
-                    ):
-                        t_progress = t2
-                        print(
-                            json.dumps(
-                                {
-                                    "progress": self.stats.total,
-                                    "of": len(self.paths) - done,
-                                    "files_per_sec": round(
-                                        self.stats.total / (t2 - t_run), 1
-                                    ),
-                                    "dedupe_hits": self.stats.dedupe_hits,
-                                }
-                            ),
-                            file=sys.stderr,
-                            flush=True,
+                            t_progress = t2
+                            print(
+                                json.dumps(
+                                    {
+                                        "progress": stats.total,
+                                        "of": len(self.paths) - done,
+                                        "files_per_sec": round(
+                                            stats.total / (t2 - t_run), 1
+                                        ),
+                                        "dedupe_hits": stats.dedupe_hits,
+                                    }
+                                ),
+                                file=sys.stderr,
+                                flush=True,
+                            )
+                    except BaseException as exc:  # noqa: BLE001
+                        writer_err.append(exc)
+
+            writer = threading.Thread(
+                target=write_loop, name="batch-writer", daemon=True
+            )
+            writer.start()
+
+            try:
+                while futures or pending or gather:
+                    if writer_err:
+                        break  # the writer's failure is raised below
+                    # pull produced batches into the coalescing buffer;
+                    # keep up to 2 dispatched groups in flight before
+                    # draining
+                    while futures and len(pending) < 2:
+                        (chunk, read_errs, keys, preset, dup_of, routes,
+                         prepared, contents, pre_rows,
+                         (t_read, t_feat)) = futures.popleft().result()
+                        submit_next()
+                        self.stats.add_stage("read", t_read)
+                        self.stats.add_stage("featurize", t_feat)
+                        trace = None
+                        if self._tracer is not None:
+                            chunk_no += 1
+                            trace = self._tracer.start(
+                                request_id=f"chunk-{chunk_no}"
+                            )
+                            # the produce stages ran on a worker BEFORE
+                            # the trace existed: rebase t_start so their
+                            # spans sit at t>=0 and the trace duration
+                            # covers the chunk's whole pipeline residency
+                            trace.t_start -= t_read + t_feat
+                            trace.add_span(
+                                "read", t_read, t0=trace.t_start
+                            )
+                            trace.add_span(
+                                "featurize", t_feat,
+                                t0=trace.t_start + t_read,
+                            )
+                        if self.dedupe:
+                            # re-probe the cross-batch cache on the main
+                            # thread: rows produced during the pipeline/
+                            # coalescing lag (and, in process mode,
+                            # every row — the worker can't see the
+                            # parent's cache) pick up results finished
+                            # since their produce
+                            cache = self._dedupe_cache
+                            hit = False
+                            for i, k in enumerate(keys):
+                                if k is not None and preset[i] is None:
+                                    cached = cache.get(k)
+                                    if cached is not None:
+                                        preset[i] = cached
+                                        prepared.results[i] = cached
+                                        hit = True
+                            if hit:
+                                prepared.todo = [
+                                    i
+                                    for i, r in enumerate(prepared.results)
+                                    if r is None
+                                ]
+                        if len(prepared.todo) < len(prepared.results):
+                            # free the dense feature arrays while the
+                            # batch waits in the buffer; merge becomes a
+                            # concat
+                            prepared.compact_features()
+                        gather.append(
+                            (chunk, read_errs, keys, preset, dup_of,
+                             routes, prepared, contents, pre_rows, trace)
                         )
+                        gather_todo += len(prepared.todo)
+                        if (
+                            gather_todo >= self.classifier.pad_batch_to
+                            or len(gather) >= self.coalesce_batches
+                            or gather_todo == 0
+                        ):
+                            # a group with no device rows finishes
+                            # instantly — holding it back would only
+                            # delay its writes (and the dedupe-cache
+                            # fills they produce)
+                            dispatch_gathered()
+
+                    if not pending:
+                        # stream tail (or an under-filled group with
+                        # nothing else in flight): dispatch what we have
+                        dispatch_gathered()
+                    batches, merged, device_out = pending.popleft()
+                    t0 = time.perf_counter()
+                    if merged is not None:
+                        self.classifier.finish_chunks(
+                            merged, device_out, self.threshold
+                        )
+                        self.classifier.scatter_merged(
+                            [b[6] for b in batches], merged
+                        )
+                    dt_score = time.perf_counter() - t0
+                    self.stats.add_stage("score", dt_score)
+                    if merged is not None:
+                        for b in batches:
+                            if b[9] is not None:
+                                b[9].add_span("score", dt_score, t0=t0)
+                    # hand the finished batches to the writer, in
+                    # manifest order, tagged for the sequence check
+                    for b in batches:
+                        write_q.put((next_seq, b))
+                        next_seq += 1
+            finally:
+                write_q.put(None)
+                writer.join()
+            if writer_err:
+                raise writer_err[0]
         self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats
 

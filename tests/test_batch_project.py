@@ -510,3 +510,43 @@ def test_resume_fingerprint_pins_template_content(tmp_path):
     with pytest.raises(ValueError, match="corpus"):
         project.run(str(out), resume=True)
     assert out.read_text() == before
+
+
+def test_writer_thread_failure_propagates_without_deadlock(
+    tmp_path, monkeypatch
+):
+    """The finish/write loop runs on a dedicated writer thread (the r6
+    serial-path reduction): a failure there must surface as run()'s
+    exception — never a silent truncation, never a producer blocked
+    forever on the bounded handoff queue."""
+    import licensee_tpu.projects.batch_project as bp
+
+    calls = {"n": 0}
+    real_row = bp._jsonl_row
+
+    def poisoned_row(path, result, error):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("disk on fire")
+        return real_row(path, result, error)
+
+    monkeypatch.setattr(bp, "_jsonl_row", poisoned_row)
+    paths = manifest_paths() * 3  # several batches through the queue
+    project = BatchProject(paths, batch_size=2, workers=1)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        project.run(str(tmp_path / "out.jsonl"), resume=False)
+
+
+def test_writer_thread_keeps_manifest_order_across_many_batches(tmp_path):
+    """Rows must land in manifest order (the resume invariant) even
+    with many small batches racing through the dispatch -> writer
+    handoff."""
+    paths = manifest_paths() * 5
+    out = tmp_path / "out.jsonl"
+    project = BatchProject(paths, batch_size=2)
+    stats = project.run(str(out), resume=False)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["path"] for r in rows] == paths
+    assert stats.total == len(paths)
+    # the write stage is accounted by the writer thread
+    assert "write" in stats.stage_seconds

@@ -108,8 +108,30 @@ def _fat_details() -> dict:
             "z" * 30: 9.9,
             "featurize_us_per_blob": 99_999_999.9,
             "scaling_model": {
+                "serial_us_per_blob": 99999.9,
                 "amdahl_ceiling_files_per_sec": 99_999_999.9,
             },
+        },
+        "stripes": {
+            "files": 1_000_000,
+            "host_cores": 224,
+            "auto_stripes": 16,
+            "stripes": 4,
+            "1_stripe": {
+                "rows": 1_000_000,
+                "files_per_sec": 99_999_999.9,
+                "wall_files_per_sec": 99_999_999.9,
+                "restarts": 99,
+            },
+            "4_stripes": {
+                "rows": 1_000_000,
+                "files_per_sec": 99_999_999.9,
+                "wall_files_per_sec": 99_999_999.9,
+                "restarts": 99,
+            },
+            "identical_output": True,
+            "speedup": 99.99,
+            "predicted_speedup": 99.99,
         },
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
@@ -167,9 +189,16 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["obs"]["prom_lines"] == 99_999_999
     assert d["obs"]["traces"] == 99_999_999
     assert d["host_model"]["featurize_us_per_blob"] == 99_999_999.9
+    assert d["host_model"]["serial_us_per_blob"] == 99999.9
     assert (
         d["host_model"]["amdahl_ceiling_files_per_sec"] == 99_999_999.9
     )
+    assert d["stripes"]["n"] == 4
+    assert d["stripes"]["files_per_sec_1"] == 99_999_999.9
+    assert d["stripes"]["files_per_sec_n"] == 99_999_999.9
+    assert d["stripes"]["speedup"] == 99.99
+    assert d["stripes"]["predicted_speedup"] == 99.99
+    assert d["stripes"]["identical_output"] is True
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 
@@ -178,10 +207,12 @@ def test_headline_survives_missing_rows(bench_mod):
     balloon."""
     details = _fat_details()
     for k in ("end_to_end_1m", "end_to_end_1m_auto", "scalar_agreement",
-              "end_to_end_readme", "serve_path", "fleet"):
+              "end_to_end_readme", "serve_path", "fleet", "stripes"):
         details[k] = None
     headline = bench_mod.make_headline("m", 1.0, 1.0, details)
     assert headline["details"]["at_scale_license"]["resume_ok"] is None
     assert headline["details"]["e2e_files_per_sec"]["readme"] is None
     assert headline["details"]["serve_path"]["cached_rps"] is None
     assert headline["details"]["fleet"]["rps_2w"] is None
+    assert headline["details"]["stripes"]["speedup"] is None
+    assert headline["details"]["stripes"]["identical_output"] is None
