@@ -1,0 +1,63 @@
+"""Concurrency-aware AST static analysis (``script/analyze``).
+
+The repo grew from a batch kernel into a threaded serving stack —
+micro-batcher, writer thread, fleet supervisor/router, stripe runner —
+and the next tentpoles (async router core, double-buffered host/device
+overlap, blue/green corpus reload) all add shared-mutable-state
+concurrency.  ``script/lint`` is a regex pass over raw text; it cannot
+see scopes, locks, or call structure.  This package is the real
+static-analysis layer: a shared parse + scope/class visitor
+infrastructure (``scopes.py``), a rule registry with path-component
+gating and inline pragmas (``core.py``), and the rule set:
+
+== =====================  ================================================
+1  ``lock-discipline``    per class, infer the lock-guarded attribute set
+                          from writes inside ``with self._lock:`` blocks,
+                          then flag lock-free reads/writes of those
+                          attributes in thread-reachable methods
+2  ``blocking-call``      ``time.sleep``/socket verbs/file I/O/subprocess
+                          waits inside router dispatch/handler paths
+3  ``resource-leak``      sockets, ``Popen``, file handles without
+                          ``with``/``finally`` close on all paths
+4  ``tracer-purity``      ``jax.jit``/``vmap`` functions calling host
+                          effects or branching on tracer values
+5  ``wallclock-time``     AST-accurate monotonic-clock house rule
+6  ``no-print``           AST-accurate no-print house rule
+7  ``per-blob-featurize`` AST-accurate batch-crossing house rule
+== =====================  ================================================
+
+Suppress a finding with ``# analysis: disable=rule-id`` plus a written
+justification (see core.py for scope semantics); ``script/analyze``
+exits non-zero on any unsuppressed finding and runs in script/cibuild
+before the test suite.
+"""
+
+from licensee_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Module,
+    RULES,
+    analyze_module,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+    main,
+)
+
+# importing the rule modules registers their rules
+from licensee_tpu.analysis import (  # noqa: F401  (registration imports)
+    rules_concurrency,
+    rules_house,
+    rules_resources,
+    rules_tracer,
+)
+
+__all__ = [
+    "Finding",
+    "Module",
+    "RULES",
+    "analyze_module",
+    "analyze_paths",
+    "analyze_source",
+    "iter_python_files",
+    "main",
+]

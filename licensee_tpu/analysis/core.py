@@ -1,0 +1,340 @@
+"""The rule engine: one shared parse per file, a rule registry with
+path-component gating, pragma suppression, and the ``script/analyze``
+driver.
+
+Each rule is a function ``check(module: Module) -> list[Finding]``
+registered under a stable rule id.  The engine parses every file ONCE
+(``ast`` tree + ``tokenize`` comment scan) and hands the same ``Module``
+to every applicable rule, so adding a rule costs one AST walk, never a
+re-parse.  Findings print as ``path:line: rule-id: message`` and the
+driver exits non-zero when any survive pragma filtering.
+
+Pragmas (the escape hatch — every use needs a justification comment):
+
+* ``# analysis: disable=rule-id[,rule-id2]`` on the offending line, or
+  as a standalone comment on the line directly above it, suppresses the
+  named rules (or ``all``) for that line.
+* The same pragma on a ``def``/``class`` line suppresses the named
+  rules for the whole body — for functions whose contract is the
+  exception (e.g. "caller holds the lock" spawn helpers).
+
+Dir gating matches on PATH COMPONENTS, never string prefixes: the gate
+``licensee_tpu/parallel/stripes`` applies to ``stripes.py`` and any
+future ``stripes/`` package, but never to a ``stripes_util.py`` that
+merely shares the prefix (the script/lint bug this engine replaces).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass
+
+PRAGMA_PREFIX = "analysis:"
+SKIP_DIRS = {
+    ".git", "__pycache__", ".pytest_cache", ".hypothesis", "dist",
+    "build", "vendor", "tests", ".venv", "venv", ".tox", ".eggs",
+    "node_modules", ".claude",
+}
+# what `script/analyze` scans by default: the product tree and the
+# repo's executable scripts (tests/ are excluded — they exercise
+# violations on purpose; the fixture corpus under tests/fixtures/
+# doubly so)
+DEFAULT_SCAN = ("licensee_tpu", "script", "bin", "bench.py")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+class Module:
+    """One parsed source file: the AST, raw lines, the pragma map, and
+    the repo-relative path split into components for dir gating."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text)
+        self.lines = text.splitlines()
+        # {lineno: set of rule ids (or {"all"})} for inline pragmas;
+        # standalone-comment pragmas are resolved at filter time
+        self.pragmas, self.pragma_only_lines = _collect_pragmas(text)
+        self.parts = tuple(p for p in rel.replace(os.sep, "/").split("/") if p)
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = (
+            node_or_line
+            if isinstance(node_or_line, int)
+            else node_or_line.lineno
+        )
+        return Finding(self.rel, line, rule, message)
+
+    # -- pragma filtering --
+
+    def suppressed(self, finding: Finding) -> bool:
+        for line in (finding.line, finding.line - 1):
+            rules = self.pragmas.get(line)
+            if rules is None:
+                continue
+            if line != finding.line and line not in self.pragma_only_lines:
+                continue  # a trailing pragma governs its OWN line only
+            if "all" in rules or finding.rule in rules:
+                return True
+        return self._suppressed_by_scope(finding)
+
+    def _suppressed_by_scope(self, finding: Finding) -> bool:
+        """A pragma on a ``def``/``class`` line — or a standalone
+        pragma comment directly above one — covers the whole body."""
+        for line, rules in self.pragmas.items():
+            if not ("all" in rules or finding.rule in rules):
+                continue
+            candidates = [line]
+            if line in self.pragma_only_lines:
+                candidates.append(line + 1)
+            for cand in candidates:
+                scope = self._scope_span(cand)
+                if (
+                    scope is not None
+                    and scope[0] <= finding.line <= scope[1]
+                ):
+                    return True
+        return False
+
+    def _scope_span(self, line: int):
+        spans = getattr(self, "_scope_spans", None)
+        if spans is None:
+            spans = {}
+            for node in ast.walk(self.tree):
+                if isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    span = (node.lineno, node.end_lineno)
+                    spans[node.lineno] = span
+                    # a decorated def starts, for pragma purposes, at
+                    # its first decorator: "directly above the def"
+                    # must keep working when @jax.jit sits in between
+                    for deco in node.decorator_list:
+                        spans.setdefault(deco.lineno, span)
+            self._scope_spans = spans
+        return spans.get(line)
+
+
+def _collect_pragmas(text: str):
+    """COMMENT tokens matching ``# analysis: disable=...`` — tokenizing
+    (not regexing) means a pragma inside a string literal is inert,
+    exactly like the rules the pragmas govern."""
+    pragmas: dict[int, set[str]] = {}
+    pragma_only: set[int] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            body = tok.string.lstrip("#").strip()
+            if not body.startswith(PRAGMA_PREFIX):
+                continue
+            directive = body[len(PRAGMA_PREFIX):].strip()
+            if not directive.startswith("disable="):
+                continue
+            # everything after the first whitespace is justification
+            # prose: `# analysis: disable=rule-id — why this is fine`
+            rule_list = directive[len("disable="):].split(None, 1)[0]
+            rules = {
+                r.strip() for r in rule_list.split(",") if r.strip()
+            }
+            if not rules:
+                continue
+            line = tok.start[0]
+            pragmas.setdefault(line, set()).update(rules)
+            if not tok.line[: tok.start[1]].strip():
+                pragma_only.add(line)
+    except tokenize.TokenError:
+        pass
+    return pragmas, pragma_only
+
+
+# -- the rule registry --
+
+
+@dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    check: object  # callable(Module) -> list[Finding]
+    dirs: tuple[tuple[str, ...], ...] | None  # None: every scanned file
+    doc: str
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, dirs=None, doc: str = ""):
+    """Register ``check(module)`` under ``rule_id``.  ``dirs`` is an
+    iterable of ``a/b/c`` gates matched on path components (a gate's
+    last component also matches ``<component>.py``)."""
+
+    def deco(fn):
+        gates = (
+            None
+            if dirs is None
+            else tuple(tuple(d.split("/")) for d in dirs)
+        )
+        RULES[rule_id] = Rule(rule_id, fn, gates, doc or (fn.__doc__ or ""))
+        return fn
+
+    return deco
+
+
+def gate_matches(parts: tuple[str, ...], gate: tuple[str, ...]) -> bool:
+    """Component-wise prefix match; the gate's LAST component also
+    matches a module file of that name (``.../stripes`` covers both a
+    ``stripes/`` package and ``stripes.py``)."""
+    if len(parts) < len(gate):
+        return False
+    head, last = gate[:-1], gate[-1]
+    if parts[: len(head)] != head:
+        return False
+    got = parts[len(head)]
+    return got == last or got == f"{last}.py"
+
+
+def applicable(module: Module, r: Rule, force_all: bool = False) -> bool:
+    if force_all or r.dirs is None:
+        return True
+    return any(gate_matches(module.parts, g) for g in r.dirs)
+
+
+def analyze_module(module: Module, force_all: bool = False) -> list[Finding]:
+    findings: list[Finding] = []
+    for r in RULES.values():
+        if applicable(module, r, force_all):
+            findings.extend(r.check(module))
+    return sorted(
+        (f for f in findings if not module.suppressed(f)),
+        key=lambda f: (f.line, f.rule),
+    )
+
+
+def analyze_source(
+    text: str, rel: str = "<memory>", force_all: bool = True
+) -> list[Finding]:
+    """Analyze one source string (the fixture-test entry point).
+    ``force_all`` bypasses dir gating so every rule sees the snippet."""
+    return analyze_module(Module(rel, text), force_all=force_all)
+
+
+# -- file collection + driver --
+
+
+def _is_python_script(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(21).startswith(b"#!/usr/bin/env python")
+    except OSError:
+        return False
+
+
+def iter_python_files(root: str, scan=DEFAULT_SCAN):
+    for entry in scan:
+        top = os.path.join(root, entry)
+        if os.path.isfile(top):
+            if top.endswith(".py") or _is_python_script(top):
+                yield top
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in SKIP_DIRS
+            )
+            for name in sorted(filenames):
+                path = os.path.join(dirpath, name)
+                if name.endswith(".py") or _is_python_script(path):
+                    yield path
+
+
+def analyze_paths(
+    paths, root: str, force_all: bool = False
+) -> tuple[list[Finding], int]:
+    """Analyze files; returns (findings, files_checked).  A file that
+    does not parse yields a ``parse-error`` finding (script/lint's
+    byte-compile gate normally catches this first)."""
+    findings: list[Finding] = []
+    checked = 0
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            findings.append(Finding(rel, 1, "parse-error", str(exc)))
+            continue
+        try:
+            module = Module(rel, text)
+        except SyntaxError as exc:
+            findings.append(
+                Finding(rel, exc.lineno or 1, "parse-error", str(exc.msg))
+            )
+            continue
+        except ValueError as exc:
+            # ast.parse raises bare ValueError for NUL bytes in source
+            findings.append(Finding(rel, 1, "parse-error", str(exc)))
+            continue
+        checked += 1
+        findings.extend(analyze_module(module, force_all=force_all))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule)), checked
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="script/analyze",
+        description=(
+            "AST-based static analysis: concurrency (lock discipline, "
+            "blocking calls, resource leaks), tracer purity, and the "
+            "AST-accurate house rules."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="Files/dirs to analyze (default: the product tree)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="Print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for r in RULES.values():
+            doc = " ".join((r.doc or "").split())
+            sys.stdout.write(f"{r.rule_id}: {doc}\n")
+        return 0
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if args.paths:
+        files = []
+        for p in args.paths:
+            if os.path.isdir(p):
+                files.extend(iter_python_files(os.path.dirname(p) or ".",
+                                               (os.path.basename(p),)))
+            else:
+                files.append(p)
+    else:
+        files = list(iter_python_files(root))
+    findings, checked = analyze_paths(files, root)
+    for f in findings:
+        sys.stdout.write(f.render() + "\n")
+    sys.stderr.write(
+        f"analyze: {checked} files, {len(RULES)} rules, "
+        f"{len(findings)} finding(s)\n"
+    )
+    return 1 if findings else 0

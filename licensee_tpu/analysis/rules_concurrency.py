@@ -1,0 +1,187 @@
+"""Concurrency rules: lock-discipline race detection and
+blocking-call-in-handler.
+
+**lock-discipline** — per class that owns a ``threading.Lock``/
+``RLock``/``Condition`` attribute AND hands work to a thread or
+executor: the guarded attribute set is inferred from writes inside
+``with self._lock:`` blocks (assignments, subscript stores, and
+in-place mutator calls like ``.append``), then every read or write of
+a guarded attribute OUTSIDE any lock block, in a method reachable from
+a thread entry (``threading.Thread(target=...)``, ``executor.submit``,
+``threading.Timer``), is a finding.  ``__init__`` is exempt — object
+construction happens-before any thread start.
+
+**blocking-call** — inside the router dispatch/handler call paths
+(the pre-flight gate for the ROADMAP's selectors/asyncio router core),
+calls that park the carrying thread are findings: ``time.sleep``,
+blocking socket verbs, file ``open``, ``subprocess`` waits, and the
+fleet's own ``oneshot`` probe round trip.  Entry points are the
+session/dispatch methods; reachability follows intra-module calls.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from licensee_tpu.analysis.core import rule
+from licensee_tpu.analysis.scopes import ImportTable, ModuleScopes
+
+# -- lock-discipline -----------------------------------------------------
+
+# attributes that are themselves synchronization objects (a secondary
+# mutex/condition assigned inside a locked section): reading them to
+# acquire them is not a data race.  Deliberately NARROW — an exemption
+# for e.g. "done"/"stop" would hide any guarded counter that happens
+# to carry those substrings, and Event attrs never enter the guarded
+# set anyway (.set() is not a tracked mutator)
+_SYNC_ATTR_HINTS = ("lock", "cond")
+
+
+def _scopes(module) -> ModuleScopes:
+    cached = getattr(module, "_mod_scopes", None)
+    if cached is None:
+        imports = ImportTable(module.tree)
+        cached = ModuleScopes(module.tree, imports)
+        module._mod_scopes = cached
+        module._imports = imports
+    return cached
+
+
+def _imports(module) -> ImportTable:
+    _scopes(module)
+    return module._imports
+
+
+@rule(
+    "lock-discipline",
+    doc=(
+        "An attribute written under `with self._lock:` is read or "
+        "written lock-free in thread-reachable code"
+    ),
+)
+def check_lock_discipline(module):
+    scopes = _scopes(module)
+    findings = []
+    for cls in scopes.classes:
+        if not cls.lock_attrs or not cls.guarded:
+            continue
+        reachable = scopes.thread_reachable(cls)
+        if not reachable:
+            continue
+        guarded = {
+            a
+            for a in cls.guarded
+            if a not in cls.lock_attrs
+            and not any(h in a.lower() for h in _SYNC_ATTR_HINTS)
+        }
+        seen: set[tuple[int, str]] = set()
+        for fname in reachable:
+            scope = cls.functions.get(fname)
+            if scope is None or fname == "__init__":
+                continue
+            for acc in scope.accesses:
+                if (
+                    acc.attr in guarded
+                    and acc.lock_depth == 0
+                    and (acc.line, acc.attr) not in seen
+                ):
+                    seen.add((acc.line, acc.attr))
+                    findings.append(
+                        module.finding(
+                            "lock-discipline",
+                            acc.line,
+                            f"{cls.name}.{fname} {acc.kind}s "
+                            f"'.{acc.attr}' without the lock, but it is "
+                            f"lock-guarded elsewhere (first guarded "
+                            f"write at line {cls.guarded[acc.attr]}) and "
+                            f"this method runs on a spawned thread",
+                        )
+                    )
+    return findings
+
+
+# -- blocking-call -------------------------------------------------------
+
+# entry points of the dispatch/handler paths (matched against method
+# and function names in the gated modules)
+HANDLER_ENTRY_NAMES = {
+    "dispatch", "handle", "handle_line", "run_session", "_drain",
+    "_race", "_attempt", "_emit",
+}
+
+# fully-qualified calls that block the carrying thread
+BLOCKING_QUALIFIED = {
+    "time.sleep": "sleeps on the handler path",
+    "subprocess.run": "waits on a subprocess",
+    "subprocess.call": "waits on a subprocess",
+    "subprocess.check_call": "waits on a subprocess",
+    "subprocess.check_output": "waits on a subprocess",
+    "os.system": "waits on a subprocess",
+    "socket.create_connection": "dials a socket synchronously",
+    "licensee_tpu.fleet.wire.oneshot": (
+        "performs a synchronous probe round trip"
+    ),
+    "open": "performs synchronous file I/O",
+    "io.open": "performs synchronous file I/O",
+}
+# blocking socket/process verbs called as methods on SOME object; the
+# receiver is untyped, so these only fire in the gated handler modules
+BLOCKING_METHODS = {
+    "recv": "blocks on a socket read",
+    "recv_into": "blocks on a socket read",
+    "sendall": "blocks on a socket write",
+    "accept": "blocks accepting a connection",
+    "makefile": "wraps a blocking socket stream",
+    "communicate": "waits on a subprocess",
+}
+# bare names that resolve to module functions known to block (the
+# wire-layer probe helpers imported into the gated modules)
+BLOCKING_IMPORT_TAILS = {"oneshot": "performs a synchronous probe round trip"}
+
+
+@rule(
+    "blocking-call",
+    dirs=("licensee_tpu/fleet/router", "licensee_tpu/serve/server"),
+    doc=(
+        "A dispatch/handler path calls a blocking primitive "
+        "(time.sleep, socket verbs, file I/O, subprocess waits)"
+    ),
+)
+def check_blocking_call(module):
+    scopes = _scopes(module)
+    imports = _imports(module)
+    reachable = scopes.module_reachable(HANDLER_ENTRY_NAMES)
+    findings = []
+    seen: set[int] = set()
+    for scope in reachable:
+        for node in ast.walk(scope.node):
+            if not isinstance(node, ast.Call):
+                continue
+            qn = imports.qualify(node.func)
+            why = None
+            what = qn
+            if qn is not None and qn in BLOCKING_QUALIFIED:
+                why = BLOCKING_QUALIFIED[qn]
+            elif qn is not None and qn.split(".")[-1] in BLOCKING_IMPORT_TAILS:
+                tail = qn.split(".")[-1]
+                if tail in scopes.module_functions or tail in imports.names:
+                    why = BLOCKING_IMPORT_TAILS[tail]
+                    what = tail
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in BLOCKING_METHODS
+            ):
+                why = BLOCKING_METHODS[node.func.attr]
+                what = f".{node.func.attr}"
+            if why is None or node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            findings.append(
+                module.finding(
+                    "blocking-call",
+                    node.lineno,
+                    f"handler path '{scope.name}' calls {what}() which "
+                    f"{why}; the async router core cannot carry this",
+                )
+            )
+    return findings

@@ -1,0 +1,254 @@
+"""Resource-leak rule: sockets, ``Popen`` handles, and file objects
+must be released on every path.
+
+A resource-creating call is clean when any of these hold:
+
+* it is the context expression of a ``with`` statement;
+* its result is assigned to a local that is closed inside a
+  ``finally`` block (``try: ... finally: x.close()``);
+* its result ESCAPES the creating function — returned, yielded, stored
+  on ``self``/an attribute/a container, or passed to another call —
+  ownership moved, the creator is not the leak site.
+
+Everything else is a finding: a bare ``open(p)`` expression, the
+``open(p).read()`` temporary (closed only when the GC gets around to
+it — on a week-long worker that is a descriptor leak), a local that is
+never closed, and a local closed only on the happy path (the
+stale-socket and SIGKILL-restart bugs of the fleet tier were exactly
+this class).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from licensee_tpu.analysis.core import rule
+from licensee_tpu.analysis.rules_concurrency import _imports
+
+RESOURCE_FACTORIES = {
+    "open": "file handle",
+    "io.open": "file handle",
+    "os.fdopen": "file handle",
+    "gzip.open": "file handle",
+    "bz2.open": "file handle",
+    "lzma.open": "file handle",
+    "tarfile.open": "archive handle",
+    "zipfile.ZipFile": "archive handle",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "subprocess.Popen": "child process handle",
+}
+
+CLOSE_METHODS = {
+    "close", "server_close", "terminate", "kill", "wait", "communicate",
+    "shutdown", "release", "unlink", "cleanup", "__exit__",
+}
+
+
+def _resource_calls(fn_node, imports):
+    """(call, kind) for resource factories lexically in this function,
+    excluding nested defs (they are visited as their own functions)."""
+    out = []
+
+    def visit(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            qn = imports.qualify(node.func)
+            if qn in RESOURCE_FACTORIES:
+                out.append((node, RESOURCE_FACTORIES[qn]))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn_node.body:
+        visit(stmt)
+    return out
+
+
+def _walk_body(fn_node):
+    """Every node under the function's statements — works for both real
+    FunctionDefs and the module-level pseudo-function."""
+    for stmt in getattr(fn_node, "body", []):
+        yield from ast.walk(stmt)
+
+
+def _finally_closes(fn_node, name: str) -> bool:
+    for node in _walk_body(fn_node):
+        if not isinstance(node, ast.Try):
+            continue
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in CLOSE_METHODS
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == name
+                ):
+                    return True
+    return False
+
+
+def _escapes(fn_node, name: str, creation: ast.Call) -> bool:
+    """Ownership leaves the function: returned/yielded, stored into an
+    attribute/subscript/container, re-aliased, or passed as a call
+    argument (the callee or the structure owns the close)."""
+    for node in _walk_body(fn_node):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            val = node.value
+            if val is not None and _bare_mentions(val, name):
+                return True
+        elif isinstance(node, ast.Assign):
+            if node.value is creation:
+                continue  # the tracked binding itself
+            if _bare_mentions(node.value, name):
+                return True  # aliased or stored into a structure
+        elif isinstance(node, ast.Call):
+            for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    # x.close()/x.read() is a method ON x, not a hand-off
+                    return True
+    return False
+
+
+def _bare_mentions(node, name: str) -> bool:
+    """``name`` used as a VALUE (returned, put in a tuple, aliased) —
+    not merely as the receiver of a method/attribute access: ``return
+    sock.recv(1)`` uses sock, ``return sock`` hands it off."""
+    receivers = {
+        id(n.value)
+        for n in ast.walk(node)
+        if isinstance(n, ast.Attribute)
+        and isinstance(n.value, ast.Name)
+        and n.value.id == name
+    }
+    return any(
+        isinstance(n, ast.Name) and n.id == name and id(n) not in receivers
+        for n in ast.walk(node)
+    )
+
+
+def _with_context_names(fn_node) -> set[str]:
+    names = set()
+    for node in _walk_body(fn_node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Name):
+                    names.add(ctx.id)
+    return names
+
+
+class _FakeModuleFn:
+    """Module-level statements analyzed as one pseudo-function."""
+
+    def __init__(self, tree):
+        self.body = [
+            n
+            for n in tree.body
+            if not isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+        ]
+
+
+def _iter_function_nodes(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+    yield _FakeModuleFn(tree)
+
+
+@rule(
+    "resource-leak",
+    doc=(
+        "A socket/Popen/file handle is created without `with` and "
+        "without a close guaranteed by `finally` (or an ownership "
+        "hand-off)"
+    ),
+)
+def check_resource_leak(module):
+    imports = _imports(module)
+    findings = []
+    for fn_node in _iter_function_nodes(module.tree):
+        with_items = set()
+        assigned_to: dict[int, str] = {}  # id(call) -> local name
+        consumed: set[int] = set()
+        # classify each resource call by its syntactic position
+        for stmt in getattr(fn_node, "body", []):
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        if isinstance(item.context_expr, ast.Call):
+                            with_items.add(id(item.context_expr))
+                elif isinstance(node, ast.Assign):
+                    if isinstance(node.value, ast.Call) and len(
+                        node.targets
+                    ) == 1:
+                        target = node.targets[0]
+                        if isinstance(target, ast.Name):
+                            assigned_to[id(node.value)] = target.id
+                        elif isinstance(
+                            target, (ast.Attribute, ast.Subscript)
+                        ):
+                            consumed.add(id(node.value))  # escapes
+                elif isinstance(node, ast.Call):
+                    for arg in [
+                        *node.args, *[kw.value for kw in node.keywords]
+                    ]:
+                        if isinstance(arg, ast.Call):
+                            consumed.add(id(arg))  # hand-off to callee
+                elif isinstance(node, (ast.Return, ast.Yield)):
+                    if isinstance(node.value, ast.Call):
+                        consumed.add(id(node.value))
+        ctx_names = _with_context_names(fn_node)
+        for call, kind in _resource_calls(fn_node, imports):
+            if id(call) in with_items or id(call) in consumed:
+                continue
+            name = assigned_to.get(id(call))
+            if name is None:
+                findings.append(
+                    module.finding(
+                        "resource-leak",
+                        call.lineno,
+                        f"{kind} created and never bound — it is closed "
+                        "only when the GC collects the temporary; use "
+                        "`with`",
+                    )
+                )
+                continue
+            if name in ctx_names:
+                continue  # opened here, entered via `with name` later
+            if _finally_closes(fn_node, name):
+                continue
+            if _escapes(fn_node, name, call):
+                continue
+            closes_somewhere = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in CLOSE_METHODS
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == name
+                for n in _walk_body(fn_node)
+            )
+            if closes_somewhere:
+                findings.append(
+                    module.finding(
+                        "resource-leak",
+                        call.lineno,
+                        f"{kind} '{name}' is closed only on the happy "
+                        "path — an exception between here and the close "
+                        "leaks it; use `with` or `try/finally`",
+                    )
+                )
+            else:
+                findings.append(
+                    module.finding(
+                        "resource-leak",
+                        call.lineno,
+                        f"{kind} '{name}' is never closed in this "
+                        "function and never handed off; use `with`",
+                    )
+                )
+    return findings

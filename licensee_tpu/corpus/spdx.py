@@ -91,7 +91,8 @@ class SpdxTemplate(NormalizedContent):
 
     def __init__(self, path: str):
         self.path = path
-        raw = open(path, encoding="utf-8").read()
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
         root = ET.fromstring(raw)
         lic = root.find(f"{_NS}license")
         if lic is None:

@@ -133,7 +133,8 @@ def license_hashes_json() -> str:
 
 # data-only fixture dirs (corpus inputs, not project trees) — excluded
 # from fixtures.yml and from tests/test_fixtures.py alike
-NON_PROJECT_FIXTURES = frozenset({"spdx-adversarial"})
+# ("analysis" is the static-analyzer rule corpus, tests/test_analysis.py)
+NON_PROJECT_FIXTURES = frozenset({"spdx-adversarial", "analysis"})
 
 
 def fixture_names() -> list[str]:

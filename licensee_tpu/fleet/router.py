@@ -262,6 +262,11 @@ class Router:
 
     def _probe(self, backend: Backend) -> None:
         try:
+            # the probe performs its blocking round trip BY DESIGN, on
+            # the dedicated prober thread — never on a session/dispatch
+            # thread; the handler-path walk reaches it only through
+            # coarse name-based call matching
+            # analysis: disable=blocking-call
             row = oneshot(
                 backend.socket_path, {"op": "stats"}, self.probe_timeout_s
             )
@@ -283,25 +288,25 @@ class Router:
 
     # -- dispatch --
 
-    def dispatchable(self, name: str) -> bool:
-        if self.supervisor is not None and not self.supervisor.dispatchable(
-            name
-        ):
-            return False
-        return self.backends[name].healthy
-
     def pick(self, exclude=frozenset()) -> str | None:
         """The least-loaded healthy, non-draining worker outside
-        ``exclude`` — the dispatch decision."""
+        ``exclude`` — the dispatch decision: the router's probed health
+        view (read under the lock) plus the supervisor's drain/stop
+        veto."""
         with self._lock:
             candidates = [
                 b
                 for name, b in self.backends.items()
                 if name not in exclude and b.healthy
             ]
-        candidates = [
-            b for b in candidates if self.dispatchable(b.name)
-        ]
+        # health was just read under the lock; only the supervisor's
+        # drain/stop veto remains (dispatchable() would re-take the
+        # lock per candidate to re-read the same flag)
+        supervisor = self.supervisor
+        if supervisor is not None:
+            candidates = [
+                b for b in candidates if supervisor.dispatchable(b.name)
+            ]
         if not candidates:
             return None
         return min(candidates, key=lambda b: (b.load(), b.name)).name
@@ -388,6 +393,10 @@ class Router:
                     # every current backend failed this request; a
                     # restart may bring one back before the deadline
                     tried = set()
+                # bounded 50 ms poll while the whole fleet is down —
+                # the asyncio router core replaces this parked thread
+                # with a timer wakeup (ROADMAP: async I/O core)
+                # analysis: disable=blocking-call
                 time.sleep(0.05)
                 continue
             if not first_round:
@@ -564,6 +573,11 @@ class Router:
         per_source = {"router": self.obs.prometheus()}
         for name, backend in self.backends.items():
             try:
+                # a fleet scrape IS a synchronous fan-out by contract:
+                # it runs on the stats verb's session writer thread and
+                # tolerates probe_timeout_s per worker; the async core
+                # will pipeline these round trips
+                # analysis: disable=blocking-call
                 row = oneshot(
                     backend.socket_path,
                     {"op": "stats", "format": "prometheus"},

@@ -253,48 +253,58 @@ def _drive_traffic(
     collect every response row."""
     kill_at = max(1, int(n_requests * kill_at_fraction))
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    sock.connect(front_path)
-    sock.settimeout(read_timeout)
-    f = sock.makefile("rwb")
+    f = None
+    try:
+        sock.connect(front_path)
+        sock.settimeout(read_timeout)
+        f = sock.makefile("rwb")
+        stream = f
 
-    def writer() -> None:
+        def writer() -> None:
+            try:
+                for i in range(n_requests):
+                    line = json.dumps({
+                        "id": i,
+                        "content": blobs[i % len(blobs)],
+                        "filename": "LICENSE",
+                    })
+                    stream.write(line.encode("utf-8") + b"\n")
+                    stream.flush()
+                    if i + 1 == kill_at:
+                        pid = supervisor.workers["w0"].pid
+                        if pid is None:
+                            problems.append("w0 had no pid at kill time")
+                        else:
+                            faults.kill(pid)
+                    time.sleep(0.005)
+            except OSError as exc:
+                problems.append(f"client writer failed: {exc}")
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        rows: list[dict] = []
         try:
-            for i in range(n_requests):
-                line = json.dumps({
-                    "id": i,
-                    "content": blobs[i % len(blobs)],
-                    "filename": "LICENSE",
-                })
-                f.write(line.encode("utf-8") + b"\n")
-                f.flush()
-                if i + 1 == kill_at:
-                    pid = supervisor.workers["w0"].pid
-                    if pid is None:
-                        problems.append("w0 had no pid at kill time")
-                    else:
-                        faults.kill(pid)
-                time.sleep(0.005)
-        except OSError as exc:
-            problems.append(f"client writer failed: {exc}")
-
-    wt = threading.Thread(target=writer, daemon=True)
-    wt.start()
-    rows: list[dict] = []
-    try:
-        for _ in range(n_requests):
-            raw = f.readline()
-            if not raw:
-                problems.append(
-                    f"front socket closed after {len(rows)} responses"
+            for _ in range(n_requests):
+                raw = f.readline()
+                if not raw:
+                    problems.append(
+                        f"front socket closed after {len(rows)} responses"
+                    )
+                    break
+                rows.append(
+                    json.loads(raw.decode("utf-8", errors="replace"))
                 )
-                break
-            rows.append(json.loads(raw.decode("utf-8", errors="replace")))
-    except (OSError, ValueError) as exc:
-        problems.append(f"client reader failed: {exc}")
-    wt.join(timeout=read_timeout)
-    try:
-        f.close()
-        sock.close()
-    except OSError:
-        pass
-    return rows
+        except (OSError, ValueError) as exc:
+            problems.append(f"client reader failed: {exc}")
+        wt.join(timeout=read_timeout)
+        return rows
+    finally:
+        # close on EVERY path (the static resource-leak rule's point):
+        # a reader failure must not leak the session socket into the
+        # next selftest stage
+        try:
+            if f is not None:
+                f.close()
+            sock.close()
+        except OSError:
+            pass

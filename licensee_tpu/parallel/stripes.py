@@ -871,7 +871,8 @@ def selftest(stream=None) -> int:
                     if problems:
                         say(f"FAIL: merged exposition: {problems[:3]}")
                         return 1
-            outputs[n] = open(out, "rb").read()
+            with open(out, "rb") as f:
+                outputs[n] = f.read()
         if outputs[1] != outputs[2]:
             say("FAIL: 2-stripe merged output != 1-stripe output")
             return 1

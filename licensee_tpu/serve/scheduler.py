@@ -591,6 +591,11 @@ class MicroBatcher:
         # longer (or no) deadline must not inherit its twin's expiry
         live: list[ServeRequest] = []
         for req in batch:
+            # ownership handoff, not a race: the scheduler thread
+            # popped req from the queue under the SAME lock submit()
+            # held when it wrote enqueued_at, and a dequeued request's
+            # fields belong to this thread alone until done.set()
+            # analysis: disable=lock-discipline
             enq = req.enqueued_at or req.created
             wait = t0 - enq
             self.stats_stages.record("queue_wait", wait)

@@ -35,6 +35,31 @@ def _force_cpu_jax():
 
 _force_cpu_jax()
 
+@pytest.fixture()
+def lock_order_sanitizer(monkeypatch):
+    """Wrap threading.Lock/RLock for the duration of one test and fail
+    it on any lock-order inversion observed across its threads (see
+    tests/lock_sanitizer.py).  Opt-in per module:
+
+        pytestmark = pytest.mark.usefixtures("lock_order_sanitizer")
+    """
+    import threading as _threading
+
+    from lock_sanitizer import LockOrderSanitizer
+
+    sanitizer = LockOrderSanitizer()
+    monkeypatch.setattr(_threading, "Lock", sanitizer.make_lock)
+    monkeypatch.setattr(_threading, "RLock", sanitizer.make_rlock)
+    yield sanitizer
+    inversions = sanitizer.check()
+    if inversions:
+        pytest.fail(
+            "lock-order sanitizer: "
+            + "\n---\n".join(inversions),
+            pytrace=False,
+        )
+
+
 FIXTURES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
 
 

@@ -1,0 +1,13 @@
+"""Clean: the dispatch path only computes and enqueues."""
+
+from collections import deque
+
+
+class Router:
+    def __init__(self):
+        self._pending = deque()
+
+    def dispatch(self, msg):
+        row = {"id": msg.get("id"), "ok": True}
+        self._pending.append(row)
+        return row

@@ -1,0 +1,8 @@
+"""Clean: a monitor loop may sleep — it is not a handler path."""
+
+import time
+
+
+def monitor_loop(stop):
+    while not stop.is_set():
+        time.sleep(1.0)

@@ -1,0 +1,15 @@
+"""TP: the dispatch path sleeps, directly and via a reachable
+helper."""
+
+import time
+
+
+class Router:
+    def dispatch(self, msg):
+        if not msg:
+            self._backoff()
+        time.sleep(0.05)  # BAD
+        return {"ok": True}
+
+    def _backoff(self):
+        time.sleep(0.5)  # BAD

@@ -1,0 +1,8 @@
+"""TP: the line handler blocks on a socket read and opens a file."""
+
+
+def handle_line(conn, line, path):
+    data = conn.recv(4096)  # BAD
+    with open(path, "ab") as f:  # BAD
+        f.write(data)
+    return data.decode("utf-8")

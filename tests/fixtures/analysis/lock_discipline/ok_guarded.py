@@ -1,0 +1,23 @@
+"""Clean: every touch of the guarded state happens under the lock."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        for _ in range(8):
+            with self._lock:
+                self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._count

@@ -1,0 +1,24 @@
+"""TP: a thread-reachable method reads a lock-guarded counter
+lock-free."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            current = self._count  # BAD
+            self.bump(current)
+
+    def bump(self, current):
+        with self._lock:
+            self._count = current + 1

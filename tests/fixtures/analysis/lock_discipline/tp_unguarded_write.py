@@ -1,0 +1,27 @@
+"""TP: an executor-submitted method writes a guarded counter without
+the lock."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.errors = 0
+        self._pool = ThreadPoolExecutor(max_workers=2)
+
+    def submit_work(self, n):
+        self._pool.submit(self._work, n)
+
+    def _work(self, n):
+        for _ in range(n):
+            self.total += 1  # BAD
+        with self._lock:
+            self.errors += 1
+
+    def reset(self):
+        with self._lock:
+            self.total = 0
+            self.errors = 0

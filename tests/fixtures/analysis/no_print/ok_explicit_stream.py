@@ -1,0 +1,8 @@
+"""Clean: explicit streams and callbacks only."""
+
+import sys
+
+
+def report(stats, stream=None):
+    stream = stream if stream is not None else sys.stderr
+    stream.write(f"stats: {stats}\n")

@@ -1,0 +1,12 @@
+"""Clean: a .print() METHOD and a string mention — both tripped the
+regex, neither is builtins.print."""
+
+NOTE = "print() is banned here"
+
+
+class Reporter:
+    def __init__(self, printer):
+        self._printer = printer
+
+    def emit(self, row):
+        self._printer.print(row)

@@ -1,0 +1,5 @@
+"""TP: print() on a layer that shares stdout with a transport."""
+
+
+def report(stats):
+    print("stats:", stats)  # BAD

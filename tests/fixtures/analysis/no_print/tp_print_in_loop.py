@@ -1,0 +1,9 @@
+"""TP: print() buried in a loop next to a legitimate stream write."""
+
+import sys
+
+
+def run(events):
+    for event in events:
+        print(event)  # BAD
+    sys.stderr.write("done\n")

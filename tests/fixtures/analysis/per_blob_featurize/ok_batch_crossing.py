@@ -1,0 +1,6 @@
+"""Clean: one batch crossing covers the whole chunk."""
+
+
+def produce(classifier, blobs):
+    prepared = classifier.prepare_batch(blobs)
+    return classifier.featurize_batch(prepared)

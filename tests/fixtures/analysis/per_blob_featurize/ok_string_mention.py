@@ -1,0 +1,8 @@
+"""Clean: '.featurize(' in prose — the regex lint flagged exactly
+this."""
+
+RULE = "never call .featurize( per blob on the hot path"
+
+
+def describe():
+    return RULE

@@ -1,0 +1,8 @@
+"""TP: per-blob native crossing in a loop."""
+
+
+def produce(classifier, blobs):
+    rows = []
+    for blob in blobs:
+        rows.append(classifier.featurize(blob))  # BAD
+    return rows

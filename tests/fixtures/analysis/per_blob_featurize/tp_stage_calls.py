@@ -1,0 +1,6 @@
+"""TP: the staged per-blob entry points."""
+
+
+def warm(pipeline, blob):
+    pipeline.stage1(blob)  # BAD
+    return pipeline.stage2(blob)  # BAD

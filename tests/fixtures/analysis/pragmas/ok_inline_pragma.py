@@ -1,0 +1,10 @@
+"""Clean: an inline pragma (with justification prose) suppresses the
+finding on its own line."""
+
+import time
+
+
+def epoch_stamp():
+    # this fixture documents pragma suppression; the row label is a
+    # REAL wall-clock timestamp by contract
+    return time.time()  # analysis: disable=wallclock-time

@@ -1,0 +1,19 @@
+"""Clean: try/finally guarantees the close; hand-offs move
+ownership."""
+
+import socket
+import subprocess
+
+
+def oneshot(path, payload):
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        sock.connect(path)
+        sock.sendall(payload)
+        return sock.recv(4096)
+    finally:
+        sock.close()
+
+
+def spawn(handle, argv):
+    handle.proc = subprocess.Popen(argv)  # stored: the handle owns it

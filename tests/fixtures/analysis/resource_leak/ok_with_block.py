@@ -1,0 +1,14 @@
+"""Clean: `with` owns the close on every path."""
+
+import socket
+
+
+def read_config(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def probe(path):
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+        sock.connect(path)
+        return sock.recv(1)

@@ -1,0 +1,8 @@
+"""TP: file handles opened as temporaries — closed only when the GC
+runs, a descriptor leak on a long-lived worker."""
+
+
+def read_config(path):
+    text = open(path, encoding="utf-8").read()  # BAD
+    lines = open(path, encoding="utf-8").readlines()  # BAD
+    return text, lines

@@ -1,0 +1,10 @@
+"""TP: a socket that is never closed and never handed off."""
+
+import socket
+
+
+def probe(path):
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)  # BAD
+    sock.connect(path)
+    sock.sendall(b"ping\n")
+    return sock.recv(1)

@@ -1,0 +1,18 @@
+"""Clean: pure jnp math, vmapped helper included."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def dice_scores(overlap, totals):
+    num = 2 * overlap
+    den = totals + 1
+    return num / den
+
+
+def _row_norm(row):
+    return row / (jnp.sum(row) + 1e-9)
+
+
+normalize = jax.vmap(_row_norm)

@@ -1,0 +1,14 @@
+"""TP: host-side effects inside a jitted function run once at trace
+time and silently vanish from the compiled kernel."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def stamped_scores(x):
+    t0 = time.time()  # BAD
+    print("tracing", t0)  # BAD
+    return jnp.sum(x) + t0

@@ -1,0 +1,13 @@
+"""TP: branching on a traced value (directly or through a tainted
+local)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x, limit):
+    scaled = x * 2.0
+    if scaled.sum() > limit:  # BAD
+        return jnp.zeros_like(x)
+    return scaled

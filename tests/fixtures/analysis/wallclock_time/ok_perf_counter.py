@@ -1,0 +1,8 @@
+"""Clean: monotonic clock."""
+
+import time
+
+
+def latency_probe():
+    t0 = time.perf_counter()
+    return time.perf_counter() - t0

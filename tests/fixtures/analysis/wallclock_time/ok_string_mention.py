@@ -1,0 +1,9 @@
+"""Clean: time.time() appears only in prose — the regex lint flagged
+this; the AST rule must not."""
+
+BANNER = "never call time.time() in serving code"
+
+
+def describe():
+    # a comment mentioning time.time() is also fine
+    return BANNER

@@ -1,0 +1,8 @@
+"""TP: the aliased form the regex lint could never see."""
+
+from time import time as wallclock
+
+
+def span():
+    start = wallclock()  # BAD
+    return wallclock() - start  # BAD

@@ -1,0 +1,8 @@
+"""TP: wall-clock latency math."""
+
+import time
+
+
+def latency_probe():
+    t0 = time.time()  # BAD
+    return time.time() - t0  # BAD

@@ -1,0 +1,199 @@
+"""Test-only lock-order sanitizer: wrap ``threading.Lock``/``RLock``
+so every acquisition records the per-thread held-lock stack, and a
+lock-order INVERSION (thread 1 takes A then B while thread 2 ever took
+B then A) fails the test with both acquisition stacks.
+
+This is the dynamic companion to the static ``lock-discipline`` rule
+(licensee_tpu/analysis): the analyzer proves guarded attributes stay
+guarded; this sanitizer proves the locks themselves are acquired in a
+consistent global order, which is the deadlock-freedom argument for
+the fleet/stripe supervision paths.
+
+Only ``threading.Lock``/``RLock`` CREATED while the fixture is active
+are tracked — library locks that predate the test keep their raw
+types.  The wrappers implement enough of the lock protocol for
+``threading.Condition`` (both the ``Condition(Lock())`` and
+``Condition(RLock())`` forms) and ``queue.Queue`` to run unmodified.
+"""
+
+from __future__ import annotations
+
+import _thread
+import threading
+import traceback
+
+
+def _site(depth: int = 3) -> str:
+    stack = traceback.extract_stack()
+    for frame in reversed(stack[:-depth]):
+        if "lock_sanitizer" not in frame.filename:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _stack_snippet(limit: int = 6) -> str:
+    frames = [
+        f
+        for f in traceback.extract_stack()
+        if "lock_sanitizer" not in f.filename
+    ]
+    return "".join(traceback.format_list(frames[-limit:]))
+
+
+class LockOrderSanitizer:
+    """Factory + edge registry.  ``make_lock``/``make_rlock`` stand in
+    for ``threading.Lock``/``RLock``; ``inversions`` accumulates every
+    (edge, reversed-edge) pair observed with their stacks."""
+
+    def __init__(self):
+        # raw primitives on purpose: the registry must not recurse
+        # through its own wrappers
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        # (id_a, id_b) -> (site_a, site_b, stack_snippet)
+        self.edges: dict[tuple[int, int], tuple[str, str, str]] = {}
+        self.inversions: list[str] = []
+
+    # -- factory entry points (patched over threading.Lock/RLock) --
+
+    def make_lock(self):
+        return _TrackedLock(self, _thread.allocate_lock(), _site())
+
+    def make_rlock(self):
+        return _TrackedRLock(self, threading._RLock(), _site())
+
+    # -- bookkeeping --
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def on_acquire(self, lock) -> None:
+        held = self._held()
+        with self._mu:
+            for prior in held:
+                if prior is lock:
+                    continue
+                edge = (id(prior), id(lock))
+                if edge in self.edges:
+                    continue  # known edge: skip the stack extraction
+                rev = (id(lock), id(prior))
+                if rev in self.edges:
+                    a_site, b_site, rev_stack = self.edges[rev]
+                    self.inversions.append(
+                        "lock-order inversion:\n"
+                        f"  this thread acquired {prior.site} THEN "
+                        f"{lock.site} at:\n{_stack_snippet()}"
+                        f"  but another acquisition took {b_site} THEN "
+                        f"{a_site} at:\n{rev_stack}"
+                    )
+                self.edges[edge] = (
+                    prior.site, lock.site, _stack_snippet()
+                )
+        held.append(lock)
+
+    def on_release(self, lock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+
+    def check(self) -> list[str]:
+        with self._mu:
+            return list(self.inversions)
+
+
+class _TrackedLock:
+    """``threading.Lock`` stand-in recording acquisition order."""
+
+    def __init__(self, registry, inner, site):
+        self._registry = registry
+        self._inner = inner
+        self.site = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._registry.on_acquire(self)
+        return ok
+
+    def release(self):
+        self._registry.on_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<TrackedLock {self.site}>"
+
+
+class _TrackedRLock:
+    """``threading.RLock`` stand-in.  Only the OUTERMOST acquire/release
+    of a recursion counts for ordering; the ``_release_save`` trio keeps
+    ``threading.Condition(RLock())`` working through wait()."""
+
+    def __init__(self, registry, inner, site):
+        self._registry = registry
+        self._inner = inner
+        self.site = site
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            depth = self._depth() + 1
+            self._tls.depth = depth
+            if depth == 1:
+                self._registry.on_acquire(self)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        depth = self._depth() - 1
+        self._tls.depth = depth
+        if depth == 0:
+            self._registry.on_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    # Condition protocol (threading.Condition duck-types these)
+    def _release_save(self):
+        # carry the WRAPPER depth through the opaque state so a
+        # recursive holder (depth > 1) restores tracking exactly;
+        # Condition passes the state back verbatim
+        state = self._inner._release_save()
+        depth = self._depth()
+        self._tls.depth = 0
+        self._registry.on_release(self)
+        return (depth, state)
+
+    def _acquire_restore(self, state):
+        depth, inner_state = state
+        self._inner._acquire_restore(inner_state)
+        self._tls.depth = depth
+        self._registry.on_acquire(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def __repr__(self):
+        return f"<TrackedRLock {self.site}>"

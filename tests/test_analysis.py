@@ -1,0 +1,338 @@
+"""The AST analyzer (licensee_tpu/analysis/ + script/analyze).
+
+Three layers of coverage:
+
+* **fixture corpus** — tests/fixtures/analysis/<rule>/ holds >=2
+  seeded true-positive (``tp_*.py``) and >=2 clean (``ok_*.py``)
+  snippets per rule.  Offending lines carry a ``# BAD`` marker; a TP
+  file's findings for its rule must hit EXACTLY the marked lines, and
+  an OK file must produce none — both directions of each rule are
+  pinned, not just "it fires".
+* **engine semantics** — pragma suppression (inline, above-line, and
+  def-scope), path-component dir gating (the ``stripes_util.py``
+  prefix bug), and aliased-import resolution.
+* **the repo gate** — the real product tree analyzes clean, exactly
+  what ``script/analyze`` asserts in script/cibuild (the analyzer's
+  own package is part of that tree: the self-check).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from licensee_tpu.analysis import (
+    RULES,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+)
+from licensee_tpu.analysis.core import gate_matches
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "analysis"
+)
+
+# fixture directory -> rule id ("pragmas" exercises the engine, not a
+# single rule)
+DIR_TO_RULE = {
+    "lock_discipline": "lock-discipline",
+    "blocking_call": "blocking-call",
+    "resource_leak": "resource-leak",
+    "tracer_purity": "tracer-purity",
+    "wallclock_time": "wallclock-time",
+    "no_print": "no-print",
+    "per_blob_featurize": "per-blob-featurize",
+}
+
+
+def _fixture_files():
+    cases = []
+    for dirname, rule_id in sorted(DIR_TO_RULE.items()):
+        dirpath = os.path.join(CORPUS, dirname)
+        for name in sorted(os.listdir(dirpath)):
+            if name.endswith(".py"):
+                cases.append(
+                    (rule_id, os.path.join(dirpath, name), name)
+                )
+    return cases
+
+
+def _marked_lines(text: str) -> set[int]:
+    return {
+        i
+        for i, line in enumerate(text.splitlines(), 1)
+        if line.rstrip().endswith("# BAD")
+    }
+
+
+@pytest.mark.parametrize(
+    "rule_id,path,name",
+    [
+        pytest.param(r, p, n, id=f"{r}/{n}")
+        for r, p, n in _fixture_files()
+    ],
+)
+def test_fixture_corpus(rule_id, path, name):
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    findings = analyze_source(text, rel=name, force_all=True)
+    hit_lines = {f.line for f in findings if f.rule == rule_id}
+    if name.startswith("tp_"):
+        marked = _marked_lines(text)
+        assert marked, f"{name}: a TP fixture must mark its lines # BAD"
+        assert hit_lines == marked, (
+            f"{name}: {rule_id} flagged lines {sorted(hit_lines)}, "
+            f"fixture marks {sorted(marked)}; findings: "
+            f"{[f.render() for f in findings]}"
+        )
+    else:
+        assert not hit_lines, (
+            f"{name}: clean fixture tripped {rule_id}: "
+            f"{[f.render() for f in findings if f.rule == rule_id]}"
+        )
+
+
+def test_every_rule_has_tp_and_ok_fixtures():
+    """>=2 seeded true-positive and >=2 clean snippets per rule."""
+    for dirname in DIR_TO_RULE:
+        names = os.listdir(os.path.join(CORPUS, dirname))
+        tps = [n for n in names if n.startswith("tp_")]
+        oks = [n for n in names if n.startswith("ok_")]
+        assert len(tps) >= 2, f"{dirname}: wants >=2 tp_ fixtures"
+        assert len(oks) >= 2, f"{dirname}: wants >=2 ok_ fixtures"
+
+
+def test_rule_registry_complete():
+    assert set(DIR_TO_RULE.values()) <= set(RULES), (
+        "fixture corpus names a rule the registry does not define"
+    )
+
+
+# -- pragmas ------------------------------------------------------------
+
+
+def test_pragma_fixtures_are_clean():
+    dirpath = os.path.join(CORPUS, "pragmas")
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(dirpath, name), encoding="utf-8") as f:
+            findings = analyze_source(f.read(), rel=name)
+        assert findings == [], (
+            f"{name}: pragma failed to suppress: "
+            f"{[f.render() for f in findings]}"
+        )
+
+
+def test_pragma_requires_matching_rule_id():
+    src = (
+        "import time\n"
+        "\n"
+        "\n"
+        "def probe():\n"
+        "    return time.time()  # analysis: disable=no-print\n"
+    )
+    findings = analyze_source(src)
+    assert [f.rule for f in findings] == ["wallclock-time"], (
+        "a pragma for a DIFFERENT rule must not suppress this one"
+    )
+
+
+def test_pragma_above_decorated_def_covers_body():
+    """'directly above a def' must keep working when a decorator sits
+    between the pragma and the def line."""
+    src = (
+        "import time\n"
+        "\n"
+        "import jax\n"
+        "\n"
+        "\n"
+        "# trace-time stamp on purpose (fixture)\n"
+        "# analysis: disable=tracer-purity\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + time.time()\n"
+    )
+    findings = analyze_source(src)
+    assert not any(f.rule == "tracer-purity" for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+def test_guarded_attr_named_done_is_not_exempt():
+    """Sync-hint exemptions must stay narrow: a guarded counter that
+    happens to be called 'done' is still a race when read lock-free."""
+    src = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.done = 0\n"
+        "\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "\n"
+        "    def _loop(self):\n"
+        "        while self.done < 10:\n"
+        "            self.bump()\n"
+        "\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.done += 1\n"
+    )
+    findings = analyze_source(src)
+    assert any(f.rule == "lock-discipline" for f in findings)
+
+
+def test_tracer_taint_through_nested_assignment():
+    """Taint must propagate in source order: a tracer-derived binding
+    inside an earlier block taints a later same-level branch."""
+    src = (
+        "import jax\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x.ndim:\n"      # line 6: shielded static read — clean
+        "        y = x\n"       # line 7: taints y, nested one block deep
+        "    while y:\n"        # line 8: MUST flag (y is tracer-derived)
+        "        y = y - 1\n"
+        "    return y\n"
+    )
+    findings = analyze_source(src)
+    branch_lines = {
+        f.line
+        for f in findings
+        if f.rule == "tracer-purity" and "branches" in f.message
+    }
+    assert branch_lines == {8}, [f.render() for f in findings]
+
+
+def test_nul_byte_file_reports_parse_error(tmp_path):
+    """ast.parse raises a bare ValueError on NUL bytes; the driver must
+    report a parse-error finding, never crash."""
+    bad = tmp_path / "nul.py"
+    bad.write_bytes(b"x = 1\x00\n")
+    findings, checked = analyze_paths([str(bad)], str(tmp_path))
+    assert checked == 0
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_pragma_in_string_is_inert():
+    src = (
+        "import time\n"
+        "\n"
+        'NOTE = "# analysis: disable=wallclock-time"\n'
+        "\n"
+        "\n"
+        "def probe():\n"
+        "    return time.time()\n"
+    )
+    findings = analyze_source(src)
+    assert [f.rule for f in findings] == ["wallclock-time"], (
+        "a pragma inside a string literal must not suppress anything"
+    )
+
+
+# -- dir gating ---------------------------------------------------------
+
+
+def test_gate_matches_on_components_not_prefixes():
+    gate = ("licensee_tpu", "parallel", "stripes")
+    # the module file and a submodule of a future package both match
+    assert gate_matches(("licensee_tpu", "parallel", "stripes.py"), gate)
+    assert gate_matches(
+        ("licensee_tpu", "parallel", "stripes", "runner.py"), gate
+    )
+    # the string-prefix sibling must NOT match (the script/lint bug)
+    assert not gate_matches(
+        ("licensee_tpu", "parallel", "stripes_util.py"), gate
+    )
+    assert not gate_matches(("licensee_tpu", "parallel"), gate)
+
+
+def test_house_rules_gated_to_their_dirs():
+    src = "import time\n\n\ndef probe():\n    return time.time()\n"
+    # ungated path: rule does not apply without force_all
+    from licensee_tpu.analysis.core import Module, analyze_module
+
+    outside = analyze_module(
+        Module("licensee_tpu/corpus/license.py", src), force_all=False
+    )
+    assert not any(f.rule == "wallclock-time" for f in outside)
+    inside = analyze_module(
+        Module("licensee_tpu/serve/clock_util.py", src), force_all=False
+    )
+    assert [f.rule for f in inside] == ["wallclock-time"]
+
+
+# -- the repo gate ------------------------------------------------------
+
+
+def test_product_tree_is_clean():
+    """The zero-findings assertion over the real licensee_tpu/ tree —
+    every violation the rules surfaced was fixed or pragma'd with a
+    justification in this PR; regressions fail here before cibuild."""
+    findings, checked = analyze_paths(
+        iter_python_files(REPO_ROOT), REPO_ROOT
+    )
+    assert checked > 50, "the scan should cover the product tree"
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_script_analyze_cli():
+    """script/analyze exits 0 on the clean tree and prints the rule
+    catalog with --list-rules."""
+    script = os.path.join(REPO_ROOT, "script", "analyze")
+    run = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        cwd=REPO_ROOT,
+    )
+    assert run.returncode == 0, run.stdout + run.stderr
+    listing = subprocess.run(
+        [sys.executable, script, "--list-rules"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert listing.returncode == 0
+    for rule_id in DIR_TO_RULE.values():
+        assert rule_id in listing.stdout
+
+
+def test_script_analyze_flags_a_violation(tmp_path):
+    """The CLI path end to end: an explicit file with a violation
+    exits 1 and prints file:line: rule-id."""
+    bad = tmp_path / "bad_clock.py"
+    bad.write_text(
+        "import time\n\n\ndef probe():\n    return time.time()\n",
+        encoding="utf-8",
+    )
+    script = os.path.join(REPO_ROOT, "script", "analyze")
+    gated = subprocess.run(
+        [sys.executable, script, str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    # dir gating holds through the CLI: the wallclock rule is scoped to
+    # serve/obs/fleet/stripes, and a tmp-path file is outside them
+    assert gated.returncode == 0, gated.stdout + gated.stderr
+    # a file outside the gated dirs still runs the ungated rules, so
+    # use one of those for the violation exit-code check
+    leak = tmp_path / "bad_leak.py"
+    leak.write_text(
+        "def read(path):\n"
+        "    text = open(path).read()\n"
+        "    return text\n",
+        encoding="utf-8",
+    )
+    run = subprocess.run(
+        [sys.executable, script, str(leak)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert run.returncode == 1, run.stdout + run.stderr
+    assert "resource-leak" in run.stdout

@@ -26,6 +26,12 @@ from licensee_tpu.fleet.router import FrontServer, Router
 from licensee_tpu.fleet.supervisor import Supervisor, worker_env
 from licensee_tpu.fleet.wire import WireError, oneshot
 
+# every test in this module runs under the lock-order sanitizer
+# (tests/lock_sanitizer.py): router/supervisor/session locks must keep
+# a consistent global acquisition order or the test fails with both
+# stacks
+pytestmark = pytest.mark.usefixtures("lock_order_sanitizer")
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STUB_ENV = {**os.environ, "PYTHONPATH": REPO_ROOT}
 

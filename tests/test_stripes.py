@@ -31,6 +31,12 @@ from licensee_tpu.parallel.stripes import (
     stripe_argv,
 )
 
+# every test in this module runs under the lock-order sanitizer
+# (tests/lock_sanitizer.py): the runner's supervision loop shares the
+# BackoffPolicy/terminate machinery with the fleet supervisor, and any
+# lock its callbacks take must keep a consistent global order
+pytestmark = pytest.mark.usefixtures("lock_order_sanitizer")
+
 # ---------------------------------------------------------------------------
 # the stub stripe worker: same rank math, same shard naming, same
 # resume-point semantics as a real batch-detect child — plus scripted
