@@ -1057,6 +1057,92 @@ def bench_serve_path(n_requests: int = 2048) -> dict:
     }
 
 
+def bench_reload(settle_s: float = 0.4) -> dict:
+    """Corpus hot-swap under live traffic (serve/scheduler.py
+    ``reload_corpus``): price the blue/green swap — build+validate+swap
+    latency for a corpus artifact, how many requests were in flight at
+    swap time, how many arrived during it — and gate ``dropped == 0``:
+    the swap must cost the client NOTHING (no errors, no lost rows,
+    every verdict attributed to exactly one corpus fingerprint)."""
+    import os
+    import tempfile
+    import threading
+
+    from licensee_tpu.corpus.artifact import write_artifact
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.corpus.spdx import spdx_corpus
+    from licensee_tpu.serve.scheduler import MicroBatcher
+
+    body = re.sub(
+        r"\[(\w+)\]", "example", License.find("mit").content or ""
+    )
+    tmpdir = tempfile.mkdtemp(prefix="bench_reload_")
+    artifact = os.path.join(tmpdir, "spdx.corpus.npz")
+    write_artifact(artifact, spdx_corpus(None), source="spdx")
+    stop = threading.Event()
+    reqs: list = []
+    admit_errors: list = []
+    with MicroBatcher(
+        max_batch=64,
+        max_delay_ms=2.0,
+        buckets=(64,),
+        queue_depth=1 << 16,
+        cache_entries=1 << 16,
+        corpus_source="vendored",
+    ) as batcher:
+        fp_old = batcher.corpus_fingerprint
+        batcher.classify(f"{body}\nwarmup\n", "LICENSE")
+
+        def traffic() -> None:
+            i = 0
+            while not stop.is_set():
+                try:
+                    reqs.append(
+                        batcher.submit(
+                            f"{body}\nzqrel{i} zqsw{i}\n", "LICENSE"
+                        )
+                    )
+                except Exception as exc:  # noqa: BLE001 — the dropped gate counts these
+                    admit_errors.append(str(exc))
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(settle_s)  # a real standing load before the swap
+        snap = batcher.stats()["scheduler"]
+        in_flight_at_swap = snap["queue_depth"] + snap["in_flight"]
+        sent_before = len(reqs)
+        t0 = time.perf_counter()
+        out = batcher.reload_corpus(artifact)
+        swap_s = time.perf_counter() - t0
+        during = len(reqs) - sent_before
+        time.sleep(settle_s)  # post-swap traffic on the new corpus
+        stop.set()
+        t.join(timeout=30.0)
+        dropped = len(admit_errors)
+        fps_seen = set()
+        for req in reqs:
+            if not req.done.wait(120.0):
+                dropped += 1
+                continue
+            if req.result is None or req.result.error:
+                dropped += 1
+                continue
+            fps_seen.add(req.corpus_fp)
+    return {
+        "requests": len(reqs),
+        "swap_s": round(swap_s, 3),
+        "in_flight_at_swap": in_flight_at_swap,
+        "requests_during_swap": during,
+        "dropped": dropped,  # the gate: must be 0
+        "fingerprint_flipped": bool(
+            out.get("ok") and out["fingerprint"] != fp_old
+        ),
+        "fingerprints_seen": len(fps_seen),  # old + new, never more
+    }
+
+
 def bench_fleet(n_requests: int = 1500) -> dict:
     """The fleet tier's own cost and resilience, measured over STUB
     workers (fleet/faults.py): requests/sec through the router with 1
@@ -1192,6 +1278,7 @@ def make_headline(
     at_scale = details.get("end_to_end_1m") or {}
     at_auto = details.get("end_to_end_1m_auto") or {}
     serve = details.get("serve_path") or {}
+    reload_d = details.get("reload") or {}
     fleet = details.get("fleet") or {}
     hm = details.get("host_model") or {}
     stripes = details.get("stripes") or {}
@@ -1236,6 +1323,13 @@ def make_headline(
                 "uncached_rps": serve.get("uncached_rps"),
                 "cached_rps": serve.get("cached_rps"),
                 "p99_ms": serve.get("p99_ms"),
+            },
+            # the corpus hot-swap priced under live traffic: swap
+            # latency and the dropped=0 gate (full row: details.reload)
+            "reload": {
+                "swap_s": reload_d.get("swap_s"),
+                "in_flight": reload_d.get("in_flight_at_swap"),
+                "dropped": reload_d.get("dropped"),
             },
             # the fleet tier over stub workers: router overhead/scaling
             # and the SIGKILL failover story (full row: details.fleet)
@@ -1396,6 +1490,7 @@ def main() -> None:
         "end_to_end_auto", bench_end_to_end, n_files=32768, mode="auto"
     )
     serve_path = run_safe("serve_path", bench_serve_path)
+    reload_row = run_safe("reload", bench_reload)
     fleet = run_safe("fleet", bench_fleet)
     host_model = run_safe("host_model", bench_host_model, e2e=end_to_end)
     stripes = run_safe(
@@ -1439,6 +1534,7 @@ def main() -> None:
         "end_to_end_package": end_to_end_package,
         "end_to_end_auto": end_to_end_auto,
         "serve_path": serve_path,
+        "reload": reload_row,
         "fleet": fleet,
         "host_model": host_model,
         "stripes": stripes,

@@ -1,5 +1,5 @@
 """Command-line interface: detect / diff / license-path / version /
-batch-detect / serve / stats / fleet.
+batch-detect / serve / stats / fleet / corpus-build.
 
 Parity target: `bin/licensee` + `lib/licensee/commands/*.rb` (Thor CLI).
 `batch-detect` is new: the TPU batch path over a manifest of files.
@@ -9,6 +9,9 @@ stdio or a Unix socket, serve/).
 (merged table with --watch, merged exposition).
 `fleet` supervises N serve workers behind one health-checked, load-
 balanced, hedging front socket (fleet/).
+`corpus-build` compiles any corpus source into a versioned, content-
+fingerprinted artifact (corpus/artifact.py) that serve workers load
+without recompiling and hot-swap via the `{"op": "reload"}` verb.
 """
 
 from __future__ import annotations
@@ -329,9 +332,10 @@ def _run_striped(args) -> int:
         return 1
     if args.corpus not in ("vendored", "spdx") and not os.path.isdir(
         args.corpus
-    ):
+    ) and not os.path.isfile(args.corpus):
         print(
-            f"error: cannot load corpus {args.corpus!r}: not a directory",
+            f"error: cannot load corpus {args.corpus!r}: not a "
+            "directory or artifact file",
             file=sys.stderr,
         )
         return 1
@@ -380,6 +384,7 @@ def _run_striped(args) -> int:
                 process_index=0,
                 process_count=1,
                 tracer=False,
+                corpus_source=args.corpus,
             )
             probe._check_resume_config(args.output, resume=True)
         except ResumeConfigError as exc:
@@ -656,6 +661,7 @@ def cmd_batch_detect(args) -> int:
             featurize_procs=args.featurize_procs,
             progress_every=args.progress,
             coalesce_batches=args.coalesce_batches,
+            corpus_source=args.corpus,
             **kwargs,
         )
     except OSError as exc:
@@ -732,19 +738,63 @@ def cmd_batch_detect(args) -> int:
 
 def _load_corpus(corpus_arg: str):
     """Resolve a --corpus value to (kwargs-with-corpus | error message).
-    Shared by batch-detect and serve."""
+    Shared by batch-detect and serve.  Sources: 'vendored', 'spdx', an
+    SPDX license-list-XML src/ directory, or a corpus ARTIFACT file
+    built by `licensee-tpu corpus-build` (loads without recompiling,
+    integrity-checked against its fingerprint manifest)."""
     kwargs = {}
     if corpus_arg and corpus_arg != "vendored":
-        from licensee_tpu.corpus.spdx import spdx_corpus
+        from licensee_tpu.corpus.artifact import ArtifactError, resolve_corpus
 
         try:
-            corpus = spdx_corpus(None if corpus_arg == "spdx" else corpus_arg)
-        except OSError as exc:
+            corpus, _fp, _manifest = resolve_corpus(corpus_arg)
+        except (ArtifactError, OSError) as exc:
             return None, f"cannot load corpus {corpus_arg!r}: {exc}"
-        if not corpus.n_templates:
-            return None, f"no license templates found in {corpus_arg!r}"
         kwargs["corpus"] = corpus
     return kwargs, None
+
+
+def cmd_corpus_build(args) -> int:
+    """Compile a corpus source into a versioned, content-fingerprinted
+    artifact bundle (corpus/artifact.py) — the unit of corpus rollout:
+    build once, ship the file, `serve --corpus art.npz` / the
+    `{"op": "reload"}` verb / `fleet reload` all load it without
+    recompiling, and its fingerprint names the corpus everywhere
+    (response rows, caches, resume sidecars, Prometheus)."""
+    from licensee_tpu.corpus.artifact import (
+        ArtifactError,
+        load_artifact,
+        resolve_corpus,
+        write_artifact,
+    )
+
+    if args.inspect:
+        try:
+            _corpus, manifest = load_artifact(args.inspect)
+        except ArtifactError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        print(json.dumps(manifest))
+        return 0
+    if not args.output:
+        print(
+            "error: need --output PATH (or --inspect ARTIFACT)",
+            file=sys.stderr,
+        )
+        return 1
+    dir_err = _check_output_dir(args.output)
+    if dir_err:
+        print(f"error: {dir_err}", file=sys.stderr)
+        return 1
+    try:
+        corpus, _fp, _manifest = resolve_corpus(args.corpus)
+    except (ArtifactError, OSError) as exc:
+        print(f"error: cannot load corpus {args.corpus!r}: {exc}",
+              file=sys.stderr)
+        return 1
+    manifest = write_artifact(args.output, corpus, source=args.corpus)
+    print(json.dumps(manifest))
+    return 0
 
 
 def cmd_serve(args) -> int:
@@ -753,10 +803,17 @@ def cmd_serve(args) -> int:
     delimited JSON on stdin/stdout, or on a Unix domain socket with
     --socket (one session per connection, one shared cache/batcher).
     The `{"op": "stats"}` verb dumps scheduler/cache/latency counters."""
-    from licensee_tpu.serve.server import selftest, serve_stdio, serve_unix
+    from licensee_tpu.serve.server import (
+        selftest,
+        selftest_reload,
+        serve_stdio,
+        serve_unix,
+    )
 
     if args.selftest:
         return selftest()
+    if args.selftest_reload:
+        return selftest_reload()
 
     kwargs, err = _load_corpus(args.corpus)
     if err:
@@ -802,6 +859,7 @@ def cmd_serve(args) -> int:
             trace_sample=args.trace_sample,
             trace_slow_ms=args.trace_slow_ms,
             trace_log=args.trace_log,
+            corpus_source=args.corpus,
             **kwargs,
         )
     except ValueError as exc:
@@ -1048,6 +1106,10 @@ def cmd_fleet(args) -> int:
         from licensee_tpu.fleet.selftest import selftest
 
         return selftest(stub=args.stub)
+    if args.selftest_reload:
+        from licensee_tpu.fleet.selftest import selftest_reload
+
+        return selftest_reload(stub=args.stub)
     if not args.socket:
         print("error: need --socket PATH (the client-facing front "
               "socket) or --selftest", file=sys.stderr)
@@ -1168,6 +1230,7 @@ COMMANDS = (
     ("serve", "Run the online micro-batching classification worker"),
     ("stats", "Scrape serve workers' metrics/traces (obs exporters)"),
     ("fleet", "Supervise N serve workers behind one routed socket"),
+    ("corpus-build", "Compile a corpus into a fingerprinted artifact"),
 )
 _COMMAND_HELP = dict(COMMANDS)
 
@@ -1552,6 +1615,15 @@ def build_parser() -> argparse.ArgumentParser:
             "the CI smoke"
         ),
     )
+    serve.add_argument(
+        "--selftest-reload", action="store_true",
+        help=(
+            "Run the corpus hot-swap smoke (build an artifact, serve "
+            "live traffic, blue/green reload under it, verify the "
+            "fingerprint flipped, the cache fenced, and corrupt/"
+            "unloadable sources refused) and exit 0/1 — the CI smoke"
+        ),
+    )
     serve.set_defaults(func=cmd_serve)
 
     stats = sub.add_parser("stats", help=_COMMAND_HELP["stats"])
@@ -1706,14 +1778,54 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     fleet.add_argument(
+        "--selftest-reload", action="store_true",
+        help=(
+            "Run the fault-drilled zero-downtime upgrade selftest: a "
+            "live 2-worker fleet under continuous traffic completes "
+            ">=3 rolling corpus reloads interleaved with corrupt-"
+            "artifact, refused-validation (rollback), and SIGKILL-"
+            "mid-swap faults, with zero client-visible errors; "
+            "exit 0/1"
+        ),
+    )
+    fleet.add_argument(
         "--stub", action="store_true",
         help=(
-            "With --selftest: use protocol-faithful stub workers "
-            "(no device path) — seconds instead of a JAX boot per "
-            "worker"
+            "With --selftest/--selftest-reload: use protocol-faithful "
+            "stub workers (no device path) — seconds instead of a JAX "
+            "boot per worker"
         ),
     )
     fleet.set_defaults(func=cmd_fleet)
+
+    corpus_build = sub.add_parser(
+        "corpus-build", help=_COMMAND_HELP["corpus-build"]
+    )
+    corpus_build.add_argument(
+        "--corpus", default="vendored",
+        help=(
+            "Source to compile: 'vendored', 'spdx', an SPDX license-"
+            "list-XML src/ directory, or an existing artifact "
+            "(re-fingerprint/repack)"
+        ),
+    )
+    corpus_build.add_argument(
+        "--output", default=None, metavar="PATH",
+        help=(
+            "Write the artifact bundle here (atomic replace; prints "
+            "the fingerprint manifest on success).  Serve it with "
+            "--corpus PATH or hot-swap a live worker with the "
+            "{\"op\": \"reload\"} verb"
+        ),
+    )
+    corpus_build.add_argument(
+        "--inspect", default=None, metavar="PATH",
+        help=(
+            "Load an artifact, verify its payload against the "
+            "fingerprint manifest, and print the manifest"
+        ),
+    )
+    corpus_build.set_defaults(func=cmd_corpus_build)
 
     # the COMMANDS table and the registered subcommands must not drift:
     # `help` prints from the table, the parser dispatches from argparse
@@ -1728,7 +1840,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "fleet", "-h", "--help"}
+    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "fleet", "corpus-build", "-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

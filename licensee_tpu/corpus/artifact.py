@@ -1,0 +1,285 @@
+"""Versioned corpus artifacts: compile once, fingerprint, load anywhere.
+
+Every serving tier so far froze its corpus at import: one compile of the
+vendored pool per process, no way to name "the corpus this worker is
+serving" and no way to hand a worker a new one without killing it.  This
+module makes a compiled corpus a first-class, self-describing ARTIFACT:
+
+* :func:`corpus_fingerprint` — the canonical content fingerprint of a
+  :class:`~licensee_tpu.corpus.compiler.CompiledCorpus`: sha256 over a
+  length-prefixed serialization of every field that shapes a verdict
+  (template keys, vocab order, the packed bit matrix, the score
+  constants, the per-template content hashes, the Exact wordsets).  Two
+  corpora with the same fingerprint classify identically; one changed
+  byte anywhere changes it.  This is the versioning primitive the
+  result-cache fencing, the resume preflight, and the blue/green reload
+  path all key on (it extends the resume sidecar's ``content_sha1``,
+  which hashed template content only).
+
+* :func:`write_artifact` / :func:`load_artifact` — a single-file bundle
+  (numpy ``.npz``: a JSON manifest + the seven constant arrays) that
+  loads WITHOUT recompiling: no template parse, no vocab build, no
+  normalization pass.  ``load_artifact`` recomputes the fingerprint from
+  the loaded payload and refuses a bundle whose manifest disagrees — a
+  truncated copy or a flipped bit fails closed, it can never serve.
+
+* :func:`resolve_corpus` — the one source resolver every consumer
+  shares (the CLI ``--corpus`` flag, the serve ``reload`` verb, the
+  fleet rolling reload): ``"vendored"``, ``"spdx"``, an SPDX
+  license-list-XML directory, or an artifact file path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+
+import numpy as np
+
+from licensee_tpu.corpus.compiler import CompiledCorpus
+
+FORMAT = "licensee-tpu-corpus"
+FORMAT_VERSION = 1
+
+# the arrays serialized into (and hashed out of) every artifact, in
+# canonical order, with their required dtypes — one table so the
+# writer, the loader, and the fingerprint can never disagree
+ARRAY_FIELDS = (
+    ("bits", np.uint32),
+    ("n_wf", np.int32),
+    ("n_fieldset", np.int32),
+    ("field_count", np.int32),
+    ("alt_count", np.int32),
+    ("length", np.int32),
+    ("cc_flag", np.bool_),
+)
+
+
+class ArtifactError(ValueError):
+    """The artifact cannot be trusted: unreadable, wrong format, or its
+    payload no longer hashes to the manifest fingerprint."""
+
+
+def _canonical_sections(corpus: CompiledCorpus):
+    """Yield (name, bytes) sections of the corpus in canonical order.
+
+    Everything that shapes a verdict is here; anything derivable (lane
+    count, template count) is covered by the array bytes themselves."""
+    yield "keys", "\n".join(corpus.keys).encode("utf-8")
+    vocab_words = [None] * len(corpus.vocab)
+    for word, i in corpus.vocab.items():
+        vocab_words[i] = word
+    yield "vocab", "\n".join(vocab_words).encode("utf-8")
+    for name, dtype in ARRAY_FIELDS:
+        arr = np.ascontiguousarray(getattr(corpus, name), dtype=dtype)
+        yield name, arr.tobytes()
+    yield "content_hashes", "\n".join(
+        sorted(f"{key}:{h}" for h, key in corpus.content_hashes.items())
+    ).encode("utf-8")
+    yield "exact_sets", "\n".join(
+        sorted(
+            " ".join(sorted(words)) + "\t" + key
+            for words, key in corpus.exact_sets.items()
+        )
+    ).encode("utf-8")
+
+
+def corpus_fingerprint(corpus: CompiledCorpus) -> str:
+    """The 64-hex sha256 content fingerprint of a compiled corpus.
+
+    Memoized on the corpus object (the payload is a few MB; reload and
+    cache fencing read the fingerprint on hot paths)."""
+    cached = getattr(corpus, "_fingerprint", None)
+    if cached is not None:
+        return cached
+    h = hashlib.sha256()
+    h.update(f"{FORMAT}/v{FORMAT_VERSION}".encode())
+    for name, payload in _canonical_sections(corpus):
+        h.update(name.encode("utf-8"))
+        h.update(len(payload).to_bytes(8, "little"))
+        h.update(payload)
+    fp = h.hexdigest()
+    # CompiledCorpus is a frozen dataclass; the memo is not a field, so
+    # it never enters equality/repr — object.__setattr__ is the blessed
+    # way to attach a cache to a frozen instance
+    object.__setattr__(corpus, "_fingerprint", fp)
+    return fp
+
+
+def short_fingerprint(fp: str | None) -> str | None:
+    """The 12-hex display form (response rows, log lines, gauges)."""
+    return fp[:12] if fp else fp
+
+
+def build_manifest(corpus: CompiledCorpus, source: str = "") -> dict:
+    """The self-description written into (and returned from) a bundle."""
+    return {
+        "format": FORMAT,
+        "format_version": FORMAT_VERSION,
+        "fingerprint": corpus_fingerprint(corpus),
+        "source": source,
+        "templates": corpus.n_templates,
+        "vocab": corpus.vocab_size,
+        "lanes": corpus.n_lanes,
+    }
+
+
+def write_artifact(
+    path: str, corpus: CompiledCorpus, source: str = ""
+) -> dict:
+    """Serialize a compiled corpus to ``path`` (atomic replace).
+
+    Returns the manifest.  The bundle is a plain ``np.savez`` zip: the
+    JSON manifest+metadata as a uint8 array, plus the seven constant
+    arrays — loadable with ``allow_pickle=False`` (no code execution
+    surface in a file an operator ships between hosts)."""
+    vocab_words = [None] * len(corpus.vocab)
+    for word, i in corpus.vocab.items():
+        vocab_words[i] = word
+    manifest = build_manifest(corpus, source)
+    meta = {
+        "manifest": manifest,
+        "keys": list(corpus.keys),
+        "vocab": vocab_words,
+        "content_hashes": corpus.content_hashes,
+        "exact_sets": [
+            [sorted(words), key]
+            for words, key in sorted(
+                corpus.exact_sets.items(),
+                key=lambda kv: (kv[1], sorted(kv[0])),
+            )
+        ],
+    }
+    meta_bytes = json.dumps(meta, ensure_ascii=False).encode("utf-8")
+    arrays = {
+        name: np.ascontiguousarray(getattr(corpus, name), dtype=dtype)
+        for name, dtype in ARRAY_FIELDS
+    }
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, meta=np.frombuffer(meta_bytes, dtype=np.uint8), **arrays
+    )
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+    return manifest
+
+
+def load_artifact(path: str) -> tuple[CompiledCorpus, dict]:
+    """Load a bundle back into a CompiledCorpus, verifying integrity.
+
+    Raises :class:`ArtifactError` on any defect: unreadable file, wrong
+    format/version, missing arrays, wrong dtypes/shapes, or a payload
+    whose recomputed fingerprint differs from the manifest's (bit rot,
+    truncation, tampering).  A loaded corpus is therefore EXACTLY the
+    corpus that was written, proven, not assumed."""
+    import zipfile
+    import zlib
+
+    try:
+        with np.load(path, allow_pickle=False) as npz:
+            data = {name: npz[name] for name in npz.files}
+    except (
+        OSError, ValueError, KeyError, EOFError,
+        zipfile.BadZipFile, zlib.error,
+    ) as exc:
+        # every way a torn/garbage/truncated bundle surfaces from the
+        # zip + npy readers — all fail closed as "cannot be trusted"
+        raise ArtifactError(f"cannot read artifact {path!r}: {exc}") from exc
+    if "meta" not in data:
+        raise ArtifactError(f"{path!r}: not a corpus artifact (no manifest)")
+    try:
+        meta = json.loads(bytes(data["meta"]).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ArtifactError(f"{path!r}: bad manifest: {exc}") from exc
+    manifest = meta.get("manifest") or {}
+    if manifest.get("format") != FORMAT:
+        raise ArtifactError(
+            f"{path!r}: format {manifest.get('format')!r} is not {FORMAT!r}"
+        )
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise ArtifactError(
+            f"{path!r}: format_version {manifest.get('format_version')!r} "
+            f"unsupported (this build reads v{FORMAT_VERSION})"
+        )
+    missing = [name for name, _ in ARRAY_FIELDS if name not in data]
+    if missing:
+        raise ArtifactError(f"{path!r}: missing arrays: {missing}")
+    keys = meta.get("keys")
+    vocab_words = meta.get("vocab")
+    if not isinstance(keys, list) or not isinstance(vocab_words, list):
+        raise ArtifactError(f"{path!r}: bad keys/vocab metadata")
+    arrays = {}
+    for name, dtype in ARRAY_FIELDS:
+        arr = np.ascontiguousarray(data[name], dtype=dtype)
+        if name == "bits":
+            if arr.ndim != 2 or arr.shape[0] != len(keys):
+                raise ArtifactError(
+                    f"{path!r}: bits shape {arr.shape} does not match "
+                    f"{len(keys)} templates"
+                )
+        elif arr.shape != (len(keys),):
+            raise ArtifactError(
+                f"{path!r}: {name} shape {arr.shape} does not match "
+                f"{len(keys)} templates"
+            )
+        arrays[name] = arr
+    corpus = CompiledCorpus(
+        keys=tuple(keys),
+        vocab={w: i for i, w in enumerate(vocab_words)},
+        content_hashes=dict(meta.get("content_hashes") or {}),
+        exact_sets={
+            frozenset(words): key
+            for words, key in meta.get("exact_sets") or []
+        },
+        **arrays,
+    )
+    fp = corpus_fingerprint(corpus)
+    if fp != manifest.get("fingerprint"):
+        raise ArtifactError(
+            f"{path!r}: payload fingerprint {short_fingerprint(fp)} does "
+            f"not match manifest "
+            f"{short_fingerprint(manifest.get('fingerprint'))} — the "
+            "artifact is corrupt; rebuild it with `licensee-tpu "
+            "corpus-build`"
+        )
+    return corpus, manifest
+
+
+def resolve_corpus(source: str) -> tuple[CompiledCorpus, str, dict | None]:
+    """Resolve a corpus SOURCE string to (corpus, fingerprint, manifest).
+
+    The one resolver behind ``--corpus`` and the reload verbs:
+
+    * ``"vendored"`` — the compiled choosealicense pool (process-cached)
+    * ``"spdx"`` — the vendored SPDX license-list-XML mirror
+    * a directory — an SPDX license-list-XML ``src/`` checkout
+    * a file — a corpus artifact written by :func:`write_artifact`
+
+    ``manifest`` is None for compiled-on-the-spot sources.  Raises
+    :class:`ArtifactError` (bad artifact / unknown source) or OSError
+    (unreadable directory)."""
+    if source == "vendored":
+        from licensee_tpu.corpus.compiler import default_corpus
+
+        corpus = default_corpus()
+        return corpus, corpus_fingerprint(corpus), None
+    if source == "spdx" or os.path.isdir(source):
+        from licensee_tpu.corpus.spdx import spdx_corpus
+
+        corpus = spdx_corpus(None if source == "spdx" else source)
+        if not corpus.n_templates:
+            raise ArtifactError(
+                f"no license templates found in {source!r}"
+            )
+        return corpus, corpus_fingerprint(corpus), None
+    if os.path.isfile(source):
+        corpus, manifest = load_artifact(source)
+        return corpus, manifest["fingerprint"], manifest
+    raise ArtifactError(
+        f"cannot load corpus {source!r}: not 'vendored', 'spdx', an SPDX "
+        "src/ directory, or a corpus artifact file"
+    )

@@ -9,12 +9,15 @@ latency exposes).  The selftest (fleet/selftest.py) drives them under
 live traffic and asserts the client never sees an error.
 
 The stub worker (``python -m licensee_tpu.fleet.faults --socket P``)
-speaks the serve JSONL contract — content rows, ``stats``/``trace``
-verbs, trace-ID adoption, ``queue_full`` shedding — with configurable
-misbehavior (``--service-ms``, ``--hang-after``, ``--exit-after``,
-``--queue-full``), so router/supervisor tests exercise real processes,
-real sockets, and real SIGKILL in milliseconds instead of paying a JAX
-import per worker.
+speaks the serve JSONL contract — content rows, ``stats``/``trace``/
+``reload`` verbs, trace-ID adoption, ``queue_full`` shedding, corpus
+fingerprints on stats and content rows — with configurable misbehavior
+(``--service-ms``, ``--hang-after``, ``--exit-after``, ``--queue-full``,
+``--fingerprint``, ``--reload-deny``, and scripted reload values:
+``slow:MS:FP`` sleeps mid-swap, ``fail:``/``corrupt:`` refuse,
+``hang`` wedges), so router/supervisor tests and the rolling-upgrade
+drills exercise real processes, real sockets, and real SIGKILL in
+milliseconds instead of paying a JAX import per worker.
 
 House rules (script/lint): monotonic clocks only, no print — the stub
 talks through its socket and reports errors on stderr.
@@ -111,6 +114,66 @@ class _StubState:
         self.in_flight = 0
         self.traces: deque = deque(maxlen=64)
         self.hang_forever = threading.Event()
+        # the corpus-lifecycle twin: a fingerprint/source pair the
+        # reload verb swaps, echoed on stats and content rows exactly
+        # like a real serve worker — the fleet reload drills ride this
+        self.fingerprint = args.fingerprint
+        self.corpus_source = args.fingerprint
+        self.reloads = 0
+        self.reload_lock = threading.Lock()
+
+
+def _stub_reload(state: _StubState, msg: dict) -> dict | None:
+    """The stub's reload verb, protocol-identical to a serve worker's:
+
+    * any value -> swap to that value as the new fingerprint+source;
+    * ``slow:<ms>:<value>`` -> sleep mid-swap first (the window the
+      SIGKILL-mid-swap drill aims at);
+    * ``fail:...`` / ``corrupt:...`` or a value matching
+      ``--reload-deny`` -> refuse like a failed validation gate, keep
+      the old fingerprint;
+    * ``hang`` -> never answer (the wedge);
+    * a second concurrent reload -> ``reload_in_progress``."""
+    rid = msg.get("id")
+    corpus = msg.get("corpus")
+    if not isinstance(corpus, str) or not corpus:
+        return {"id": rid,
+                "error": "bad_request: reload needs a 'corpus' "
+                "source string"}
+    if not state.reload_lock.acquire(blocking=False):
+        return {"id": rid, "error": "reload_in_progress"}
+    try:
+        if corpus.startswith("slow:"):
+            _, ms, corpus = corpus.split(":", 2)
+            time.sleep(float(ms) / 1000.0)
+        if corpus == "hang":
+            return None
+        deny = state.args.reload_deny
+        if corpus.startswith(("fail:", "corrupt:")) or (
+            deny and corpus.startswith(deny)
+        ):
+            return {
+                "id": rid,
+                "error": f"reload_failed: injected refusal of {corpus!r}",
+                "problems": [f"injected refusal of {corpus!r}"],
+            }
+        with state.lock:
+            previous = state.fingerprint
+            state.fingerprint = corpus
+            state.corpus_source = corpus
+            state.reloads += 1
+        return {
+            "id": rid,
+            "reload": {
+                "ok": True,
+                "fingerprint": corpus,
+                "previous": previous,
+                "unchanged": corpus == previous,
+                "source": corpus,
+            },
+        }
+    finally:
+        state.reload_lock.release()
 
 
 def _stub_answer(state: _StubState, msg: dict) -> dict | None:
@@ -121,6 +184,9 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
     if op == "stats":
         with state.lock:
             completed, in_flight = state.completed, state.in_flight
+            fingerprint = state.fingerprint
+            source = state.corpus_source
+            reloads = state.reloads
         if msg.get("format") == "prometheus":
             text = (
                 "# HELP stub_requests_total Stub worker requests.\n"
@@ -133,6 +199,11 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
             "stats": {
                 "uptime_s": round(time.perf_counter() - state.t0, 3),
                 "worker": state.name,
+                "corpus": {
+                    "fingerprint": fingerprint,
+                    "source": source,
+                    "reloads": reloads,
+                },
                 "scheduler": {
                     "queue_depth": args.report_load,
                     "in_flight": in_flight,
@@ -140,6 +211,8 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
                 },
             },
         }
+    if op == "reload":
+        return _stub_reload(state, msg)
     if op == "trace":
         with state.lock:
             tail = list(state.traces)[-int(msg.get("n", 20)):]
@@ -172,9 +245,13 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
     if args.exit_after and n >= args.exit_after:
         # crash AFTER answering: the next request finds a dead socket
         threading.Timer(0.05, os._exit, args=(41,)).start()
+    with state.lock:
+        fingerprint = state.fingerprint
     row = {
         "id": rid, "key": "stub-mit", "matcher": "stub",
         "confidence": 99.0, "cached": False, "stub_worker": state.name,
+        # one fingerprint per answer, like a real worker's corpus field
+        "corpus": fingerprint,
     }
     if msg.get("trace"):
         row["trace"] = msg["trace"]
@@ -232,6 +309,16 @@ def stub_main(argv=None) -> int:
     parser.add_argument(
         "--queue-full", action="store_true",
         help="Answer every content row with queue_full backpressure",
+    )
+    parser.add_argument(
+        "--fingerprint", default="stub-fp-0",
+        help="The corpus fingerprint/source this stub reports until a "
+        "reload verb swaps it (the corpus-lifecycle drills)",
+    )
+    parser.add_argument(
+        "--reload-deny", default=None, metavar="PREFIX",
+        help="Refuse reload verbs whose corpus value starts with "
+        "PREFIX (the per-worker validation-failure script)",
     )
     args = parser.parse_args(argv)
     try:

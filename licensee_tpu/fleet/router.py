@@ -593,6 +593,17 @@ class Router:
     def trace_tail(self, n: int = 20) -> list[dict]:
         return self.obs.tracer.tail(n)
 
+    def reload_fleet(self, corpus: str) -> dict:
+        """The front-door rolling corpus reload: delegates to the
+        attached supervisor's health-gated, rollback-capable
+        ``reload_fleet`` (fleet/supervisor.py) — one ops verb swaps the
+        whole fleet with zero downtime."""
+        if self.supervisor is None:
+            raise RuntimeError(
+                "no supervisor attached; reload workers directly"
+            )
+        return self.supervisor.reload_fleet(corpus)
+
 
 class _RouterSession:
     """One client session on the front socket: parse lines, dispatch
@@ -640,6 +651,18 @@ class _RouterSession:
             elif kind == "trace":
                 rid, n = payload
                 row = {"id": rid, "traces": self.router.trace_tail(n)}
+            elif kind == "reload":
+                rid, corpus = payload
+                try:
+                    # a fleet reload IS a long synchronous ops verb by
+                    # contract: it runs on this session's writer thread
+                    # (same as the prometheus fan-out scrape) and holds
+                    # only this session's response stream, never the
+                    # dispatch path
+                    row = {"id": rid,
+                           "reload": self.router.reload_fleet(corpus)}
+                except Exception as exc:  # noqa: BLE001 — session containment
+                    row = {"id": rid, "error": f"reload_failed: {exc}"}
             else:
                 row = payload
             try:
@@ -683,6 +706,18 @@ class _RouterSession:
                 )
                 return
             self._emit("trace", (rid, n))
+            return
+        if op == "reload":
+            corpus = msg.get("corpus")
+            if not isinstance(corpus, str) or not corpus:
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: reload needs a 'corpus' "
+                     "source string"},
+                )
+                return
+            self._emit("reload", (rid, corpus))
             return
         if op is not None:
             self._emit(

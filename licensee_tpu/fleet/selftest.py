@@ -35,6 +35,7 @@ import tempfile
 import threading
 import time
 
+from licensee_tpu.corpus.artifact import short_fingerprint
 from licensee_tpu.fleet import faults
 from licensee_tpu.fleet.router import FrontServer, Router
 from licensee_tpu.fleet.supervisor import Supervisor, worker_env
@@ -55,6 +56,24 @@ def _serve_argv(name: str, sock: str) -> list[str]:
         "--socket", sock, "--max-delay-ms", "5",
         "--trace-sample", "1.0",
     ]
+
+
+def _stub_reload_argv(name: str, sock: str) -> list[str]:
+    argv = [
+        sys.executable, "-m", "licensee_tpu.fleet.faults",
+        "--socket", sock, "--name", name, "--service-ms", "5",
+        "--fingerprint", "fp-old",
+    ]
+    if name == "w1":
+        # the per-worker validation-failure script: w1 refuses any
+        # corpus starting "deny-", so a fleet roll of one fails AFTER
+        # w0 succeeded — the rollback drill
+        argv += ["--reload-deny", "deny-"]
+    return argv
+
+
+def _serve_reload_argv(name: str, sock: str) -> list[str]:
+    return _serve_argv(name, sock) + ["--corpus", "vendored"]
 
 
 def _client_blobs(stub: bool, n_unique: int = 8) -> list[str]:
@@ -237,6 +256,368 @@ def selftest(
 
 class _Abort(Exception):
     """Internal early-exit: boot failed, nothing further to assert."""
+
+
+class _ReloadTraffic:
+    """Continuous client traffic through the front socket for the
+    reload drill: sequential request/response round trips on one
+    connection, every row collected, until stopped."""
+
+    def __init__(self, front_path: str, blobs: list[str],
+                 timeout_s: float):
+        self.front_path = front_path
+        self.blobs = blobs
+        self.timeout_s = timeout_s
+        self.rows: list[dict] = []
+        self.errors: list[str] = []
+        self.reconnects = 0
+        self.stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def finish(self) -> None:
+        self.stop.set()
+        self._thread.join(timeout=self.timeout_s + 10.0)
+
+    def _run(self) -> None:
+        from licensee_tpu.fleet.wire import Connection, WireError
+
+        conn = None
+        i = 0
+        while not self.stop.is_set():
+            try:
+                if conn is None:
+                    conn = Connection(self.front_path, self.timeout_s)
+                line = json.dumps({
+                    "id": i,
+                    "content": self.blobs[i % len(self.blobs)],
+                    "filename": "LICENSE",
+                })
+                self.rows.append(conn.request(line, self.timeout_s))
+                i += 1
+            except WireError as exc:
+                # the front socket must never drop a session during a
+                # reload: any reconnect is itself a finding (counted),
+                # and a failure on a FRESH connection is a hard error
+                if conn is None:
+                    self.errors.append(str(exc))
+                    self.stop.wait(0.2)
+                else:
+                    self.reconnects += 1
+                    conn.close()
+                    conn = None
+            time.sleep(0.005)
+        if conn is not None:
+            conn.close()
+
+
+def _fingerprints(supervisor: Supervisor) -> dict:
+    """name -> reported corpus fingerprint for every probeable worker."""
+    out = {}
+    for name in supervisor.workers:
+        stats = supervisor.probe(name)
+        out[name] = ((stats or {}).get("corpus") or {}).get("fingerprint")
+    return out
+
+
+def _await_respawn(
+    supervisor: Supervisor, name: str, timeout_s: float
+) -> bool:
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if supervisor.probe(name) is not None:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _patch_stub_argv(argv: list[str], corpus: str) -> list[str]:
+    """The stub twin of Supervisor.patch_corpus_argv: a respawned stub
+    must report the fingerprint its fleet was rolled onto."""
+    out = list(argv)
+    for i, arg in enumerate(out[:-1]):
+        if arg == "--fingerprint":
+            out[i + 1] = corpus
+            return out
+    return out + ["--fingerprint", corpus]
+
+
+def selftest_reload(
+    verbose: bool = True,
+    stub: bool = False,
+    n_workers: int = 2,
+) -> int:
+    """The fault-drilled zero-downtime upgrade selftest (``licensee-tpu
+    fleet --selftest-reload``): a live 2-worker fleet under continuous
+    front-socket traffic completes >=3 rolling corpus reloads
+    interleaved with injected failures — a corrupt-artifact reload, a
+    refused (validation-failure) reload that triggers automatic
+    rollback, and (stub mode) a SIGKILL mid-swap — gating that
+
+    * the client sees ZERO errors across every drill;
+    * every response carries exactly one KNOWN corpus fingerprint
+      (old or new, never anything else — no half-swapped corpus);
+    * failed rolls leave the fleet healthy on the previous fingerprint,
+      rollback included;
+    * a crash-restarted worker rejoins on the fleet's CURRENT corpus
+      (the respawn argv is patched by the roll).
+
+    ``stub=True`` runs protocol-faithful stub workers (real processes,
+    sockets, and signals; no JAX) — the fast CI path; ``stub=False``
+    drives real serve workers through real corpus artifacts."""
+    problems: list[str] = []
+    tmpdir = tempfile.mkdtemp(prefix="licensee-reload-fleet-")
+    sockets = {
+        f"w{i}": os.path.join(tmpdir, f"w{i}.sock")
+        for i in range(n_workers)
+    }
+    boot_timeout = 20.0 if stub else 240.0
+    req_timeout = 10.0 if stub else 120.0
+    env = worker_env(None, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    supervisor = Supervisor(
+        sockets,
+        argv_for=(_stub_reload_argv if stub else _serve_reload_argv),
+        env_for=lambda name, chips: env,
+        probe_interval_s=0.25,
+        backoff_base_s=0.25,
+        backoff_max_s=2.0,
+        startup_grace_s=boot_timeout,
+    )
+    router = Router(
+        sockets,
+        supervisor=supervisor,
+        probe_interval_s=0.25,
+        request_timeout_s=req_timeout,
+        dispatch_wait_s=req_timeout + 30.0,
+    )
+    front_path = os.path.join(tmpdir, "front.sock")
+    server = None
+    server_thread = None
+    traffic = None
+    argv_patch = _patch_stub_argv if stub else None
+    want_key = "stub-mit" if stub else "mit"
+    allowed_fps: set[str] = set()
+    good_rolls = 0
+    try:
+        supervisor.start()
+        if not supervisor.wait_healthy(boot_timeout):
+            problems.append(
+                f"workers never became healthy: {supervisor.status()}"
+            )
+            raise _Abort()
+        router.start()
+        server = FrontServer(front_path, router)
+        server_thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        server_thread.start()
+        fp_old = _fingerprints(supervisor)["w0"]
+        if not fp_old:
+            problems.append("workers report no corpus fingerprint")
+            raise _Abort()
+        allowed_fps.add(fp_old)
+
+        if stub:
+            targets = ["fp-new-1", "fp-new-2", "fp-new-4"]
+            bad_source = "corrupt:drill"
+            deny_source = "deny-fp"
+        else:
+            from licensee_tpu.corpus.artifact import write_artifact
+            from licensee_tpu.corpus.spdx import spdx_corpus
+
+            artifact = os.path.join(tmpdir, "spdx.corpus.npz")
+            write_artifact(artifact, spdx_corpus(None), source="spdx")
+            bad_source = os.path.join(tmpdir, "corrupt.corpus.npz")
+            with open(bad_source, "wb") as f:
+                f.write(b"definitely not a corpus artifact")
+            targets = [artifact, "vendored", artifact]
+            deny_source = None
+
+        traffic = _ReloadTraffic(
+            front_path, _client_blobs(stub), req_timeout
+        )
+        traffic.start()
+        time.sleep(0.5)  # rows in flight before the first roll
+
+        def roll(source: str, expect_ok: bool, label: str):
+            nonlocal good_rolls
+            out = supervisor.reload_fleet(
+                source, timeout_s=req_timeout + 60.0,
+                health_timeout_s=30.0, argv_patch=argv_patch,
+            )
+            if bool(out["ok"]) != expect_ok:
+                problems.append(f"{label}: unexpected outcome {out}")
+            if out.get("fingerprint"):
+                allowed_fps.add(out["fingerprint"])
+            if out["ok"]:
+                good_rolls += 1
+                fps = set(_fingerprints(supervisor).values())
+                if fps != {out["fingerprint"]}:
+                    problems.append(
+                        f"{label}: fleet fingerprints diverged: {fps}"
+                    )
+            return out
+
+        # -- roll 1: clean fleet-wide reload --
+        out1 = roll(targets[0], True, "roll-1")
+        fp_roll1 = out1.get("fingerprint")
+
+        # -- crash-restart keeps the ROLLED corpus (argv patch) --
+        pid = supervisor.workers["w0"].pid
+        if pid:
+            faults.kill(pid)
+        if not _await_respawn(supervisor, "w0", boot_timeout + 10.0):
+            problems.append("w0 never respawned after SIGKILL")
+        elif stub:
+            # a real serve worker re-compiles the artifact on respawn —
+            # same fingerprint; the stub proves the argv patch directly
+            got = _fingerprints(supervisor)["w0"]
+            if got != fp_roll1:
+                problems.append(
+                    f"respawned w0 on {got!r}, fleet rolled to "
+                    f"{fp_roll1!r} — restart rolled it back"
+                )
+
+        # -- corrupt-artifact roll: refused, fleet unmoved --
+        before = _fingerprints(supervisor)
+        roll(bad_source, False, "roll-corrupt")
+        after = _fingerprints(supervisor)
+        if before != after:
+            problems.append(
+                f"corrupt roll moved fingerprints: {before} -> {after}"
+            )
+
+        # -- refused-validation roll with automatic rollback (stub:
+        #    w1 denies, w0 already swapped -> rolled back) --
+        if deny_source is not None:
+            # w0 swaps to the denied source before w1 refuses it, so a
+            # few rows legitimately carry it until the rollback lands
+            allowed_fps.add(deny_source)
+            out_deny = roll(deny_source, False, "roll-deny")
+            if not out_deny.get("rolled_back"):
+                problems.append(f"deny roll did not roll back: {out_deny}")
+            fps = set(_fingerprints(supervisor).values())
+            if fps != set(before.values()):
+                problems.append(
+                    f"rollback left fleet on {fps}, wanted "
+                    f"{set(before.values())}"
+                )
+
+        # -- roll 2 --
+        roll(targets[1], True, "roll-2")
+
+        # -- SIGKILL mid-swap (stub: the slow reload window) --
+        if stub:
+            fps_before_kill = _fingerprints(supervisor)
+            allowed_fps.add("fp-mid-3")  # a late kill may land post-swap
+            killer_done: list[dict] = []
+
+            def slow_roll() -> None:
+                killer_done.append(supervisor.reload_fleet(
+                    "slow:1500:fp-mid-3", timeout_s=req_timeout + 60.0,
+                    health_timeout_s=30.0, argv_patch=argv_patch,
+                ))
+
+            rt = threading.Thread(target=slow_roll, daemon=True)
+            rt.start()
+            time.sleep(0.4)  # w0 is mid-swap (sleeping in the verb)
+            pid = supervisor.workers["w0"].pid
+            if pid:
+                faults.kill(pid)
+            rt.join(timeout=req_timeout + 90.0)
+            if not killer_done or killer_done[0].get("ok"):
+                problems.append(
+                    f"SIGKILL mid-swap roll reported ok: {killer_done}"
+                )
+            if not _await_respawn(supervisor, "w0", boot_timeout + 10.0):
+                problems.append("w0 never respawned after mid-swap kill")
+            else:
+                fps = _fingerprints(supervisor)
+                if set(fps.values()) != set(fps_before_kill.values()):
+                    problems.append(
+                        f"mid-swap kill left fleet on {fps}, wanted "
+                        f"{fps_before_kill}"
+                    )
+
+        # -- roll 3 --
+        roll(targets[2], True, "roll-3")
+
+        if good_rolls < 3:
+            problems.append(f"only {good_rolls} clean rolls (< 3)")
+
+        time.sleep(0.5)  # post-roll traffic on the final corpus
+        traffic.finish()
+        errors = [r for r in traffic.rows if r.get("error")]
+        errors = traffic.errors + [str(e)[:200] for e in errors]
+        if errors:
+            problems.append(
+                f"{len(errors)} client-visible errors, e.g. {errors[:3]}"
+            )
+        if traffic.reconnects:
+            problems.append(
+                f"front socket dropped the client session "
+                f"{traffic.reconnects} time(s)"
+            )
+        wrong = [
+            r for r in traffic.rows
+            if not r.get("error") and r.get("key") != want_key
+        ]
+        if wrong:
+            problems.append(f"wrong verdicts, e.g. {wrong[:3]}")
+        if len(traffic.rows) < 50:
+            problems.append(
+                f"only {len(traffic.rows)} traffic rows — the drill "
+                "did not run under load"
+            )
+        unattributed = [
+            r for r in traffic.rows
+            if not r.get("error") and not r.get("corpus")
+        ]
+        if unattributed:
+            problems.append(
+                f"{len(unattributed)} responses carry no corpus "
+                f"fingerprint, e.g. {unattributed[:2]}"
+            )
+        short_allowed = {
+            short_fingerprint(fp) for fp in allowed_fps
+        } | allowed_fps
+        alien = [
+            r for r in traffic.rows
+            if r.get("corpus") and r["corpus"] not in short_allowed
+        ]
+        if alien:
+            problems.append(
+                f"{len(alien)} responses attributed to an unknown "
+                f"corpus, e.g. {alien[:2]} (known: {sorted(short_allowed)})"
+            )
+    except _Abort:
+        pass
+    except Exception as exc:  # noqa: BLE001 — selftest must report, not die
+        problems.append(f"selftest crashed: {type(exc).__name__}: {exc}")
+    finally:
+        if traffic is not None and not traffic.stop.is_set():
+            traffic.finish()
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if server_thread is not None:
+            server_thread.join(timeout=5.0)
+        router.close()
+        supervisor.stop()
+    if verbose:
+        summary = {
+            "reload_fleet_selftest": "ok" if not problems else "FAIL",
+            "stub_workers": stub,
+            "clean_rolls": good_rolls,
+            "traffic_rows": len(traffic.rows) if traffic else 0,
+            "problems": problems,
+        }
+        sys.stderr.write(json.dumps(summary) + "\n")
+    return 0 if not problems else 1
 
 
 def _drive_traffic(

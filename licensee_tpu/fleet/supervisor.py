@@ -27,6 +27,11 @@ Failure handling, in escalation order:
   queued/in-flight work AND the router reports zero outstanding routed
   requests, then SIGTERM (the serve loop shuts down cleanly and
   unlinks its socket), escalating to SIGKILL only on a stuck exit.
+
+Upgrades ride :meth:`Supervisor.reload_fleet`: a rolling, health-gated
+corpus reload (one worker mid-swap at a time, failure budget, automatic
+rollback, respawn-argv patching) — zero-downtime corpus rollout with
+``rolling_restart()`` as the fallback path.
 """
 
 from __future__ import annotations
@@ -235,6 +240,13 @@ class Supervisor:
             )
             self.workers[name] = WorkerHandle(name, sock, argv, env)
         self._lock = threading.Lock()
+        # fleet-level reload mutex: one rolling reload at a time.  Two
+        # concurrent rolls would interleave worker swaps (the per-worker
+        # reload_in_progress guard only catches same-instant overlap on
+        # ONE worker), leaving the fleet on mixed fingerprints with
+        # clobbered respawn argv — the second roll is refused
+        # deterministically instead, mirroring the worker-level verb.
+        self._reload_fleet_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -504,6 +516,207 @@ class Supervisor:
                     break
                 time.sleep(0.1)
         return out
+
+    # -- fleet-wide rolling corpus reload --
+
+    @staticmethod
+    def patch_corpus_argv(argv: list[str], corpus: str) -> list[str]:
+        """Rewrite a serve worker's argv so a LATER crash-restart boots
+        the corpus it was rolled onto — without this, a restart would
+        silently roll one replica back to its launch-time corpus.
+        Replaces the value after ``--corpus`` (or appends the pair)."""
+        out = list(argv)
+        for i, arg in enumerate(out[:-1]):
+            if arg == "--corpus":
+                out[i + 1] = corpus
+                return out
+        return out + ["--corpus", corpus]
+
+    def _await_fingerprint(
+        self, name: str, fingerprint: str | None, timeout_s: float
+    ) -> bool:
+        """Health-gate one worker after its reload verb answered: it
+        must come back on probes AND report the expected fingerprint."""
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            stats = self.probe(name)
+            if stats is not None:
+                got = (stats.get("corpus") or {}).get("fingerprint")
+                if fingerprint is None or got == fingerprint:
+                    return True
+            time.sleep(0.1)
+        return False
+
+    def reload_fleet(
+        self,
+        corpus: str,
+        *,
+        timeout_s: float = 300.0,
+        health_timeout_s: float = 30.0,
+        failure_budget: int = 0,
+        rollback: bool = True,
+        argv_patch=None,
+    ) -> dict:
+        """Rolling corpus reload: one worker at a time, health-gated,
+        with a capped failure budget and automatic rollback.
+
+        Per worker, in sequence: send ``{"op": "reload"}``, wait for
+        the validated swap to answer, then gate on a health probe
+        reporting the NEW fingerprint before touching the next replica
+        — at most one worker is ever mid-swap, so the fleet keeps
+        serving throughout.  A worker that refuses the corpus (compile
+        error, corrupt artifact, failed validation) or dies mid-swap
+        counts against ``failure_budget``; when the budget is exceeded,
+        every already-reloaded worker is rolled back to the corpus
+        source it reported before the roll, and the fleet is left
+        healthy on the OLD fingerprint.  ``rolling_restart()`` remains
+        the fallback path when a corpus can only change via argv.
+
+        ``argv_patch(argv, corpus) -> argv`` rewrites a successfully
+        reloaded worker's respawn command (default:
+        :meth:`patch_corpus_argv`) so later crash-restarts boot the new
+        corpus instead of silently rolling back one replica.
+
+        One roll at a time, fleet-wide: a reload_fleet that arrives
+        while another is rolling is refused deterministically
+        (``error: fleet_reload_in_progress``) — never queued, never
+        interleaved — so "at most one worker is ever mid-swap" holds
+        across concurrent callers, not just within one roll."""
+        if not self._reload_fleet_lock.acquire(blocking=False):
+            return {
+                "ok": False,
+                "corpus": corpus,
+                "fingerprint": None,
+                "rolled_back": False,
+                "error": "fleet_reload_in_progress",
+                "workers": {},
+            }
+        try:
+            return self._reload_fleet_locked(
+                corpus,
+                timeout_s=timeout_s,
+                health_timeout_s=health_timeout_s,
+                failure_budget=failure_budget,
+                rollback=rollback,
+                argv_patch=argv_patch,
+            )
+        finally:
+            self._reload_fleet_lock.release()
+
+    def _reload_fleet_locked(
+        self,
+        corpus: str,
+        *,
+        timeout_s: float,
+        health_timeout_s: float,
+        failure_budget: int,
+        rollback: bool,
+        argv_patch,
+    ) -> dict:
+        if argv_patch is None:
+            argv_patch = self.patch_corpus_argv
+        results: dict[str, dict] = {}
+        succeeded: list[tuple[str, str | None, list[str]]] = []
+        failures = 0
+        target_fp: str | None = None
+        out = {
+            "ok": True,
+            "corpus": corpus,
+            "fingerprint": None,
+            "rolled_back": False,
+            "workers": results,
+        }
+        for name in list(self.workers):
+            handle = self.workers[name]
+            if handle.state == STOPPED:
+                results[name] = {"skipped": "stopped"}
+                continue
+            before = self.probe(name) or {}
+            old_source = (before.get("corpus") or {}).get("source")
+            row = None
+            error = None
+            try:
+                row = oneshot(
+                    handle.socket_path,
+                    {"op": "reload", "corpus": corpus},
+                    timeout_s,
+                )
+            except WireError as exc:
+                error = f"reload transport failed: {exc}"
+            if row is not None:
+                reload_row = row.get("reload")
+                if isinstance(reload_row, dict) and reload_row.get("ok"):
+                    fp = reload_row.get("fingerprint")
+                    target_fp = fp or target_fp
+                    if self._await_fingerprint(name, fp, health_timeout_s):
+                        results[name] = {"ok": True, "fingerprint": fp}
+                        old_argv = list(handle.argv)
+                        with self._lock:
+                            handle.argv = argv_patch(handle.argv, corpus)
+                        succeeded.append((name, old_source, old_argv))
+                        continue
+                    error = (
+                        f"worker unhealthy (or on the wrong fingerprint) "
+                        f"{health_timeout_s}s after reload"
+                    )
+                else:
+                    error = str(
+                        row.get("error") or f"unexpected response: {row}"
+                    )
+            failures += 1
+            results[name] = {"ok": False, "error": error}
+            if failures > failure_budget:
+                out["ok"] = False
+                if rollback and succeeded:
+                    out["rolled_back"] = True
+                    self._rollback(succeeded, results, timeout_s)
+                break
+        out["fingerprint"] = None if out["rolled_back"] else target_fp
+        if out["ok"] and failures:
+            out["ok"] = False  # within budget, but not a clean roll
+        return out
+
+    def _rollback(
+        self,
+        succeeded: list[tuple[str, str | None, list[str]]],
+        results: dict,
+        timeout_s: float,
+    ) -> None:
+        """Return every already-reloaded worker to its pre-roll corpus
+        (newest first, mirroring the forward order) and restore its
+        respawn argv."""
+        for name, old_source, old_argv in reversed(succeeded):
+            handle = self.workers.get(name)
+            if handle is None:
+                continue
+            with self._lock:
+                handle.argv = old_argv
+            entry = results.get(name) or {}
+            if not old_source:
+                entry["rolled_back"] = False
+                entry["rollback_error"] = (
+                    "previous corpus source unknown; restart will "
+                    "restore it from argv"
+                )
+                results[name] = entry
+                continue
+            try:
+                row = oneshot(
+                    handle.socket_path,
+                    {"op": "reload", "corpus": old_source},
+                    timeout_s,
+                )
+                ok = bool(
+                    isinstance(row.get("reload"), dict)
+                    and row["reload"].get("ok")
+                )
+                entry["rolled_back"] = ok
+                if not ok:
+                    entry["rollback_error"] = str(row.get("error") or row)
+            except WireError as exc:
+                entry["rolled_back"] = False
+                entry["rollback_error"] = str(exc)
+            results[name] = entry
 
     # -- introspection --
 

@@ -207,6 +207,7 @@ class BatchProject:
         already_striped: bool = False,
         coalesce_batches: int = 32,
         tracer=None,
+        corpus_source: str | None = None,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -284,6 +285,10 @@ class BatchProject:
         self.dedupe_cap = dedupe_cap
         self._dedupe_cache: dict = {}
         self.mode = self.classifier.mode
+        # the --corpus source string ("vendored" / "spdx" / a dir / an
+        # artifact path), recorded in the resume sidecar so a corpus-
+        # fingerprint refusal can NAME the corpus that wrote the output
+        self.corpus_source = corpus_source
         # --attribution: extract the copyright line per matched blob
         # (post-match host regex; with dedupe, once per unique content).
         # Raw contents ride the pipeline tuples only when enabled.
@@ -451,6 +456,9 @@ class BatchProject:
             "threshold": self.threshold,
             "closest": self.classifier.closest,
             "attribution": self.attribution,
+            # descriptive only (never compared): names the corpus in
+            # refusal messages — "the output was written with X"
+            "corpus_source": self.corpus_source,
         }
 
     def _check_resume_config(self, output: str, resume: bool) -> dict:
@@ -472,19 +480,42 @@ class BatchProject:
             if prior is not None:
                 # compare key-by-key over THIS version's fields: a
                 # sidecar from a newer version with extra keys must not
-                # refuse a resume whose tracked settings all match
+                # refuse a resume whose tracked settings all match.
+                # corpus_source is descriptive (it names a path/alias,
+                # not content) — the corpus_id fingerprints decide.
                 diffs = [
                     k
                     for k in config
-                    if prior.get(k) != config[k]
+                    if k != "corpus_source" and prior.get(k) != config[k]
                 ]
                 if diffs:
+                    detail = ""
+                    if "corpus" in diffs:
+                        # name BOTH corpora: the fingerprints that
+                        # disagree and where each came from — an opaque
+                        # "corpus changed" costs the operator a
+                        # spelunking session at 3am
+                        prior_c = prior.get("corpus") or {}
+                        cur_c = config.get("corpus") or {}
+                        prior_src = prior.get("corpus_source")
+                        detail = (
+                            "; corpus fingerprint mismatch: the output "
+                            f"was written with corpus "
+                            f"{prior_src or 'unknown source'} "
+                            f"(content_sha1 "
+                            f"{prior_c.get('content_sha1')}, "
+                            f"{prior_c.get('templates')} templates), "
+                            f"this run uses "
+                            f"{self.corpus_source or 'unknown source'} "
+                            f"(content_sha1 {cur_c.get('content_sha1')}, "
+                            f"{cur_c.get('templates')} templates)"
+                        )
                     raise ResumeConfigError(
                         f"cannot resume {output!r}: this run's "
                         "configuration differs from the one that wrote "
-                        f"it ({', '.join(diffs)} changed — {meta_path}); "
-                        "rerun with matching settings, a fresh --output, "
-                        "or --no-resume"
+                        f"it ({', '.join(diffs)} changed — {meta_path})"
+                        f"{detail}; rerun with matching settings, a "
+                        "fresh --output, or --no-resume"
                     )
         return config
 

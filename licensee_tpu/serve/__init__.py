@@ -18,8 +18,14 @@ answers with bounded latency:
                       deadlines, queue-full rejection with retry_after,
                       host scalar Dice fallback on device failure
   serve.server      — newline-delimited-JSON transport over stdio and a
-                      Unix domain socket, plus the `stats` control verb
-                      (the `licensee-tpu serve` CLI command)
+                      Unix domain socket, plus the `stats`/`trace`/
+                      `reload` control verbs (the `licensee-tpu serve`
+                      CLI command)
+  serve.reload      — the corpus hot-swap machinery: build a
+                      replacement classifier off-thread, validate it
+                      (shape sanity + golden parity probe against the
+                      device path), and only then let the scheduler
+                      swap epochs
 
 Imports are lazy (PEP 562): ``import licensee_tpu.serve`` stays cheap;
 the heavy classifier machinery loads only when a symbol is touched.
@@ -36,6 +42,10 @@ _EXPORTS = {
     "serve_stdio": "licensee_tpu.serve.server",
     "serve_unix": "licensee_tpu.serve.server",
     "selftest": "licensee_tpu.serve.server",
+    "selftest_reload": "licensee_tpu.serve.server",
+    "ReloadError": "licensee_tpu.serve.reload",
+    "ReloadInProgressError": "licensee_tpu.serve.reload",
+    "ReloadRejectedError": "licensee_tpu.serve.reload",
 }
 
 __all__ = list(_EXPORTS)

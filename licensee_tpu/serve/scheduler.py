@@ -41,6 +41,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 import licensee_tpu
+from licensee_tpu.corpus.artifact import short_fingerprint
 from licensee_tpu.kernels.batch import BlobResult
 from licensee_tpu.obs import NativeProfileSource, Observability
 from licensee_tpu.serve.cache import ResultCache
@@ -87,6 +88,12 @@ class ServeRequest:
     enqueued_at: float = 0.0
     prepared: object = None  # size-1 PreparedBatch while Dice-bound
     cache_key: object = None
+    # the classifier epoch this request was admitted under: featurized
+    # with ITS vocab, scored against ITS matrix — a reload swapping the
+    # active epoch mid-flight must never mix the two (the fence that
+    # makes every response attributable to exactly one corpus)
+    clf: object = None
+    corpus_fp: str | None = None
     result: BlobResult | None = None
     cached: bool = False
     # concurrent duplicates of this request (same content key, admitted
@@ -139,6 +146,7 @@ class MicroBatcher:
         trace_sample: float = 0.01,
         trace_slow_ms: float = 250.0,
         trace_log: str | None = None,
+        corpus_source: str | None = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch!r}")
@@ -160,7 +168,24 @@ class MicroBatcher:
                 mesh=mesh,
                 pad_batch_to=max_batch,
             )
-        self.classifier = classifier
+        # the active corpus epoch: (classifier, fingerprint), swapped
+        # ATOMICALLY (one attribute assignment under the lock) by
+        # reload_corpus.  Every request snapshots the pair once at
+        # admission; the scheduler scores each request with the epoch
+        # it was featurized under, so a swap can never mix vocabularies
+        # and matrices inside one verdict.
+        # getattr: unit tests drive the scheduler with minimal fake
+        # classifiers that carry no corpus at all
+        fp = None
+        if getattr(classifier, "corpus", None) is not None:
+            from licensee_tpu.corpus.artifact import corpus_fingerprint
+
+            fp = corpus_fingerprint(classifier.corpus)
+        self._active = (classifier, fp)
+        self._seen_fps = {fp} if fp else set()
+        self._corpus_source = corpus_source
+        self._method_arg = method  # re-resolved per reload (e.g. "auto")
+        self._reload_lock = threading.Lock()
         self.mode = classifier.mode
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
@@ -222,6 +247,9 @@ class MicroBatcher:
             "rejected": 0,
             "expired": 0,
             "fallbacks": 0,
+            "reloads": 0,
+            "reload_failed": 0,
+            "reload_rejected": 0,
         }
         self._flush_reasons = {"full": 0, "deadline": 0, "drain": 0}
         self._bucket_counts: dict[int, int] = {}
@@ -229,6 +257,36 @@ class MicroBatcher:
         self._register_metrics()
         if start:
             self.start()
+
+    @property
+    def classifier(self):
+        """The ACTIVE classifier (current corpus epoch).  A bare tuple
+        read: the epoch pair is replaced atomically, and every consumer
+        that must stay consistent across several reads (submit, the
+        flush loop) snapshots ``_active`` once instead of re-reading.
+        """
+        # epoch handoff, not shared mutable state: _active is replaced
+        # wholesale under the lock and this single read is atomic — a
+        # reader sees the old pair or the new pair, never a mix
+        return self._active[0]
+
+    @classifier.setter
+    def classifier(self, clf) -> None:
+        fp = None
+        if getattr(clf, "corpus", None) is not None:
+            from licensee_tpu.corpus.artifact import corpus_fingerprint
+
+            fp = corpus_fingerprint(clf.corpus)
+        with self._lock:
+            self._active = (clf, fp)
+            if fp:
+                self._seen_fps.add(fp)
+
+    @property
+    def corpus_fingerprint(self) -> str | None:
+        """The active corpus fingerprint (None for corpus-free modes)."""
+        # same single-atomic-read epoch handoff as `classifier` above
+        return self._active[1]
 
     def _register_metrics(self) -> None:
         """Wire every serve-path stat into the obs registry: live
@@ -281,6 +339,12 @@ class MicroBatcher:
             "Tracer retention events (started / retained / slow)",
             labels=("event",),
         )
+        corpus_info = reg.gauge(
+            "serve_corpus_info",
+            "Active corpus fingerprint (1 on the serving fingerprint "
+            "label, 0 on fingerprints this worker served before)",
+            labels=("fingerprint",),
+        )
         NativeProfileSource(reg)
 
         def collect(_reg) -> None:
@@ -288,6 +352,12 @@ class MicroBatcher:
                 counters = dict(self._counters)
                 flush_now = dict(self._flush_reasons)
                 buckets_now = dict(self._bucket_counts)
+                active_fp = self._active[1]
+                seen_fps = set(self._seen_fps)
+            for fp in seen_fps:
+                corpus_info.labels(
+                    fingerprint=short_fingerprint(fp)
+                ).set(1.0 if fp == active_fp else 0.0)
             for k, v in counters.items():
                 events.labels(event=k).sync(v)
             for k, v in flush_now.items():
@@ -392,6 +462,74 @@ class MicroBatcher:
             self._paused = False
             self._cond.notify_all()
 
+    # -- corpus lifecycle (blue/green reload) --
+
+    def reload_corpus(self, source: str) -> dict:
+        """Validated blue/green corpus swap: build a full replacement
+        classifier for ``source`` (vendored / spdx / SPDX dir / corpus
+        artifact), run the validation gate, and only then swap the
+        active epoch — one atomic assignment between scheduler batches.
+
+        On ANY failure (unloadable source, compile error, corrupt
+        artifact, parity-probe mismatch) the old corpus keeps serving
+        and the error is raised: ReloadInProgressError for a concurrent
+        reload (rejected deterministically, never queued), otherwise
+        ReloadRejectedError with the problem list.
+
+        In-flight requests finish under the epoch they were admitted
+        with; the result cache is fenced by fingerprint, so a pre-swap
+        verdict can never answer a post-swap request."""
+        from licensee_tpu.serve import reload as reload_mod
+
+        if getattr(self.classifier, "corpus", None) is None:
+            raise reload_mod.ReloadRejectedError(
+                [f"mode {self.mode!r} is host-only; there is no corpus "
+                 "to reload"]
+            )
+        if not self._reload_lock.acquire(blocking=False):
+            with self._lock:
+                self._counters["reload_rejected"] += 1
+            raise reload_mod.ReloadInProgressError(
+                "a reload is already in progress"
+            )
+        try:
+            t0 = time.perf_counter()
+            try:
+                new_clf = reload_mod.build_classifier_like(
+                    self.classifier, source, method=self._method_arg
+                )
+                problems = reload_mod.validate_classifier(new_clf)
+            except reload_mod.ReloadError:
+                with self._lock:
+                    self._counters["reload_failed"] += 1
+                raise
+            if problems:
+                with self._lock:
+                    self._counters["reload_failed"] += 1
+                raise reload_mod.ReloadRejectedError(problems)
+            new_fp = reload_mod.corpus_fingerprint(new_clf.corpus)
+            with self._cond:
+                if self._closed:
+                    raise BatcherClosedError(
+                        "batcher closed during reload"
+                    )
+                old_fp = self._active[1]
+                self._active = (new_clf, new_fp)
+                self._seen_fps.add(new_fp)
+                self._corpus_source = source
+                self._counters["reloads"] += 1
+            return {
+                "ok": True,
+                "fingerprint": new_fp,
+                "previous": old_fp,
+                "unchanged": new_fp == old_fp,
+                "source": source,
+                "templates": new_clf.corpus.n_templates,
+                "elapsed_s": round(time.perf_counter() - t0, 3),
+            }
+        finally:
+            self._reload_lock.release()
+
     # -- admission --
 
     def submit(
@@ -415,8 +553,14 @@ class MicroBatcher:
             else str(content).encode("utf-8", errors="ignore")
         )
         filename = os.path.basename(filename) if filename else None
+        # ONE epoch snapshot per request: this classifier featurizes
+        # AND scores it, and this fingerprint fences its cache key — a
+        # reload swapping the active epoch mid-admission cannot split a
+        # request across two corpora
+        with self._lock:
+            clf, corpus_fp = self._active
         route = (
-            self.classifier.route_for(filename)
+            clf.route_for(filename)
             if self.mode == "auto"
             else self.mode
         )
@@ -426,6 +570,8 @@ class MicroBatcher:
             route=route,
             request_id=request_id,
             created=t0,
+            clf=clf,
+            corpus_fp=corpus_fp,
         )
         # trace minted (or adopted) at admission: its ID follows the
         # request through every span below and is echoed on the response
@@ -443,7 +589,11 @@ class MicroBatcher:
             with self._lock:
                 self._counters["unrouted"] += 1
             return self._finish_local(req, UNROUTED, t0, "unrouted")
-        key = content_key(route, filename, raw)
+        # the cache key is FENCED by corpus fingerprint: a verdict
+        # computed under one corpus can never answer a request admitted
+        # under another, so a reload invalidates the whole pre-swap
+        # cache by construction (stale entries age out via LRU)
+        key = (corpus_fp, content_key(route, filename, raw))
         t_probe = time.perf_counter()
         cached = self.cache.get(key)
         dt_probe = time.perf_counter() - t_probe
@@ -466,7 +616,7 @@ class MicroBatcher:
                 return req
         t_feat = time.perf_counter()
         prepared = featurize_request(
-            self.classifier, raw, filename,
+            clf, raw, filename,
             route if self.mode == "auto" else None,
         )
         dt_feat = time.perf_counter() - t_feat
@@ -608,53 +758,20 @@ class MicroBatcher:
             if alive:
                 live.append(req)
         if live:
-            group = [r.prepared for r in live]
-            n = sum(len(p.todo) for p in group)
-            bucket = self.bucket_for(n)
-            clf = self.classifier
-            device_err = None
-            try:
-                merged = clf.merge_prepared(group)
-                outs = clf.dispatch_chunks(merged, pad_to=bucket)
-                clf.finish_chunks(merged, outs, self.threshold)
-                clf.scatter_merged(group, merged)
-                for req in live:
-                    req.result = req.prepared.results[0]
-            except Exception as exc:  # noqa: BLE001 — device failure containment
-                device_err = exc
-                with self._lock:
-                    self._counters["fallbacks"] += len(live)
-            dt_device = time.perf_counter() - t0
+            # one device batch PER CLASSIFIER EPOCH: rows admitted
+            # before a corpus reload were featurized under the old
+            # vocab and must score against the old matrix; rows after,
+            # the new.  In steady state there is exactly one group —
+            # the partition costs a dict build, not a dispatch.
+            by_clf: dict[int, list[ServeRequest]] = {}
             for req in live:
-                if req.trace is not None:
-                    # the batch's device attempt, shared by every rider
-                    req.trace.add_span(
-                        "device", dt_device, t0=t0,
-                        note=(
-                            f"error: {device_err}" if device_err is not None
-                            else f"bucket={bucket} rows={n}"
-                        ),
-                    )
-            if device_err is not None:
-                for req in live:
-                    t_fb = time.perf_counter()
-                    req.result = self._scalar_fallback(req)
-                    if req.trace is not None:
-                        req.trace.add_span(
-                            "fallback",
-                            time.perf_counter() - t_fb,
-                            t0=t_fb,
-                        )
+                by_clf.setdefault(id(req.clf), []).append(req)
+            for grp in by_clf.values():
+                self._score_group(grp, t0)
             dt = time.perf_counter() - t0
             self.stats_stages.record("device", dt)
             with self._lock:
-                self._counters["device_batches"] += 1
-                self._counters["device_rows"] += n
-                self._counters["padded_rows"] += bucket - n
                 self._flush_reasons[reason] += 1
-                self._bucket_counts[bucket] = (
-                    self._bucket_counts.get(bucket, 0) + 1
-                )
                 self._batch_ewma = (
                     dt
                     if self._batch_ewma is None
@@ -699,29 +816,109 @@ class MicroBatcher:
                     self.obs.tracer.finish(member.trace, status)
                 member.done.set()
 
-    def _scalar_fallback(self, req: ServeRequest) -> BlobResult:
-        """Reference-semantics host path for one Dice-bound request —
-        the graceful-degradation answer when the device dispatch
-        raised.  Copyright/Exact already had their turn at admission,
-        so only Dice (and the readme Reference fallback) run here.
-        Scores come from the scalar matcher over the vendored pool, the
-        same chain `licensee-tpu detect` walks."""
-        from licensee_tpu.matchers import Dice
-        from licensee_tpu.project_files.license_file import LicenseFile
+    def _score_group(self, live: list[ServeRequest], t0: float) -> int:
+        """Merge, dispatch, and finish one classifier-epoch group of a
+        flush (every member shares ``req.clf``).  Device failure falls
+        back to the host scalar chain per request, same as before."""
+        group = [r.prepared for r in live]
+        n = sum(len(p.todo) for p in group)
+        bucket = self.bucket_for(n)
+        clf = live[0].clf
+        device_err = None
+        try:
+            merged = clf.merge_prepared(group)
+            outs = clf.dispatch_chunks(merged, pad_to=bucket)
+            clf.finish_chunks(merged, outs, self.threshold)
+            clf.scatter_merged(group, merged)
+            for req in live:
+                req.result = req.prepared.results[0]
+        except Exception as exc:  # noqa: BLE001 — device failure containment
+            device_err = exc
+            with self._lock:
+                self._counters["fallbacks"] += len(live)
+        dt_device = time.perf_counter() - t0
+        for req in live:
+            if req.trace is not None:
+                # the batch's device attempt, shared by every rider
+                req.trace.add_span(
+                    "device", dt_device, t0=t0,
+                    note=(
+                        f"error: {device_err}" if device_err is not None
+                        else f"bucket={bucket} rows={n}"
+                    ),
+                )
+        if device_err is not None:
+            for req in live:
+                t_fb = time.perf_counter()
+                req.result = self._scalar_fallback(req)
+                if req.trace is not None:
+                    req.trace.add_span(
+                        "fallback",
+                        time.perf_counter() - t_fb,
+                        t0=t_fb,
+                    )
+        with self._lock:
+            self._counters["device_batches"] += 1
+            self._counters["device_rows"] += n
+            self._counters["padded_rows"] += bucket - n
+            self._bucket_counts[bucket] = (
+                self._bucket_counts.get(bucket, 0) + 1
+            )
+        return n
 
+    def _scalar_fallback(self, req: ServeRequest) -> BlobResult:
+        """Host path for one Dice-bound request — the graceful-
+        degradation answer when the device dispatch raised.
+        Copyright/Exact already had their turn at admission, so only
+        Dice (and the readme Reference fallback) run here.
+
+        Scoring runs the host numpy re-derivation of the device
+        algebra (serve/reload.py ``host_best``) over the request's own
+        prepared feature row, against the corpus of the ADMITTED
+        epoch (``req.clf``) — the verdict a reloaded worker hands out
+        must come from the corpus its fingerprint names, never from
+        the vendored pool the scalar text matcher iterates.  The
+        scalar `licensee-tpu detect` chain remains only for the
+        corpus-free case (no fingerprint is stamped there)."""
         section = None
         if req.prepared is not None and req.prepared.sections:
             section = req.prepared.sections[0]
-        text = section if section is not None else req.content
         try:
-            ranked = Dice(
-                LicenseFile(text, req.filename or "LICENSE")
-            ).matches_by_similarity
-            if ranked and ranked[0][1] >= self.threshold:
-                lic, sim = ranked[0]
-                return BlobResult(lic.key, "dice", float(sim))
+            clf = req.clf or self.classifier
+            corpus = getattr(clf, "corpus", None)
+            prepared = req.prepared
+            if corpus is not None and prepared is not None and len(
+                getattr(prepared, "bits", ())
+            ):
+                from licensee_tpu.serve.reload import host_best
+
+                ((idx, num, den),) = host_best(
+                    corpus,
+                    prepared.bits[:1],
+                    prepared.n_words[:1],
+                    prepared.lengths[:1],
+                    prepared.cc_fp[:1],
+                )
+                score = (num * 200.0) / den if den > 0 else 0.0
+                if num >= 0 and score >= self.threshold:
+                    return BlobResult(
+                        corpus.keys[idx], "dice", float(score), num, den
+                    )
+            else:
+                from licensee_tpu.matchers import Dice
+                from licensee_tpu.project_files.license_file import (
+                    LicenseFile,
+                )
+
+                text = section if section is not None else req.content
+                ranked = Dice(
+                    LicenseFile(text, req.filename or "LICENSE")
+                ).matches_by_similarity
+                if ranked and ranked[0][1] >= self.threshold:
+                    lic, sim = ranked[0]
+                    return BlobResult(lic.key, "dice", float(sim))
             if section is not None:
-                lic = self.classifier._reference_match(section)
+                lic = clf._reference_match(section)
                 if lic is not None:
                     return BlobResult(lic.key, "reference", 90.0)
             return BlobResult(None, None, 0.0)
@@ -745,9 +942,21 @@ class MicroBatcher:
             bucket_counts = {
                 str(k): v for k, v in sorted(self._bucket_counts.items())
             }
-        dispatch = getattr(self.classifier, "dispatch_stats", None)
+            active_clf, active_fp = self._active
+            corpus_source = self._corpus_source
+        dispatch = getattr(active_clf, "dispatch_stats", None)
         return {
             "uptime_s": self.obs.uptime_s(),
+            "corpus": {
+                "fingerprint": active_fp,
+                "source": corpus_source,
+                "templates": (
+                    active_clf.corpus.n_templates
+                    if getattr(active_clf, "corpus", None) is not None
+                    else None
+                ),
+                "reloads": counters["reloads"],
+            },
             "scheduler": {
                 **counters,
                 "flush": flush,

@@ -16,6 +16,9 @@ Request lines:
   {"op": "stats", "id": ...}         # dump scheduler/cache/latency JSON
   {"op": "stats", "format": "prometheus", "id": ...}  # text exposition
   {"op": "trace", "n": 20, "id": ...}  # recent retained traces
+  {"op": "reload", "corpus": "...", "id": ...}  # blue/green corpus swap
+                                     # (vendored | spdx | SPDX dir |
+                                     # artifact path; validated, atomic)
 Response lines:
   {"id": ..., "key": ..., "matcher": ..., "confidence": ...,
    "cached": ..., "trace": "16-hex trace id"}
@@ -44,6 +47,7 @@ import stat
 import threading
 from collections import deque
 
+from licensee_tpu.corpus.artifact import short_fingerprint
 from licensee_tpu.serve.scheduler import MicroBatcher, QueueFullError
 
 # an upstream hop's trace ID (the fleet router's): 16 lowercase hex
@@ -57,7 +61,54 @@ def _render_result(req) -> dict:
     row["cached"] = req.cached
     if req.trace_id is not None:
         row["trace"] = req.trace_id
+    if req.corpus_fp is not None:
+        # the corpus epoch that produced this verdict (display form) —
+        # the attribution handle the reload drills gate on: every
+        # answer names exactly one fingerprint, old or new
+        row["corpus"] = short_fingerprint(req.corpus_fp)
     return row
+
+
+class _ReloadHandle:
+    """One in-flight reload verb: the swap runs on its own thread (a
+    compile takes seconds and must not block this session's reader from
+    admitting traffic), the writer waits on ``done`` like any request."""
+
+    def __init__(self, batcher, rid, source: str):
+        self.row: dict = {"id": rid, "error": "internal_error: no result"}
+        self.done = threading.Event()
+        self._batcher = batcher
+        self._rid = rid
+        self._source = source
+        threading.Thread(
+            target=self._run, name="serve-reload", daemon=True
+        ).start()
+
+    def _run(self) -> None:
+        from licensee_tpu.serve.reload import (
+            ReloadInProgressError,
+            ReloadRejectedError,
+        )
+
+        try:
+            self.row = {
+                "id": self._rid,
+                "reload": self._batcher.reload_corpus(self._source),
+            }
+        except ReloadInProgressError:
+            self.row = {"id": self._rid, "error": "reload_in_progress"}
+        except ReloadRejectedError as exc:
+            self.row = {
+                "id": self._rid,
+                "error": f"reload_failed: {exc}",
+                "problems": exc.problems,
+            }
+        except Exception as exc:  # noqa: BLE001 — session containment
+            self.row = {
+                "id": self._rid, "error": f"internal_error: {exc}"
+            }
+        finally:
+            self.done.set()
 
 
 class _Session:
@@ -93,6 +144,9 @@ class _Session:
             if kind == "req":
                 payload.done.wait()
                 row = _render_result(payload)
+            elif kind == "reload":
+                payload.done.wait()
+                row = payload.row
             elif kind == "stats":
                 # snapshot at WRITE time, not parse time: every earlier
                 # request in the stream has answered by now, so the verb
@@ -150,6 +204,18 @@ class _Session:
                 )
                 return
             self._emit("trace", (rid, n))
+            return
+        if op == "reload":
+            source = msg.get("corpus")
+            if not isinstance(source, str) or not source:
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: reload needs a 'corpus' "
+                     "source string"},
+                )
+                return
+            self._emit("reload", _ReloadHandle(self.batcher, rid, source))
             return
         if op is not None:
             self._emit(
@@ -524,3 +590,141 @@ def selftest(verbose: bool = True) -> int:
 
 def _raise_injected(*args, **kwargs):
     raise RuntimeError("selftest: injected device failure")
+
+
+def selftest_reload(verbose: bool = True) -> int:
+    """End-to-end smoke of the corpus hot-swap path on this host (the
+    `licensee-tpu serve --selftest-reload` CI gate): build a corpus
+    artifact, serve live traffic from the vendored corpus, reload to
+    the artifact UNDER that traffic, and assert
+
+    * the reload verb answers ok and the fingerprint flipped;
+    * zero traffic errors across the swap, every response carrying
+      exactly one known fingerprint (old or new, never anything else);
+    * post-swap answers are re-validated: the first post-swap repeat of
+      a pre-swap-cached blob is NOT served from cache (the fingerprint
+      fence), yet still classifies correctly under the new corpus;
+    * a corrupt artifact and an unloadable source are both refused
+      while the worker keeps serving, fingerprint unchanged.
+    """
+    import re
+    import tempfile
+    import time
+
+    from licensee_tpu.corpus.artifact import write_artifact
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.corpus.spdx import spdx_corpus
+
+    problems: list[str] = []
+    body = re.sub(
+        r"\[(\w+)\]", "example", License.find("mit").content or ""
+    )
+    with tempfile.TemporaryDirectory(prefix="licensee-reload-") as tmp:
+        artifact = os.path.join(tmp, "spdx.corpus.npz")
+        write_artifact(artifact, spdx_corpus(None), source="spdx")
+        corrupt = os.path.join(tmp, "corrupt.corpus.npz")
+        with open(corrupt, "wb") as f:
+            f.write(b"not a corpus artifact at all")
+        stop = threading.Event()
+        rows: list = []
+        errors: list = []
+
+        with MicroBatcher(
+            max_batch=32, max_delay_ms=5.0, corpus_source="vendored",
+        ) as batcher:
+            fp_old = batcher.corpus_fingerprint
+
+            def traffic() -> None:
+                i = 0
+                while not stop.is_set():
+                    blob = f"{body}\nzqswap{i} zqdrill{i % 7}\n"
+                    try:
+                        rows.append(batcher.submit(blob, "LICENSE"))
+                    except Exception as exc:  # noqa: BLE001 — the gate counts these
+                        errors.append(str(exc))
+                    i += 1
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            time.sleep(0.1)  # real in-flight load during the swap
+            # -- cache-fence seed: classify + repeat (cached) pre-swap --
+            seed = body + "\nzqfence zqfence2\n"
+            first = batcher.classify(seed, "LICENSE")
+            again = batcher.submit(seed, "LICENSE")
+            again_res = again.wait(60.0)
+            if (first.key, again_res.key) != ("mit", "mit"):
+                problems.append(
+                    f"pre-swap verdicts: {first.key} / {again_res.key}"
+                )
+            if not again.cached:
+                problems.append("pre-swap repeat was not served cached")
+            # -- the swap, under traffic --
+            out = batcher.reload_corpus(artifact)
+            fp_new = out["fingerprint"]
+            if not out.get("ok") or fp_new == fp_old:
+                problems.append(f"reload did not flip: {out}")
+            if batcher.corpus_fingerprint != fp_new:
+                problems.append("active fingerprint is not the new one")
+            # -- post-swap: the pre-swap cached verdict must NOT serve --
+            post = batcher.submit(seed, "LICENSE")
+            post_res = post.wait(60.0)
+            if post.cached:
+                problems.append(
+                    "post-swap repeat served a pre-swap cached verdict"
+                )
+            if post_res.key != "mit":
+                problems.append(f"post-swap verdict: {post_res.key!r}")
+            if post.corpus_fp != fp_new:
+                problems.append(
+                    f"post-swap answer not attributed to the new corpus: "
+                    f"{post.corpus_fp}"
+                )
+            # -- refusal paths: corrupt artifact, unloadable source --
+            from licensee_tpu.serve.reload import ReloadRejectedError
+
+            for bad in (corrupt, os.path.join(tmp, "missing.npz")):
+                try:
+                    batcher.reload_corpus(bad)
+                    problems.append(f"reload of {bad!r} was not refused")
+                except ReloadRejectedError:
+                    pass
+            if batcher.corpus_fingerprint != fp_new:
+                problems.append("refused reload changed the fingerprint")
+            check = batcher.classify(body + "\nzqafter zqbad\n", "LICENSE")
+            if check.key != "mit":
+                problems.append(f"post-refusal verdict: {check.key}")
+            stop.set()
+            t.join(timeout=10.0)
+            # -- the traffic gate: zero errors, single-fingerprint rows --
+            unfinished = 0
+            for req in rows:
+                if not req.done.wait(60.0):
+                    unfinished += 1
+                    continue
+                if req.result is not None and req.result.error:
+                    errors.append(req.result.error)
+                if req.corpus_fp not in (fp_old, fp_new):
+                    problems.append(
+                        f"row attributed to unknown corpus {req.corpus_fp}"
+                    )
+                elif req.result is not None and req.result.key != "mit":
+                    errors.append(f"wrong verdict {req.result.key}")
+            if unfinished:
+                problems.append(f"{unfinished} requests never finished")
+            if errors:
+                problems.append(
+                    f"{len(errors)} traffic errors, e.g. {errors[:3]}"
+                )
+            stats = batcher.stats()
+            if stats["scheduler"].get("reloads") != 1:
+                problems.append(f"reload counter: {stats['scheduler']}")
+            if stats["corpus"].get("fingerprint") != fp_new:
+                problems.append(f"stats corpus: {stats['corpus']}")
+    if verbose:
+        print(json.dumps({
+            "reload_selftest": "ok" if not problems else "FAIL",
+            "problems": problems,
+            "requests": len(rows),
+        }))
+    return 0 if not problems else 1

@@ -127,6 +127,12 @@ class _TrackedLock:
     def locked(self):
         return self._inner.locked()
 
+    def _at_fork_reinit(self):
+        # concurrent.futures.thread registers this with os.register_at_fork
+        # at IMPORT time — a wrapper without it breaks any module whose
+        # first import happens inside a sanitized test
+        self._inner._at_fork_reinit()
+
     def __enter__(self):
         self.acquire()
         return self
@@ -194,6 +200,10 @@ class _TrackedRLock:
 
     def _is_owned(self):
         return self._inner._is_owned()
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+        self._tls = threading.local()
 
     def __repr__(self):
         return f"<TrackedRLock {self.site}>"

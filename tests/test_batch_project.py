@@ -512,6 +512,61 @@ def test_resume_fingerprint_pins_template_content(tmp_path):
     assert out.read_text() == before
 
 
+def test_resume_corpus_mismatch_names_both_fingerprints(tmp_path):
+    """The refusal must NAME the evidence: both corpora's content
+    fingerprints and the --corpus sources that produced them, not an
+    opaque 'corpus changed'."""
+    import json
+
+    from licensee_tpu.corpus.compiler import CompiledCorpus
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.kernels.batch import BatchClassifier
+    from licensee_tpu.projects.batch_project import ResumeConfigError
+
+    def project_for(keys, source):
+        corpus = CompiledCorpus.compile(
+            [License.find(k) for k in keys]
+        )
+        clf = BatchClassifier(
+            corpus=corpus, pad_batch_to=2, mesh=None, device=False
+        )
+        return BatchProject(
+            [], batch_size=2, classifier=clf, corpus_source=source,
+            process_index=0, process_count=1, tracer=False,
+        )
+
+    writer = project_for(["mit", "apache-2.0"], "corpusA")
+    out = tmp_path / "out.jsonl"
+    out.write_text('{"path": "x"}\n')
+    sidecar = tmp_path / "out.jsonl.meta.json"
+    sidecar.write_text(json.dumps(writer._run_config()) + "\n")
+    writer_sha = writer._run_config()["corpus"]["content_sha1"]
+
+    # the same corpus under a different source label still resumes:
+    # corpus_source is descriptive, the fingerprints decide
+    relabeled = project_for(["mit", "apache-2.0"], "corpusA-moved")
+    relabeled._check_resume_config(str(out), resume=True)
+
+    reader = project_for(["mit", "isc"], "corpusB")
+    reader_sha = reader._run_config()["corpus"]["content_sha1"]
+    with pytest.raises(ResumeConfigError) as excinfo:
+        reader._check_resume_config(str(out), resume=True)
+    message = str(excinfo.value)
+    assert "corpus fingerprint mismatch" in message
+    assert writer_sha in message and reader_sha in message
+    assert "corpusA" in message and "corpusB" in message
+
+    # an OLD sidecar (no corpus_source key) still gets the fingerprint
+    # detail, with the source reported as unknown
+    prior = json.loads(sidecar.read_text())
+    del prior["corpus_source"]
+    sidecar.write_text(json.dumps(prior) + "\n")
+    with pytest.raises(ResumeConfigError) as excinfo:
+        reader._check_resume_config(str(out), resume=True)
+    assert "unknown source" in str(excinfo.value)
+    assert writer_sha in str(excinfo.value)
+
+
 def test_writer_thread_failure_propagates_without_deadlock(
     tmp_path, monkeypatch
 ):

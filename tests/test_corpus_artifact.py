@@ -1,0 +1,182 @@
+"""Versioned corpus artifacts (licensee_tpu/corpus/artifact.py):
+canonical fingerprinting, bundle round-trips, integrity verification,
+and the shared source resolver behind --corpus and the reload verbs."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from licensee_tpu.corpus.artifact import (
+    ArtifactError,
+    build_manifest,
+    corpus_fingerprint,
+    load_artifact,
+    resolve_corpus,
+    short_fingerprint,
+    write_artifact,
+)
+from licensee_tpu.corpus.compiler import CompiledCorpus
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def small_corpus():
+    from licensee_tpu.corpus.license import License
+
+    pool = [License.find("mit"), License.find("apache-2.0")]
+    return CompiledCorpus.compile(pool)
+
+
+@pytest.fixture(scope="module")
+def other_corpus():
+    from licensee_tpu.corpus.license import License
+
+    pool = [License.find("mit"), License.find("isc")]
+    return CompiledCorpus.compile(pool)
+
+
+def test_fingerprint_is_stable_and_content_sensitive(
+    small_corpus, other_corpus
+):
+    fp = corpus_fingerprint(small_corpus)
+    assert len(fp) == 64 and int(fp, 16) >= 0
+    assert corpus_fingerprint(small_corpus) == fp  # memoized, stable
+    assert corpus_fingerprint(other_corpus) != fp
+    assert short_fingerprint(fp) == fp[:12]
+    assert short_fingerprint(None) is None
+
+
+def test_fingerprint_changes_when_the_matrix_changes(small_corpus):
+    from dataclasses import replace
+
+    bits = small_corpus.bits.copy()
+    bits[0, 0] ^= 1  # one flipped bit anywhere in the matrix
+    tampered = replace(small_corpus, bits=bits)
+    assert corpus_fingerprint(tampered) != corpus_fingerprint(small_corpus)
+
+
+def test_artifact_roundtrip_preserves_everything(small_corpus, tmp_path):
+    path = str(tmp_path / "small.corpus.npz")
+    manifest = write_artifact(path, small_corpus, source="unit-test")
+    assert manifest["fingerprint"] == corpus_fingerprint(small_corpus)
+    assert manifest["templates"] == small_corpus.n_templates
+    assert manifest["source"] == "unit-test"
+
+    loaded, loaded_manifest = load_artifact(path)
+    assert loaded_manifest == manifest
+    assert loaded.keys == small_corpus.keys
+    assert loaded.vocab == small_corpus.vocab
+    assert loaded.content_hashes == small_corpus.content_hashes
+    assert loaded.exact_sets == small_corpus.exact_sets
+    for name in ("bits", "n_wf", "n_fieldset", "field_count",
+                 "alt_count", "length", "cc_flag"):
+        assert np.array_equal(
+            getattr(loaded, name), getattr(small_corpus, name)
+        ), name
+    # the load is proven, not assumed: fingerprints agree
+    assert corpus_fingerprint(loaded) == manifest["fingerprint"]
+
+
+def test_artifact_refuses_corruption(small_corpus, tmp_path):
+    path = str(tmp_path / "a.corpus.npz")
+    write_artifact(path, small_corpus)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\x00" * 16)
+    with pytest.raises(ArtifactError):
+        load_artifact(path)
+
+
+def test_artifact_refuses_garbage_truncation_and_wrong_format(tmp_path):
+    garbage = tmp_path / "g.npz"
+    garbage.write_bytes(b"not a zip at all")
+    with pytest.raises(ArtifactError, match="cannot read"):
+        load_artifact(str(garbage))
+    plain = tmp_path / "plain.npz"
+    np.savez(plain, foo=np.zeros(3))
+    with pytest.raises(ArtifactError, match="not a corpus artifact"):
+        load_artifact(str(plain))
+
+
+def test_manifest_fingerprint_mismatch_fails_closed(
+    small_corpus, other_corpus, tmp_path
+):
+    """A manifest lying about its payload must be refused: rebuild the
+    bundle with one array swapped and the OLD manifest kept."""
+    path = str(tmp_path / "lie.corpus.npz")
+    write_artifact(path, small_corpus)
+    with np.load(path, allow_pickle=False) as npz:
+        data = {name: npz[name] for name in npz.files}
+    meta = json.loads(bytes(data["meta"]).decode("utf-8"))
+    # splice other_corpus's template constants under small_corpus's
+    # manifest (shapes agree: both pools have 2 templates)
+    data["n_wf"] = other_corpus.n_wf
+    np.savez(path, **data)
+    assert meta["manifest"]["fingerprint"] == corpus_fingerprint(
+        small_corpus
+    )
+    with pytest.raises(ArtifactError, match="fingerprint"):
+        load_artifact(path)
+
+
+def test_resolve_corpus_sources(small_corpus, tmp_path):
+    art = str(tmp_path / "r.corpus.npz")
+    write_artifact(art, small_corpus, source="unit-test")
+    corpus, fp, manifest = resolve_corpus(art)
+    assert fp == corpus_fingerprint(small_corpus)
+    assert manifest["source"] == "unit-test"
+    corpus_v, fp_v, manifest_v = resolve_corpus("vendored")
+    assert manifest_v is None
+    assert fp_v == corpus_fingerprint(corpus_v)
+    with pytest.raises(ArtifactError, match="cannot load corpus"):
+        resolve_corpus(str(tmp_path / "nope"))
+
+
+def test_build_manifest_shape(small_corpus):
+    manifest = build_manifest(small_corpus, source="s")
+    assert manifest["format"] == "licensee-tpu-corpus"
+    assert manifest["format_version"] == 1
+    assert manifest["vocab"] == small_corpus.vocab_size
+    assert manifest["lanes"] == small_corpus.n_lanes
+
+
+def test_corpus_build_cli_roundtrip(tmp_path):
+    """The corpus-build verb: build an artifact from the vendored pool,
+    inspect it, and refuse a corrupt one — all through the real CLI."""
+    art = str(tmp_path / "vendored.corpus.npz")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT}
+    built = subprocess.run(
+        [sys.executable, "-m", "licensee_tpu.cli.main", "corpus-build",
+         "--corpus", "vendored", "--output", art],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert built.returncode == 0, built.stderr
+    manifest = json.loads(built.stdout)
+    assert manifest["templates"] > 0
+
+    inspected = subprocess.run(
+        [sys.executable, "-m", "licensee_tpu.cli.main", "corpus-build",
+         "--inspect", art],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert inspected.returncode == 0, inspected.stderr
+    assert json.loads(inspected.stdout) == manifest
+
+    with open(art, "r+b") as f:
+        f.seek(os.path.getsize(art) // 2)
+        f.write(b"\x00" * 8)
+    broken = subprocess.run(
+        [sys.executable, "-m", "licensee_tpu.cli.main", "corpus-build",
+         "--inspect", art],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert broken.returncode == 1
+    assert "error" in broken.stderr

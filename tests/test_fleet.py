@@ -536,3 +536,231 @@ def test_fleet_selftest_stub_mode_passes():
     from licensee_tpu.fleet.selftest import selftest
 
     assert selftest(verbose=False, stub=True) == 0
+
+
+# -- corpus lifecycle: rolling reload, rollback, argv patching --
+
+
+def _reload_supervisor(tmp_path, extra_for=None):
+    """A 2-stub supervisor for the reload drills; ``extra_for`` maps a
+    worker name to extra stub argv (e.g. a --reload-deny script)."""
+    sockets = {
+        "w0": str(tmp_path / "w0.sock"),
+        "w1": str(tmp_path / "w1.sock"),
+    }
+    extra_for = extra_for or {}
+
+    def argv(name, sock):
+        return stub_argv(
+            sock, name, "--fingerprint", "fp-old",
+            *extra_for.get(name, ()),
+        )
+
+    return Supervisor(
+        sockets,
+        argv_for=argv,
+        env_for=lambda name, chips: dict(STUB_ENV),
+        probe_interval_s=0.05, backoff_base_s=0.1, backoff_max_s=1.0,
+        startup_grace_s=15.0,
+    )
+
+
+def _stub_patch(argv, corpus):
+    out = list(argv)
+    out[out.index("--fingerprint") + 1] = corpus
+    return out
+
+
+def _worker_fps(supervisor):
+    return {
+        name: ((supervisor.probe(name) or {}).get("corpus") or {}).get(
+            "fingerprint"
+        )
+        for name in supervisor.workers
+    }
+
+
+def test_reload_fleet_rolls_every_worker_and_patches_argv(tmp_path):
+    with _reload_supervisor(tmp_path) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        out = supervisor.reload_fleet(
+            "fp-new", timeout_s=10.0, health_timeout_s=10.0,
+            argv_patch=_stub_patch,
+        )
+        assert out["ok"] and not out["rolled_back"]
+        assert out["fingerprint"] == "fp-new"
+        assert _worker_fps(supervisor) == {"w0": "fp-new", "w1": "fp-new"}
+        # a crash-restarted worker must rejoin on the ROLLED corpus,
+        # not its launch-time one: the roll patched its respawn argv
+        first_pid = supervisor.workers["w0"].pid
+        faults.kill(first_pid)
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            if (
+                supervisor.workers["w0"].pid not in (None, first_pid)
+                and supervisor.probe("w0") is not None
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"w0 never respawned: {supervisor.status()}")
+        assert _worker_fps(supervisor)["w0"] == "fp-new"
+
+
+def test_reload_fleet_rolls_back_on_mid_roll_refusal(tmp_path):
+    # w1 refuses any "deny-*" corpus (the injected validation failure):
+    # w0 swaps first, w1 refuses, and the budget-exceeded roll must
+    # return w0 to the old corpus — fleet healthy on the OLD fingerprint
+    with _reload_supervisor(
+        tmp_path, extra_for={"w1": ("--reload-deny", "deny-")}
+    ) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        out = supervisor.reload_fleet(
+            "deny-fp", timeout_s=10.0, health_timeout_s=10.0,
+            argv_patch=_stub_patch,
+        )
+        assert not out["ok"]
+        assert out["rolled_back"]
+        assert out["fingerprint"] is None
+        assert out["workers"]["w0"]["ok"]
+        assert out["workers"]["w0"]["rolled_back"]
+        assert not out["workers"]["w1"]["ok"]
+        assert _worker_fps(supervisor) == {"w0": "fp-old", "w1": "fp-old"}
+        # the rollback also restored w0's respawn argv
+        assert "deny-fp" not in supervisor.workers["w0"].argv
+
+
+def test_reload_fleet_corrupt_source_fails_closed(tmp_path):
+    with _reload_supervisor(tmp_path) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        out = supervisor.reload_fleet(
+            "corrupt:artifact", timeout_s=10.0, health_timeout_s=10.0,
+            argv_patch=_stub_patch,
+        )
+        assert not out["ok"] and not out["rolled_back"]
+        assert "injected refusal" in out["workers"]["w0"]["error"]
+        assert _worker_fps(supervisor) == {"w0": "fp-old", "w1": "fp-old"}
+
+
+def test_reload_fleet_dead_worker_mid_swap_rolls_back(tmp_path):
+    # SIGKILL w0 while it sleeps inside a slow reload verb: the roll
+    # fails on the transport, nothing was swapped, the supervisor
+    # respawns w0 on the old corpus
+    with _reload_supervisor(tmp_path) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        results = {}
+
+        def roll():
+            results["out"] = supervisor.reload_fleet(
+                "slow:1500:fp-mid", timeout_s=10.0,
+                health_timeout_s=10.0, argv_patch=_stub_patch,
+            )
+
+        t = threading.Thread(target=roll)
+        t.start()
+        time.sleep(0.4)  # w0 is sleeping mid-swap
+        faults.kill(supervisor.workers["w0"].pid)
+        t.join(timeout=30.0)
+        assert not results["out"]["ok"]
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            if supervisor.probe("w0") is not None:
+                break
+            time.sleep(0.05)
+        assert _worker_fps(supervisor) == {"w0": "fp-old", "w1": "fp-old"}
+
+
+def test_reload_fleet_concurrent_roll_refused(tmp_path):
+    # the fleet-level mutex: a second reload_fleet while one is rolling
+    # is refused deterministically — two interleaved rolls would leave
+    # the fleet on mixed fingerprints with clobbered respawn argv
+    with _reload_supervisor(tmp_path) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        results = {}
+
+        def roll():
+            results["out"] = supervisor.reload_fleet(
+                "slow:800:fp-a", timeout_s=10.0,
+                health_timeout_s=10.0, argv_patch=_stub_patch,
+            )
+
+        t = threading.Thread(target=roll)
+        t.start()
+        time.sleep(0.3)  # w0 is mid-swap inside the first roll
+        second = supervisor.reload_fleet(
+            "fp-b", timeout_s=10.0, health_timeout_s=10.0,
+            argv_patch=_stub_patch,
+        )
+        t.join(timeout=30.0)
+        assert second == {
+            "ok": False,
+            "corpus": "fp-b",
+            "fingerprint": None,
+            "rolled_back": False,
+            "error": "fleet_reload_in_progress",
+            "workers": {},
+        }
+        assert results["out"]["ok"]
+        assert _worker_fps(supervisor) == {"w0": "fp-a", "w1": "fp-a"}
+
+
+def test_stub_concurrent_reload_rejected(stub_fleet):
+    # the worker-side guarantee satellite: a second reload while one is
+    # mid-swap answers reload_in_progress, deterministically
+    sock = stub_fleet.spawn("w0", "--fingerprint", "fp-old")
+    rows = []
+
+    def slow():
+        rows.append(oneshot(
+            sock, {"op": "reload", "corpus": "slow:800:fp-a"}, 10.0
+        ))
+
+    t = threading.Thread(target=slow)
+    t.start()
+    time.sleep(0.2)
+    fast = oneshot(sock, {"op": "reload", "corpus": "fp-b"}, 10.0)
+    t.join(timeout=15.0)
+    assert fast.get("error") == "reload_in_progress"
+    assert rows and rows[0]["reload"]["ok"]
+    stats = oneshot(sock, {"op": "stats"}, 5.0)["stats"]
+    assert stats["corpus"]["fingerprint"] == "fp-a"
+
+
+def test_front_socket_reload_verb_delegates_to_supervisor(tmp_path):
+    with _reload_supervisor(tmp_path) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        sockets = {
+            name: h.socket_path for name, h in supervisor.workers.items()
+        }
+        with Router(
+            sockets, supervisor=supervisor, probe_interval_s=0.05
+        ) as router:
+            front = str(tmp_path / "front.sock")
+            server = FrontServer(front, router)
+            st = threading.Thread(
+                target=server.serve_forever,
+                kwargs={"poll_interval": 0.05}, daemon=True,
+            )
+            st.start()
+            try:
+                row = oneshot(
+                    front, {"id": 9, "op": "reload", "corpus": "fp-front"},
+                    30.0,
+                )
+                assert row["reload"]["ok"], row
+                assert row["reload"]["fingerprint"] == "fp-front"
+                assert _worker_fps(supervisor) == {
+                    "w0": "fp-front", "w1": "fp-front"
+                }
+                bad = oneshot(front, {"id": 10, "op": "reload"}, 10.0)
+                assert "bad_request" in bad["error"]
+            finally:
+                server.shutdown()
+                server.server_close()
+                st.join(timeout=5.0)
+
+
+def test_reload_fleet_selftest_stub_mode_passes():
+    from licensee_tpu.fleet.selftest import selftest_reload
+
+    assert selftest_reload(verbose=False, stub=True) == 0

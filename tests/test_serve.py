@@ -26,6 +26,13 @@ from licensee_tpu.serve.server import (
 from licensee_tpu.serve.stats import LatencyStats
 from tests.conftest import fixture_contents
 
+# lock-order sanitizer across every serve test (PR 6 infrastructure,
+# previously fleet/stripes only): the corpus-reload path added a new
+# lock interaction with the scheduler (_reload_lock -> scheduler lock
+# -> cache lock), and an inversion anywhere in serve/ must fail here
+# before it deadlocks a live worker
+pytestmark = pytest.mark.usefixtures("lock_order_sanitizer")
+
 
 @pytest.fixture(scope="module")
 def clf():
@@ -918,3 +925,210 @@ def test_micro_batcher_wires_cache_bytes(clf):
         assert b.cache.max_bytes == 4096
         assert b.stats()["config"]["cache_bytes"] == 4096
         assert b.stats()["cache"]["max_bytes"] == 4096
+
+
+# -- corpus lifecycle: blue/green reload, cache fencing --
+
+
+@pytest.fixture(scope="module")
+def spdx_artifact(tmp_path_factory):
+    """A corpus artifact with a fingerprint distinct from vendored."""
+    from licensee_tpu.corpus.artifact import write_artifact
+    from licensee_tpu.corpus.spdx import spdx_corpus
+
+    path = str(tmp_path_factory.mktemp("corpus") / "spdx.corpus.npz")
+    write_artifact(path, spdx_corpus(None), source="spdx")
+    return path
+
+
+def test_reload_swaps_corpus_and_fences_cache(clf, mit_body, spdx_artifact):
+    """The satellite regression: a reload must never serve a pre-swap
+    cached verdict — the cache key is fenced by corpus fingerprint."""
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, corpus_source="vendored"
+    ) as b:
+        fp_old = b.corpus_fingerprint
+        assert fp_old
+        blob = dice_blob(mit_body, "reload")
+        first = b.classify(blob, "LICENSE")
+        assert first.key == "mit"
+        rq = b.submit(blob, "LICENSE")
+        rq.wait(60.0)
+        assert rq.cached  # pre-swap repeat serves from cache
+
+        out = b.reload_corpus(spdx_artifact)
+        assert out["ok"]
+        fp_new = out["fingerprint"]
+        assert fp_new != fp_old
+        assert out["previous"] == fp_old
+        assert b.corpus_fingerprint == fp_new
+        assert b.classifier.corpus.n_templates == 47
+
+        # the first post-swap repeat must RE-SCORE, not answer from the
+        # pre-swap cache...
+        post = b.submit(blob, "LICENSE")
+        res = post.wait(60.0)
+        assert not post.cached
+        assert res.key == "mit"  # ...and re-validate under the new corpus
+        assert post.corpus_fp == fp_new
+        # ...and the new epoch caches normally from then on
+        post2 = b.submit(blob, "LICENSE")
+        post2.wait(60.0)
+        assert post2.cached
+
+        stats = b.stats()
+        assert stats["scheduler"]["reloads"] == 1
+        assert stats["corpus"]["fingerprint"] == fp_new
+        assert stats["corpus"]["source"] == spdx_artifact
+        # the obs surface: the fingerprint gauge labels both epochs,
+        # 1 on the serving one, 0 on the retired one
+        exposition = b.prometheus()
+        assert (
+            f'serve_corpus_info{{fingerprint="{fp_new[:12]}"}} 1'
+            in exposition
+        )
+        assert (
+            f'serve_corpus_info{{fingerprint="{fp_old[:12]}"}} 0'
+            in exposition
+        )
+
+
+def test_scalar_fallback_scores_against_admitted_corpus(
+    clf, mit_body, spdx_artifact
+):
+    """A device failure AFTER a reload must fall back to the admitted
+    corpus epoch, not the vendored pool: the verdict must come from the
+    corpus the response's fingerprint names, at device-identical
+    confidence (the fallback runs the same score algebra on the host)."""
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,),
+        corpus_source="vendored",
+    ) as b:
+        fp_new = b.reload_corpus(spdx_artifact)["fingerprint"]
+        blob = dice_blob(mit_body, "fallback-epoch")
+        expected = b.classifier.classify_blobs([blob])[0]
+        assert (expected.key, expected.matcher) == ("mit", "dice")
+        new_clf = b.classifier
+        original = new_clf.dispatch_chunks
+
+        def broken(*args, **kwargs):
+            raise RuntimeError("injected device failure")
+
+        new_clf.dispatch_chunks = broken
+        try:
+            rq = b.submit(blob, "LICENSE")
+            res = rq.wait(60.0)
+        finally:
+            new_clf.dispatch_chunks = original
+        assert (res.key, res.matcher) == ("mit", "dice")
+        assert res.confidence == expected.confidence
+        assert rq.corpus_fp == fp_new
+        assert b.stats()["scheduler"]["fallbacks"] == 1
+
+
+def test_reload_rejects_bad_sources_and_keeps_serving(
+    clf, mit_body, tmp_path
+):
+    from licensee_tpu.serve.reload import ReloadRejectedError
+
+    corrupt = tmp_path / "bad.corpus.npz"
+    corrupt.write_bytes(b"this is not an artifact")
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        fp = b.corpus_fingerprint
+        with pytest.raises(ReloadRejectedError, match="cannot load"):
+            b.reload_corpus(str(corrupt))
+        with pytest.raises(ReloadRejectedError, match="cannot load"):
+            b.reload_corpus(str(tmp_path / "missing.npz"))
+        assert b.corpus_fingerprint == fp  # old corpus still serving
+        assert b.classify(mit_body, "LICENSE").key == "mit"
+        assert b.stats()["scheduler"]["reload_failed"] == 2
+
+
+def test_reload_validation_gate_refuses(clf, monkeypatch):
+    import licensee_tpu.serve.reload as reload_mod
+
+    monkeypatch.setattr(
+        reload_mod, "validate_classifier",
+        lambda c: ["injected validation failure"],
+    )
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        fp = b.corpus_fingerprint
+        with pytest.raises(
+            reload_mod.ReloadRejectedError, match="injected"
+        ):
+            b.reload_corpus("vendored")
+        assert b.corpus_fingerprint == fp
+        assert b.stats()["scheduler"]["reload_failed"] == 1
+
+
+def test_concurrent_reload_rejected_deterministically(clf, monkeypatch):
+    """The satellite: a second reload while one is compiling is
+    REJECTED (never queued, never interleaved), and the first completes
+    unharmed."""
+    import licensee_tpu.serve.reload as reload_mod
+
+    started, release = threading.Event(), threading.Event()
+    real_build = reload_mod.build_classifier_like
+
+    def slow_build(template, source, method=None):
+        started.set()
+        assert release.wait(30.0)
+        return real_build(template, source, method=method)
+
+    monkeypatch.setattr(reload_mod, "build_classifier_like", slow_build)
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        results = {}
+
+        def first():
+            try:
+                results["first"] = b.reload_corpus("vendored")
+            except Exception as exc:  # pragma: no cover - failure detail
+                results["first"] = exc
+
+        t = threading.Thread(target=first)
+        t.start()
+        assert started.wait(10.0)
+        with pytest.raises(reload_mod.ReloadInProgressError):
+            b.reload_corpus("vendored")
+        assert b.stats()["scheduler"]["reload_rejected"] == 1
+        release.set()
+        t.join(30.0)
+        assert isinstance(results["first"], dict)
+        assert results["first"]["ok"]
+        # same source, same corpus: the swap is a no-op fingerprint-wise
+        assert results["first"]["unchanged"]
+        assert b.stats()["scheduler"]["reloads"] == 1
+
+
+def test_reload_verb_over_session(clf, mit_body, tmp_path):
+    """The wire surface: bad requests cost error rows, a failed reload
+    reports reload_failed, and classification rows carry the corpus
+    fingerprint — all in request order."""
+    lines = [
+        json.dumps({"id": 1, "op": "reload"}),  # missing corpus
+        json.dumps({
+            "id": 2, "op": "reload",
+            "corpus": str(tmp_path / "nonexistent.npz"),
+        }),
+        json.dumps({"id": 3, "content": mit_body, "filename": "LICENSE"}),
+    ]
+    out = []
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        serve_session(b, lines, lambda line: out.append(json.loads(line)))
+        fp = b.corpus_fingerprint
+    assert [row["id"] for row in out] == [1, 2, 3]
+    assert "bad_request" in out[0]["error"]
+    assert out[1]["error"].startswith("reload_failed")
+    assert out[1]["problems"]
+    assert out[2]["key"] == "mit"
+    assert out[2]["corpus"] == fp[:12]
+
+
+def test_reload_rejected_for_corpusless_mode():
+    from licensee_tpu.serve.reload import ReloadRejectedError
+
+    pkg_clf = BatchClassifier(mode="package", mesh=None)
+    with MicroBatcher(classifier=pkg_clf, max_delay_ms=5.0) as b:
+        assert b.corpus_fingerprint is None
+        with pytest.raises(ReloadRejectedError, match="host-only"):
+            b.reload_corpus("vendored")
