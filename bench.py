@@ -539,6 +539,190 @@ def bench_host_model(
     }
 
 
+def bench_overlap(
+    n_files: int = 16384,
+    batch_size: int = 2048,
+    depths: tuple = (1, 2, 3),
+    reps: int = 2,
+) -> dict:
+    """The overlap pipeline priced: the SAME corpus run at pipeline
+    depth 1 (the synchronous dispatch -> await -> write loop) and at
+    depth >= 2 (the software pipeline: featurize chunk N+1 while the
+    device scores N and the writer drains N-1), with three gates:
+
+    * output sha256-identical across every depth (the FIFO-await
+      ordering contract);
+    * depth >= 2 beats the synchronous rate on this host;
+    * the measured overlapped rate tracks the LANE model,
+      ``1/max(featurize_lane, writer_lane)`` — the device term must be
+      invisible (its submit cost rides 'dispatch', its await is a
+      no-op by the time the FIFO pop reaches it).
+
+    Per-depth rates are best-of-``reps`` (shared-core VMs jitter); the
+    lane occupancy block is obs/pipeline.py's gauge snapshot for the
+    best overlapped run."""
+    import hashlib
+    import os
+    import tempfile
+
+    from licensee_tpu.kernels.batch import BatchClassifier
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        paths = write_bench_corpus(tmpdir, n_files, "license", unique=True)
+        classifier = BatchClassifier(pad_batch_to=batch_size, mesh=None)
+        classifier.classify_blobs([b"warm up words beyond any template"])
+        runs = {}
+        shas = {}
+        best_overlapped = None
+        for depth in depths:
+            best = None
+            for _ in range(reps):
+                project = BatchProject(
+                    paths,
+                    batch_size=batch_size,
+                    classifier=classifier,
+                    pipeline_depth=depth,
+                )
+                out = os.path.join(tmpdir, f"out_d{depth}.jsonl")
+                stats = project.run(out, resume=False)
+                elapsed = stats.stage_seconds["elapsed"]
+                if best is None or elapsed < best[0]:
+                    best = (elapsed, stats, project.workers)
+            elapsed, stats, workers = best
+            with open(os.path.join(tmpdir, f"out_d{depth}.jsonl"), "rb") as f:
+                shas[depth] = hashlib.sha256(f.read()).hexdigest()
+            runs[f"depth{depth}"] = {
+                "files_per_sec": round(stats.total / elapsed, 1),
+                "stage_seconds": {
+                    k: round(v, 3) for k, v in stats.stage_seconds.items()
+                },
+                "occupancy": (stats.pipeline or {}).get("occupancy"),
+                "sha256": shas[depth][:16],
+            }
+            if depth >= 2 and (
+                best_overlapped is None or elapsed < best_overlapped[0]
+            ):
+                best_overlapped = (elapsed, stats, workers, depth)
+
+    sync = runs.get("depth1") or {}
+    sync_rate = sync.get("files_per_sec") or 0.0
+    elapsed, stats, workers, depth = best_overlapped
+    st = stats.stage_seconds
+    total = stats.total
+    measured = total / elapsed
+    # the lane model: the featurize LANE is the whole produce stage
+    # (read + featurize — one worker does both per blob, exactly what
+    # the pipeline_featurize_busy clock brackets) and accumulates
+    # thread-seconds across the pool, so its per-blob cost divides by
+    # the workers; the writer and the main thread's serial section
+    # (submit + the FIFO await/finish, 'dispatch' + 'score') are
+    # single lanes
+    feat_lane_pb = (
+        st.get("read", 0.0) + st.get("featurize", 0.0)
+    ) / total / max(workers, 1)
+    writer_pb = st.get("write", 0.0) / total
+    serial_pb = (st.get("dispatch", 0.0) + st.get("score", 0.0)) / total
+    lane_pb = max(feat_lane_pb, writer_pb)
+    predicted = 1.0 / lane_pb if lane_pb else float("inf")
+    ratio = measured / predicted if predicted else 0.0
+    return {
+        "files": n_files,
+        "batch": batch_size,
+        "workers": workers,
+        "host_cores": os.cpu_count(),
+        "runs": runs,
+        "identical_output": len(set(shas.values())) == 1,
+        "sync_files_per_sec": sync_rate,
+        "overlap_files_per_sec": round(measured, 1),
+        "best_depth": depth,
+        "speedup": round(measured / sync_rate, 3) if sync_rate else None,
+        "lane_model": {
+            "featurize_lane_us_per_blob": round(feat_lane_pb * 1e6, 1),
+            "writer_lane_us_per_blob": round(writer_pb * 1e6, 1),
+            # submit + FIFO await/finish on the main thread: the resid-
+            # ual device term.  Invisible == well under the bottleneck
+            # lane (the await resolves instantly in steady state)
+            "main_serial_us_per_blob": round(serial_pb * 1e6, 1),
+            "predicted_files_per_sec": round(predicted, 1),
+            "measured_files_per_sec": round(measured, 1),
+            "measured_over_predicted": round(ratio, 3),
+            "within_25pct": bool(abs(1.0 - ratio) <= 0.25),
+        },
+    }
+
+
+def bench_method_crossover(
+    widths: tuple = (128, 304, 608, 1216, 2432),
+    n_blobs: int = 16384,
+    iters: int = 5,
+) -> dict:
+    """Refresh the popcount/matmul method crossover PAST vendored
+    width: the ROADMAP flagged the old table (measured once at T<=608)
+    as stale for artifact corpora grown beyond it, so this prices both
+    kernels at T=608 (vendored+SPDX width) and doubled/quadrupled
+    template pools (extend_templates: perturbed real bitsets, same
+    dtypes/density) and checks ``resolve_method``'s rung table
+    (kernels/batch.py METHOD_CROSSOVER — what ``method="auto"`` and
+    every reload's ``build_classifier_like`` re-resolution consult)
+    against the measured winner at every width."""
+    from licensee_tpu.corpus.compiler import default_corpus
+    from licensee_tpu.kernels.batch import METHOD_CROSSOVER, resolve_method
+    from licensee_tpu.kernels.dice_xla import CorpusArrays
+
+    import jax
+
+    corpus = default_corpus()
+    arrays = CorpusArrays.from_compiled(corpus)
+    features = build_blob_features(corpus, n_blobs)
+    rows = {}
+    consistent = True
+    consistent_wide = True
+    for width in widths:
+        arr = (
+            extend_templates(arrays, width)
+            if width > arrays.bits.shape[0]
+            else arrays
+        )
+        rates = {}
+        for method in ("popcount", "matmul"):
+            try:
+                rates[method] = round(
+                    bench_device(arr, features, method, iters=iters), 1
+                )
+            except Exception as exc:  # noqa: BLE001 — keep the bench robust
+                print(
+                    f"bench[crossover {method}@T={width}] failed: {exc}",
+                    file=sys.stderr,
+                )
+        if not rates:
+            continue
+        winner = max(rates, key=rates.get)
+        auto = resolve_method(width)
+        agrees = winner == auto
+        consistent = consistent and agrees
+        if width > 128:
+            consistent_wide = consistent_wide and agrees
+        rows[str(width)] = {
+            **rates,
+            "winner": winner,
+            "auto_resolves": auto,
+            "auto_agrees": agrees,
+        }
+    return {
+        "n_blobs": n_blobs,
+        # the narrow (<=128) rung is the v5e VPU measurement from the
+        # dice_pallas ADR; on non-TPU backends matmul tends to win
+        # everywhere, so the gate that matters for the stale-table
+        # worry is the ABOVE-vendored consistency
+        "platform": jax.default_backend(),
+        "rows": rows,
+        "table": [list(rung) for rung in METHOD_CROSSOVER],
+        "auto_consistent_with_measurement": consistent,
+        "auto_consistent_above_vendored_width": consistent_wide,
+    }
+
+
 def bench_stripes(
     n_files: int = 16384, host_model: dict | None = None
 ) -> dict:
@@ -1364,6 +1548,16 @@ def make_headline(
                 "amdahl_ceiling_files_per_sec": (
                     hm.get("scaling_model") or {}
                 ).get("amdahl_ceiling_files_per_sec"),
+                # the overlap pipeline's proof, compressed: depth>=2
+                # vs sync speedup, bit-identical output, and the lane
+                # model hit (full row: details.host_model.overlap)
+                "overlap_speedup": (hm.get("overlap") or {}).get("speedup"),
+                "overlap_identical": (hm.get("overlap") or {}).get(
+                    "identical_output"
+                ),
+                "overlap_vs_lane_model": (
+                    (hm.get("overlap") or {}).get("lane_model") or {}
+                ).get("measured_over_predicted"),
             },
             # the striped scale-out: 1 vs N co-located stripes over the
             # same manifest (full row: details.stripes)
@@ -1493,6 +1687,14 @@ def main() -> None:
     reload_row = run_safe("reload", bench_reload)
     fleet = run_safe("fleet", bench_fleet)
     host_model = run_safe("host_model", bench_host_model, e2e=end_to_end)
+    overlap = run_safe("overlap", bench_overlap)
+    if host_model is not None and overlap is not None:
+        # the overlap row rides host_model: it is the same lane story
+        # (rate = 1/max(featurize_lane, writer_lane), device invisible)
+        host_model["overlap"] = overlap
+    method_crossover = run_safe(
+        "method_crossover", bench_method_crossover
+    )
     stripes = run_safe(
         "stripes", bench_stripes, host_model=host_model
     )
@@ -1537,6 +1739,7 @@ def main() -> None:
         "reload": reload_row,
         "fleet": fleet,
         "host_model": host_model,
+        "method_crossover": method_crossover,
         "stripes": stripes,
         "reference_fallback": reference_fallback,
         "tp_width": tp_width,

@@ -17,13 +17,16 @@ gating and inline pragmas (``core.py``), and the rule set:
                           attributes in thread-reachable methods
 2  ``blocking-call``      ``time.sleep``/socket verbs/file I/O/subprocess
                           waits inside router dispatch/handler paths
-3  ``resource-leak``      sockets, ``Popen``, file handles without
+3  ``blocking-device-call`` ``block_until_ready()``/sync
+                          ``dispatch_chunks`` on the overlap pipeline's
+                          submit paths (scheduler flush, batch run loop)
+4  ``resource-leak``      sockets, ``Popen``, file handles without
                           ``with``/``finally`` close on all paths
-4  ``tracer-purity``      ``jax.jit``/``vmap`` functions calling host
+5  ``tracer-purity``      ``jax.jit``/``vmap`` functions calling host
                           effects or branching on tracer values
-5  ``wallclock-time``     AST-accurate monotonic-clock house rule
-6  ``no-print``           AST-accurate no-print house rule
-7  ``per-blob-featurize`` AST-accurate batch-crossing house rule
+6  ``wallclock-time``     AST-accurate monotonic-clock house rule
+7  ``no-print``           AST-accurate no-print house rule
+8  ``per-blob-featurize`` AST-accurate batch-crossing house rule
 == =====================  ================================================
 
 Suppress a finding with ``# analysis: disable=rule-id`` plus a written

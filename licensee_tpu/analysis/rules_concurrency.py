@@ -139,6 +139,89 @@ BLOCKING_METHODS = {
 BLOCKING_IMPORT_TAILS = {"oneshot": "performs a synchronous probe round trip"}
 
 
+# -- blocking-device-call ------------------------------------------------
+
+# Entry points of the overlap pipeline's SUBMIT side: the scheduler
+# thread's flush path (serve/scheduler.py) and the batch run loop with
+# its nested producers (projects/batch_project.py).  The completion/
+# await side (_complete_group, finish_chunks callers, warmup) is
+# ALLOWED to block — awaiting the DeviceFuture there is its whole job —
+# so it is deliberately not an entry.
+PIPELINE_ENTRY_NAMES = {
+    "_flush", "_submit_group", "_loop", "submit",  # scheduler thread
+    "run", "dispatch_gathered", "submit_next",     # batch run loop
+    "dispatch_chunks_async",                       # the submit seam itself
+}
+
+# device synchronization verbs that must never ride the submit path
+BLOCKING_DEVICE_METHODS = {
+    "block_until_ready": "synchronizes the carrying thread with the device",
+    "dispatch_chunks": (
+        "is the synchronous submit+await wrapper; submit with "
+        "dispatch_chunks_async and await the DeviceFuture on the "
+        "completion lane"
+    ),
+}
+BLOCKING_DEVICE_QUALIFIED = {
+    "jax.block_until_ready": (
+        "synchronizes the carrying thread with the device"
+    ),
+}
+
+
+@rule(
+    "blocking-device-call",
+    dirs=(
+        "licensee_tpu/serve/scheduler",
+        "licensee_tpu/projects/batch_project",
+        "licensee_tpu/kernels/batch",
+    ),
+    doc=(
+        "The overlap pipeline's submit path (scheduler flush, batch "
+        "run loop, dispatch_chunks_async) calls a device-synchronizing "
+        "primitive (block_until_ready, the sync dispatch_chunks "
+        "wrapper) — the device lane must stay asynchronous"
+    ),
+)
+def check_blocking_device_call(module):
+    scopes = _scopes(module)
+    imports = _imports(module)
+    reachable = scopes.module_reachable(PIPELINE_ENTRY_NAMES)
+    findings = []
+    seen: set[int] = set()
+    for scope in reachable:
+        if scope.name in BLOCKING_DEVICE_METHODS:
+            # the sync wrapper's own DEFINITION is the one sanctioned
+            # home of the await; flagging its body would flag the seam
+            continue
+        for node in ast.walk(scope.node):
+            if not isinstance(node, ast.Call):
+                continue
+            qn = imports.qualify(node.func)
+            why = None
+            what = qn
+            if qn is not None and qn in BLOCKING_DEVICE_QUALIFIED:
+                why = BLOCKING_DEVICE_QUALIFIED[qn]
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in BLOCKING_DEVICE_METHODS
+            ):
+                why = BLOCKING_DEVICE_METHODS[node.func.attr]
+                what = f".{node.func.attr}"
+            if why is None or node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            findings.append(
+                module.finding(
+                    "blocking-device-call",
+                    node.lineno,
+                    f"pipeline submit path '{scope.name}' calls "
+                    f"{what}() which {why}",
+                )
+            )
+    return findings
+
+
 @rule(
     "blocking-call",
     dirs=("licensee_tpu/fleet/router", "licensee_tpu/serve/server"),

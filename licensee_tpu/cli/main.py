@@ -409,9 +409,24 @@ def _run_striped(args) -> int:
         ("--mesh", args.mesh, None),
         ("--confidence", args.confidence, None),
         ("--coalesce-batches", args.coalesce_batches, 32),
+        ("--pipeline-depth", args.pipeline_depth, 2),
     ):
         if value is not None and value != default:
             forward += [flag, str(value)]
+    if args.device_lanes is not None:
+        forward += ["--device-lanes", str(args.device_lanes)]
+    elif (
+        args.chips_per_stripe is not None
+        and args.chips_per_stripe > 1
+        and args.mesh in (None, "auto", "none")
+    ):
+        # a --chips-per-stripe K worker sees exactly K chips (the
+        # visible-chips env contract); round-robin them by default so
+        # the K device lanes sit behind that stripe's one featurize
+        # lane — the in-stripe scale-out the flag exists for.  An
+        # explicit numeric --mesh means the operator chose per-dispatch
+        # sharding instead, and lanes are mutually exclusive with it
+        forward += ["--device-lanes", "auto"]
     if args.closest:
         forward += ["--closest", str(args.closest)]
     if args.attribution:
@@ -662,6 +677,8 @@ def cmd_batch_detect(args) -> int:
             progress_every=args.progress,
             coalesce_batches=args.coalesce_batches,
             corpus_source=args.corpus,
+            pipeline_depth=args.pipeline_depth,
+            device_lanes=args.device_lanes,
             **kwargs,
         )
     except OSError as exc:
@@ -855,6 +872,10 @@ def cmd_serve(args) -> int:
             deadline_ms=args.deadline_ms,
             threshold=args.confidence,
             buckets=buckets,
+            pipeline_depth=args.pipeline_depth,
+            # the product worker always pre-compiles its bucket shapes:
+            # no live request pays a jit compile (tests/libraries opt in)
+            warm_start=True,
             tracing=not args.no_tracing,
             trace_sample=args.trace_sample,
             trace_slow_ms=args.trace_slow_ms,
@@ -1407,6 +1428,33 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     batch.add_argument(
+        "--pipeline-depth", type=bounded(int, 1), default=2, metavar="N",
+        help=(
+            "How many dispatched device groups may be in flight at "
+            "once: 1 = the synchronous dispatch/await/write loop, >= 2 "
+            "= the overlap pipeline (featurize chunk N+1 while the "
+            "device scores N and the writer drains N-1; output "
+            "bit-identical at every depth; default 2)"
+        ),
+    )
+
+    def lanes_arg(value):
+        if value == "auto":
+            return value
+        return bounded(int, 1)(value)
+
+    lanes_arg.__name__ = "K|auto"
+    batch.add_argument(
+        "--device-lanes", type=lanes_arg, default=None, metavar="K|auto",
+        help=(
+            "In-stripe multi-chip scoring: round-robin whole dispatch "
+            "chunks across the first K visible chips ('auto' = all), "
+            "so one featurize lane feeds K independent device lanes. "
+            "Mutually exclusive with an explicit --mesh (which shards "
+            "ONE chunk across chips and synchronizes per dispatch)"
+        ),
+    )
+    batch.add_argument(
         "--stripes", default=None, metavar="N|auto",
         help=(
             "Scale out across N co-located worker processes, each "
@@ -1534,6 +1582,16 @@ def build_parser() -> argparse.ArgumentParser:
             "Bounded admission queue: a request arriving with N "
             "Dice-bound rows already waiting is rejected with "
             "retry_after instead of buffered (default 1024)"
+        ),
+    )
+    serve.add_argument(
+        "--pipeline-depth", type=bounded(int, 1), default=2, metavar="N",
+        help=(
+            "How many submitted device flushes may be in flight before "
+            "the scheduler thread blocks on the handoff queue: 1 = "
+            "synchronous flush, >= 2 = the overlap pipeline (the "
+            "scheduler gathers flush N+1 while the device scores N "
+            "and the completion thread answers N-1; default 2)"
         ),
     )
     serve.add_argument(

@@ -78,6 +78,133 @@ def _refscan_native():
     return nat, handle
 
 
+# Measured kernel-method crossover (bench.py bench_method_crossover):
+# ascending (max_templates, method) rungs; None = everything above.
+# The narrow rung is the v5e VPU measurement (the ADR in
+# dice_pallas.py: popcount wins while the loop stays cache-resident).
+# Re-benched 2026-08-03 at T = 608 (vendored+SPDX width), 1216 and
+# 2432 (padded-template widths standing in for artifact corpora grown
+# past the vendored pool): matmul wins from a few hundred templates up
+# and its lead GROWS with T (the MXU amortizes the 32x bit unpack over
+# ever-larger contractions) — the r5 worry that the crossover might
+# invert above vendored width did not materialize.  ``method="auto"``
+# (and every reload's re-resolution through serve/reload.py
+# build_classifier_like) consults this table.
+METHOD_CROSSOVER: tuple = ((128, "popcount"), (None, "matmul"))
+
+
+def resolve_method(n_templates: int) -> str:
+    """The measured-best scoring method for a corpus of this width."""
+    for bound, method in METHOD_CROSSOVER:
+        if bound is None or n_templates <= bound:
+            return method
+    raise AssertionError("METHOD_CROSSOVER must end with a None rung")
+
+
+class DeviceFuture:
+    """A handle to in-flight device scoring.
+
+    Submission already happened (asynchronous JAX dispatch, with the
+    device->host output copies started), so holding a DeviceFuture
+    costs nothing on the host; :meth:`result` blocks only until those
+    copies land and then returns the resolved
+    ``[(chunk, (np arrays...)), ...]`` outs list — the exact shape
+    ``finish_chunks`` consumes.  ``ready()`` is a non-blocking poll
+    for callers that want to peek before awaiting; the batch and serve
+    pipelines themselves never use it — they await strictly FIFO via
+    :meth:`result` (the ordering contract).  Resolution also
+    releases any staging-ring slots the dispatch borrowed, so a future
+    must be awaited (or dropped) for its slots to recycle."""
+
+    __slots__ = ("_parts", "_resolved", "_on_resolve")
+
+    def __init__(self, parts, on_resolve=()):
+        self._parts = parts
+        self._resolved = None
+        self._on_resolve = list(on_resolve)
+
+    def __len__(self) -> int:
+        return len(self._parts)
+
+    def ready(self) -> bool:
+        """True when every output has landed on the host (non-blocking;
+        conservatively False only while a copy is still in flight)."""
+        if self._resolved is not None:
+            return True
+        for _chunk, out in self._parts:
+            for a in out:
+                is_ready = getattr(a, "is_ready", None)
+                if is_ready is not None and not is_ready():
+                    return False
+        return True
+
+    def result(self):
+        """Await: resolve every output to host numpy (idempotent)."""
+        if self._resolved is None:
+            self._resolved = [
+                (chunk, tuple(np.asarray(a) for a in out))
+                for chunk, out in self._parts
+            ]
+            self._parts = self._resolved
+            callbacks, self._on_resolve = self._on_resolve, []
+            for cb in callbacks:
+                cb()
+        return self._resolved
+
+
+class _StagingRing:
+    """Pre-allocated host staging rows for padded dispatch chunks.
+
+    One free-list of (bits, n_words, lengths, cc_fp) row blocks per
+    padded shape: a partial chunk copies its rows in and zeroes the
+    tail instead of paying an ``np.pad`` allocation quartet per
+    dispatch.  ``acquire`` NEVER blocks — when the ring is dry it
+    allocates a fresh slot (the pipeline depth, not the ring, bounds
+    in-flight chunks; a blocking acquire here could deadlock the
+    single thread that both submits and awaits) — and ``release``
+    keeps at most ``depth`` slots per shape, so a burst allocates and
+    the steady state recycles."""
+
+    def __init__(self, n_lanes: int, depth: int = 3):
+        self.n_lanes = n_lanes
+        self.depth = depth
+        self._free: dict[int, list] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, B: int):
+        with self._lock:
+            free = self._free.setdefault(B, [])
+            if free:
+                return free.pop()
+        return (
+            np.zeros((B, self.n_lanes), dtype=np.uint32),
+            np.zeros(B, dtype=np.int32),
+            np.zeros(B, dtype=np.int32),
+            np.zeros(B, dtype=bool),
+        )
+
+    def release(self, slot) -> None:
+        B = len(slot[1])
+        with self._lock:
+            free = self._free.setdefault(B, [])
+            if len(free) < self.depth:
+                free.append(slot)
+
+    def fill(self, slot, b, nw, ln, cf):
+        """Copy n live rows into the slot and zero the padding tail."""
+        n = len(nw)
+        sb, snw, sln, scf = slot
+        sb[:n] = b
+        snw[:n] = nw
+        sln[:n] = ln
+        scf[:n] = cf
+        sb[n:] = 0
+        snw[n:] = 0
+        sln[n:] = 0
+        scf[n:] = False
+        return slot
+
+
 @functools.lru_cache(maxsize=None)
 def _has_fullname(key: str) -> bool:
     """Does the vendored license's template carry a [fullname] field?
@@ -187,22 +314,29 @@ class BatchClassifier:
         mode: str = "license",
         closest: int = 0,
         device: bool = True,
+        lanes: int | str | None = None,
+        staging_depth: int = 3,
     ):
         if mode not in ("license", "readme", "package", "auto"):
             raise ValueError(f"unknown mode {mode!r}")
         self.mode = mode
         # device dispatch attribution (obs): each distinct padded shape
-        # jit-compiles exactly once, so the FIRST dispatch of a shape is
-        # compile-dominated and every later one is steady-state enqueue.
-        # Splitting the two is the compile-vs-execute story the serve
-        # registry exports (one cold bucket showing up as a p99 cliff is
-        # a compile, not a regression).
+        # jit-compiles exactly once PER DEVICE, so the FIRST dispatch of
+        # a (shape, device) pair is compile-dominated and every later
+        # one is steady-state enqueue.  Splitting the two is the
+        # compile-vs-execute story the serve registry exports (one cold
+        # bucket showing up as a p99 cliff is a compile, not a
+        # regression); _shape_prof keeps the same split per padded
+        # shape, so a serve worker can name WHICH bucket paid what.
         self._dispatch_lock = threading.Lock()
         self._dispatch_prof = {
             "compiles": 0, "compile_s": 0.0,
             "dispatches": 0, "dispatch_s": 0.0,
         }
-        self._dispatched_shapes: set[int] = set()
+        self._dispatched_shapes: set = set()  # (pad shape, device key)
+        self._shape_prof: dict[int, dict] = {}
+        self._rr = 0  # round-robin cursor over self.devices
+        self.devices: list | None = None
         self.closest = int(closest)
         if self.closest < 0:
             raise ValueError("closest must be >= 0")
@@ -225,6 +359,7 @@ class BatchClassifier:
             self.method = method
             self.pad_batch_to = pad_batch_to
             self.mesh = None
+            self._staging = None  # host-only: nothing ever dispatches
             self._fn = None
             self.arrays = None
             self._exact_map = {}
@@ -234,12 +369,18 @@ class BatchClassifier:
             return
         self.corpus = corpus or default_corpus()
         if method == "auto":
-            # measured crossover on v5e (see the ADR in dice_pallas.py):
-            # popcount wins at vendored width, matmul from a few hundred
-            # templates up (the MXU amortizes the 32x unpack)
-            method = "popcount" if self.corpus.n_templates <= 128 else "matmul"
+            # the measured crossover table (METHOD_CROSSOVER above; the
+            # v5e ADR in dice_pallas.py tells the same story): popcount
+            # at narrow widths, matmul from a few hundred templates up,
+            # re-benched past vendored width by bench_method_crossover
+            method = resolve_method(self.corpus.n_templates)
         self.method = method
         self.pad_batch_to = pad_batch_to
+        # host staging rows for padded dispatch (the async pipeline's
+        # double/triple buffer — see _StagingRing)
+        self._staging = _StagingRing(
+            self.corpus.n_lanes, depth=max(1, int(staging_depth))
+        )
         if not device:
             # host-only twin for featurize worker PROCESSES
             # (--featurize-procs): prepare_batch works in full, but no
@@ -268,6 +409,40 @@ class BatchClassifier:
             raise ValueError(
                 "closest is not supported with the pallas methods"
             )
+        # ``lanes``: in-stripe multi-chip ROUND-ROBIN — successive
+        # dispatch chunks go wholly to successive visible chips, so one
+        # featurize lane feeds K independent device lanes (the overlap
+        # pipeline's scale-out inside one stripe).  Orthogonal to
+        # ``mesh`` (which splits ONE chunk across chips and
+        # synchronizes them per dispatch): exactly one of the two may
+        # be active.  "auto" takes every visible chip; an int takes the
+        # first K.
+        if lanes is not None:
+            if mesh not in (None, "auto"):
+                raise ValueError(
+                    "lanes round-robins whole chunks per chip; pass "
+                    "mesh=None (or leave mesh='auto' to be overridden)"
+                )
+            if method.startswith("pallas"):
+                raise ValueError(
+                    "the pallas methods are single-device; lanes cannot "
+                    "round-robin them"
+                )
+            import jax
+
+            local = jax.local_devices()
+            k = len(local) if lanes == "auto" else int(lanes)
+            if k < 1:
+                raise ValueError(f"lanes must be >= 1, got {lanes!r}")
+            if k > len(local):
+                raise ValueError(
+                    f"lanes={k} but only {len(local)} visible devices "
+                    "(the chip-partition contract: set "
+                    "LICENSEE_TPU_VISIBLE_CHIPS / --chips-per-stripe)"
+                )
+            mesh = None  # a lanes classifier never shards one chunk
+            if k > 1:
+                self.devices = list(local[:k])
         self.mesh = self._resolve_mesh(mesh, method, pad_batch_to)
         # top-1 stays exact with or without closest; the k candidate
         # columns are a per-row reduction, so they ride both the
@@ -286,7 +461,7 @@ class BatchClassifier:
         elif k:
             from licensee_tpu.kernels.dice_xla import make_topk_fn
 
-            self._fn = make_topk_fn(self.arrays, k, method=method)
+            self._fn = make_topk_fn(self.arrays, k, method=method, donate=True)
         elif method == "pallas":
             from licensee_tpu.kernels.dice_pallas import (
                 make_best_match_fn_pallas,
@@ -300,7 +475,14 @@ class BatchClassifier:
 
             self._fn = make_best_match_fn_pallas_mxu(self.arrays)
         else:
-            self._fn = make_best_match_fn(self.arrays, method=method)
+            # donate=True: the int32[B] feature rows' device buffers are
+            # released to the allocator as the kernel consumes them (see
+            # dice_xla.DONATE_ARGNUMS) — the async pipeline keeps
+            # several chunks in flight, and their dead inputs must not
+            # stack up in HBM behind the live ones
+            self._fn = make_best_match_fn(
+                self.arrays, method=method, donate=True
+            )
         # Exact matcher pre-filter: full wordset (fields included) equality
         # (matchers/exact.rb:6-13), against the corpus's OWN template
         # renderings (not the vendored pool — custom SPDX corpora carry
@@ -906,18 +1088,36 @@ class BatchClassifier:
         self.finish_chunks(prepared, outs, threshold)
         return prepared.results  # type: ignore[return-value]
 
-    def dispatch_chunks(self, prepared: PreparedBatch, pad_to: int | None = None):
-        """Launch device scoring for the ``todo`` rows in fixed-size padded
-        chunks.  The returned device outputs are lazy (JAX dispatch is
-        asynchronous): the host featurizes the next batch while the device
-        scores this one; finish_chunks() synchronizes.
+    def dispatch_chunks_async(
+        self, prepared: PreparedBatch, pad_to: int | None = None
+    ) -> DeviceFuture:
+        """Submit device scoring for the ``todo`` rows — NON-BLOCKING.
+
+        The returned :class:`DeviceFuture` resolves to the
+        ``[(chunk, outs), ...]`` list ``finish_chunks`` consumes; until
+        then the device computes (and the device->host copies stream)
+        while the host featurizes the next chunk — the overlap seam of
+        the whole pipeline.  Nothing on this path synchronizes: no
+        ``block_until_ready``, no ``np.asarray`` on device values (the
+        ``blocking-device-call`` analysis rule holds the pipeline
+        callers to the same contract).  The one blocking exception is
+        the FIRST dispatch of a new (shape, device) pair, which pays
+        its jit compile inline — pre-compile shapes (serve warmup, the
+        bench warm loop) to keep the steady state flat.
+
+        Padded chunks borrow host staging rows from a small
+        pre-allocated ring (double/triple buffer, ``staging_depth``)
+        instead of paying an ``np.pad`` allocation quartet; the slots
+        recycle when the future resolves.  With multi-chip ``lanes``,
+        successive chunks round-robin across the visible devices —
+        K device lanes behind one featurize lane.
 
         ``pad_to`` overrides the chunk shape for this dispatch — the
-        online micro-batcher (serve/scheduler.py) pads each flush to the
-        smallest fitting BUCKET so a 3-row deadline flush doesn't pay a
-        4096-row padded batch.  Each distinct shape jit-compiles once
-        and is reused forever after (the bucket list is fixed), so the
-        steady state never recompiles per request."""
+        online micro-batcher (serve/scheduler.py) pads each flush to
+        the smallest fitting BUCKET so a 3-row deadline flush doesn't
+        pay a 4096-row padded batch.  Each distinct shape jit-compiles
+        once per device and is reused forever after (the bucket list
+        is fixed), so the steady state never recompiles per request."""
         if prepared.todo and self._fn is None:
             raise RuntimeError(
                 "device=False classifier cannot dispatch (featurize "
@@ -938,7 +1138,8 @@ class BatchClassifier:
             prepared.cc_fp,
             prepared.todo,
         )
-        outs = []
+        parts = []
+        slots = []
         B = int(pad_to) if pad_to is not None else self.pad_batch_to
         for start in range(0, len(todo), B):
             chunk = todo[start : start + B]
@@ -950,48 +1151,93 @@ class BatchClassifier:
             nw = n_words[rows]
             ln = lengths[rows]
             cf = cc_fp[rows]
-            pad = B - len(chunk)
-            if pad:
-                b = np.pad(b, ((0, pad), (0, 0)))
-                nw = np.pad(nw, (0, pad))
-                ln = np.pad(ln, (0, pad))
-                cf = np.pad(cf, (0, pad))
-            if self.mesh is not None:
+            if B - len(chunk):
+                slot = self._staging.acquire(B)
+                slots.append(slot)
+                b, nw, ln, cf = self._staging.fill(slot, b, nw, ln, cf)
+            dev = None
+            if self.devices is not None:
+                with self._dispatch_lock:
+                    dev = self.devices[self._rr % len(self.devices)]
+                    self._rr += 1
+                import jax
+
+                # commit the host rows to THIS lane's chip; the jitted
+                # scorer runs where its (committed) arguments live, so
+                # successive chunks land on successive chips
+                b, nw, ln, cf = jax.device_put((b, nw, ln, cf), dev)
+            elif self.mesh is not None:
                 from licensee_tpu.parallel.mesh import shard_batch
 
                 b, nw, ln, cf = shard_batch(self.mesh, b, nw, ln, cf)
             t0 = time.perf_counter()
             out = self._fn(b, nw, ln, cf)
             dt = time.perf_counter() - t0
-            with self._dispatch_lock:
-                # first dispatch of a shape blocks on the jit compile;
-                # later ones are the steady-state async enqueue
-                if B not in self._dispatched_shapes:
-                    self._dispatched_shapes.add(B)
-                    self._dispatch_prof["compiles"] += 1
-                    self._dispatch_prof["compile_s"] += dt
-                else:
-                    self._dispatch_prof["dispatches"] += 1
-                    self._dispatch_prof["dispatch_s"] += dt
-            # start the device->host copies NOW so finish_chunks finds
-            # them ready instead of paying a synchronous transfer per
-            # array (the main loop's serial section at 10M-file scale)
+            self._note_dispatch(B, dev, dt)
+            # start the device->host copies NOW so the await finds them
+            # ready instead of paying a synchronous transfer per array
+            # (the main loop's serial section at 10M-file scale)
             for a in out:
                 try:
                     a.copy_to_host_async()
                 except AttributeError:
                     break  # non-jax arrays (interpret/test paths)
-            outs.append((chunk, out))
-        return outs
+            parts.append((chunk, out))
+        release = [
+            (lambda s=s: self._staging.release(s)) for s in slots
+        ]
+        return DeviceFuture(parts, on_resolve=release)
+
+    def dispatch_chunks(self, prepared: PreparedBatch, pad_to: int | None = None):
+        """Synchronous convenience over :meth:`dispatch_chunks_async`:
+        submit and await in one call, returning resolved host-numpy
+        outs.  For the one-shot paths (classify_blobs, the reload
+        validation probe, benches); the pipelines keep the future."""
+        return self.dispatch_chunks_async(prepared, pad_to=pad_to).result()
+
+    def _note_dispatch(self, B: int, dev, dt: float) -> None:
+        """Account one submit: compile (first dispatch of this
+        (shape, device) pair) vs steady-state enqueue, totals and
+        per-shape."""
+        key = (B, None if dev is None else getattr(dev, "id", str(dev)))
+        with self._dispatch_lock:
+            shape = self._shape_prof.setdefault(
+                B,
+                {
+                    "compiles": 0, "compile_s": 0.0,
+                    "dispatches": 0, "dispatch_s": 0.0,
+                },
+            )
+            if key not in self._dispatched_shapes:
+                self._dispatched_shapes.add(key)
+                self._dispatch_prof["compiles"] += 1
+                self._dispatch_prof["compile_s"] += dt
+                shape["compiles"] += 1
+                shape["compile_s"] += dt
+            else:
+                self._dispatch_prof["dispatches"] += 1
+                self._dispatch_prof["dispatch_s"] += dt
+                shape["dispatches"] += 1
+                shape["dispatch_s"] += dt
 
     def dispatch_stats(self) -> dict:
         """The device compile-vs-execute split: counts and seconds of
-        first-dispatch-per-shape (jit compile included) vs steady-state
-        dispatches, plus the compiled shape set.  Scraped into the obs
-        registry; resets with the classifier, never midstream."""
+        first-dispatch-per-(shape, device) (jit compile included) vs
+        steady-state dispatches, the compiled shape set, and the same
+        split PER SHAPE (``per_shape`` — the serve cold-start story:
+        which bucket paid which compile, and what it cost).  Scraped
+        into the obs registry; resets with the classifier, never
+        midstream."""
         with self._dispatch_lock:
             out = dict(self._dispatch_prof)
-            out["shapes"] = sorted(self._dispatched_shapes)
+            out["shapes"] = sorted({b for b, _dev in self._dispatched_shapes})
+            out["per_shape"] = {
+                b: {
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in prof.items()
+                }
+                for b, prof in sorted(self._shape_prof.items())
+            }
         return out
 
     def merge_prepared(self, group: list[PreparedBatch]) -> PreparedBatch:
@@ -1073,7 +1319,12 @@ class BatchClassifier:
         In readme mode a blob the Dice pass left unmatched falls through
         to the Reference matcher (the last entry of the readme chain,
         readme_file.rb:32-34): a license named by title or source URL in
-        the extracted section matches at confidence 90."""
+        the extracted section matches at confidence 90.
+
+        ``outs`` may be the resolved list or a still-in-flight
+        :class:`DeviceFuture` — awaiting it here IS the synchronize."""
+        if isinstance(outs, DeviceFuture):
+            outs = outs.result()
         results = prepared.results
         for chunk, out in outs:
             best_idx, best_num, best_den = (

@@ -216,14 +216,33 @@ def best_match(
     return _argmax_exact(num, den)
 
 
-def make_best_match_fn(corpus: CorpusArrays, method: str = "popcount"):
-    """A jitted scorer closed over device-resident corpus constants."""
+# Which scorer arguments are donated when the caller opts in: the
+# int32[B] rows (n_words, lengths).  They are the only inputs whose
+# device buffers can alias an output (the int32[B] idx/num/den triple),
+# so donating them frees HBM the moment the kernel consumes them with
+# no "donated buffer not usable" warning; the uint32[B, W] bits matrix
+# and the bool[B] cc flags have no same-shaped output and would only
+# warn.  Donation invalidates DEVICE buffers, never host numpy — safe
+# for the staging-ring dispatch path (kernels/batch.py), which always
+# feeds host arrays; callers that re-use jax device arrays across calls
+# (tests, notebooks) must keep donate=False.
+DONATE_ARGNUMS = (1, 2)
 
-    @jax.jit
+
+def make_best_match_fn(
+    corpus: CorpusArrays, method: str = "popcount", donate: bool = False
+):
+    """A jitted scorer closed over device-resident corpus constants.
+
+    ``donate=True`` donates the int32[B] feature rows (see
+    DONATE_ARGNUMS) — the async dispatch pipeline's default, so an
+    in-flight chunk's consumed inputs never hold HBM alongside the next
+    chunk's transfer."""
+
     def fn(file_bits, n_words, lengths, cc_fp):
         return best_match(corpus, file_bits, n_words, lengths, cc_fp, method)
 
-    return fn
+    return jax.jit(fn, donate_argnums=DONATE_ARGNUMS if donate else ())
 
 
 def topk_candidates(num: jnp.ndarray, den: jnp.ndarray, k: int):
@@ -257,7 +276,10 @@ def topk_candidates(num: jnp.ndarray, den: jnp.ndarray, k: int):
     )
 
 
-def make_topk_fn(corpus: CorpusArrays, k: int, method: str = "popcount"):
+def make_topk_fn(
+    corpus: CorpusArrays, k: int, method: str = "popcount",
+    donate: bool = False,
+):
     """Jitted scorer returning the EXACT top-1 plus a top-k candidate
     list per blob (the batch analog of the CLI's closest-licenses view,
     commands/detect.rb:44-63).  The top-1 triple uses the exact int64
@@ -265,7 +287,6 @@ def make_topk_fn(corpus: CorpusArrays, k: int, method: str = "popcount"):
     use the same exact comparison (`topk_candidates`), so the whole
     candidate list is exact, boundary included."""
 
-    @jax.jit
     def fn(file_bits, n_words, lengths, cc_fp):
         num, den = score_pairs(
             corpus, file_bits, n_words, lengths, cc_fp, method
@@ -273,4 +294,4 @@ def make_topk_fn(corpus: CorpusArrays, k: int, method: str = "popcount"):
         best = _argmax_exact(num, den)
         return (*best, *topk_candidates(num, den, k))
 
-    return fn
+    return jax.jit(fn, donate_argnums=DONATE_ARGNUMS if donate else ())

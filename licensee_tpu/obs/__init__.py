@@ -26,6 +26,7 @@ from licensee_tpu.obs.registry import (
     MetricsRegistry,
     get_registry,
 )
+from licensee_tpu.obs.pipeline import PipelineLanes
 from licensee_tpu.obs.tracing import (
     NullTracer,
     Trace,
@@ -37,7 +38,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Trace", "Tracer", "NullTracer", "get_tracer",
     "render_prometheus", "check_exposition", "merge_expositions",
-    "NativeProfileSource",
+    "NativeProfileSource", "PipelineLanes",
     "DEFAULT_LATENCY_BUCKETS", "Observability",
 ]
 

@@ -125,7 +125,10 @@ def make_sharded_scorer(
     # DP × TP: lanes of the bit-matrix (and of blob bitsets) are sharded
     # over 'model'; each chip popcounts its lane slice and the partial
     # overlaps are summed over the model axis.
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax<=0.4.x keeps it under experimental
+        from jax.experimental.shard_map import shard_map
 
     def _tp_score(corpus_arrays, file_bits, n_words, lengths, cc_fp):
         # Inside shard_map: arrays hold this chip's (data, model) block.

@@ -889,4 +889,27 @@ def selftest(stream=None) -> int:
             f"OK: 2-stripe merge bit-identical to 1-stripe "
             f"({len(rows)} rows, {matched} matched)"
         )
+        # overlap smoke: the SAME manifest through the in-process
+        # software pipeline at depth 1 (the synchronous dispatch ->
+        # await -> write loop) and depth 3 — the async-submit /
+        # FIFO-await contract must keep the JSONL bit-identical at
+        # every pipeline depth, and both must match the striped runs
+        from licensee_tpu.projects.batch_project import BatchProject
+
+        overlap_out = {}
+        for depth in (1, 3):
+            out = os.path.join(tmpdir, f"out-depth{depth}.jsonl")
+            project = BatchProject(
+                paths, batch_size=16, mesh=None, pipeline_depth=depth
+            )
+            project.run(out, resume=False)
+            with open(out, "rb") as f:
+                overlap_out[depth] = f.read()
+        if overlap_out[1] != overlap_out[3]:
+            say("FAIL: depth-3 pipeline output != synchronous output")
+            return 1
+        if overlap_out[1] != outputs[1]:
+            say("FAIL: pipelined output != striped-run output")
+            return 1
+        say("OK: overlap pipeline depth 1/3 bit-identical to sync")
     return 0

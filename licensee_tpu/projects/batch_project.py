@@ -56,6 +56,19 @@ manifest-striping contract became a one-command launcher
 (`licensee-tpu batch-detect --stripes N`, parallel/stripes.py) that
 spawns co-located stripe processes under a supervisor and merges their
 shards/stats/expositions deterministically.
+
+Update r8: the run loop is an explicit bounded software pipeline over
+the non-blocking device seam (`dispatch_chunks_async` -> DeviceFuture,
+kernels/batch.py): up to ``pipeline_depth`` dispatched groups stay in
+flight while the workers featurize ahead and the writer thread drains
+behind, groups are awaited strictly FIFO (output bit-identical at
+every depth, resume invariant untouched), and per-lane occupancy
+(featurize | device | writer) + the in-flight-chunks gauge surface
+through obs/pipeline.py — at-scale files/s now tracks
+``1/max(featurize_lane, writer_lane)`` with the device term invisible
+(the overlap row of bench.py's host model).  ``--device-lanes`` adds
+in-stripe multi-chip scoring: whole chunks round-robin across the
+stripe's visible chips, K device lanes behind one featurize lane.
 """
 
 from __future__ import annotations
@@ -159,6 +172,10 @@ class BatchStats:
     # SURVEY.md §5; read+featurize accumulate across worker threads, so
     # they can exceed elapsed on multi-core hosts)
     stage_seconds: dict = field(default_factory=dict)
+    # the run's lane-occupancy snapshot (obs/pipeline.py PipelineLanes
+    # .occupancy()): busy fraction per featurize/device/writer lane —
+    # the overlap proof a bench or operator reads off a finished run
+    pipeline: dict = field(default_factory=dict)
 
     def add_stage(self, stage: str, seconds: float) -> None:
         self.stage_seconds[stage] = self.stage_seconds.get(stage, 0.0) + seconds
@@ -171,6 +188,8 @@ class BatchStats:
         d = dict(self.__dict__)
         if not d["routed"]:
             del d["routed"]  # fixed-mode runs keep their old stats shape
+        if not d["pipeline"]:
+            del d["pipeline"]  # unpipelined paths keep their old shape
         d["stage_seconds"] = {
             k: round(v, 4) for k, v in self.stage_seconds.items()
         }
@@ -208,6 +227,8 @@ class BatchProject:
         coalesce_batches: int = 32,
         tracer=None,
         corpus_source: str | None = None,
+        pipeline_depth: int = 2,
+        device_lanes: int | str | None = None,
     ):
         from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -248,6 +269,10 @@ class BatchProject:
             mesh=mesh,
             mode=mode,
             closest=closest,
+            # --device-lanes: round-robin whole chunks across this
+            # stripe's visible chips (K device lanes behind one
+            # featurize lane); overrides mesh sharding when set
+            lanes=device_lanes,
         )
         if self.classifier.pad_batch_to != batch_size:
             raise ValueError(
@@ -271,6 +296,18 @@ class BatchProject:
                 f"coalesce_batches must be >= 1, got {coalesce_batches!r}"
             )
         self.coalesce_batches = int(coalesce_batches)
+        # --pipeline-depth: how many dispatched device GROUPS may be in
+        # flight at once.  1 = the synchronous path (dispatch, await,
+        # write — the bit-identical baseline); >= 2 = the software
+        # pipeline, where the host featurizes chunk N+1 and the writer
+        # drains chunk N-1 while the device scores chunk N.  Output is
+        # identical at every depth: groups are awaited strictly FIFO
+        # and rows carry sequence numbers into the writer.
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth!r}"
+            )
+        self.pipeline_depth = int(pipeline_depth)
         self.stats = BatchStats()
         # Content-dedupe: real license corpora are dominated by verbatim
         # copies of a few hundred texts, so a content-hash -> result
@@ -535,6 +572,14 @@ class BatchProject:
         starts = deque(range(done, len(self.paths), self.batch_size))
         t_run = time.perf_counter()
         t_progress = t_run
+        # lane-occupancy clocks (obs/pipeline.py): featurize (produce
+        # workers), device (submit -> future resolution), writer (the
+        # writer thread's loop body), plus the in-flight-chunks gauge —
+        # registered on the process registry so --prom-file carries the
+        # overlap proof of this run
+        from licensee_tpu.obs import PipelineLanes, get_registry
+
+        lanes = PipelineLanes().register(get_registry())
         use_procs = self.featurize_procs > 0
         if use_procs:
             import multiprocessing
@@ -560,11 +605,21 @@ class BatchProject:
                 f.write("\n")
             futures: deque = deque()
 
+            def produce_traced(start: int):
+                # the featurize lane is busy while >= 1 produce worker
+                # is inside (read + featurize, the parallel stage)
+                with lanes.lane("featurize"):
+                    return self._produce(start)
+
             def submit_next() -> None:
                 if not starts:
                     return
                 start = starts.popleft()
                 if use_procs:
+                    # worker processes: the lane clock cannot reach into
+                    # the children, so featurize occupancy reads 0 under
+                    # --featurize-procs (stats.stage_seconds still
+                    # carries the thread-seconds)
                     futures.append(
                         pool.submit(
                             _mp_produce,
@@ -575,7 +630,7 @@ class BatchProject:
                         )
                     )
                 else:
-                    futures.append(pool.submit(self._produce, start))
+                    futures.append(pool.submit(produce_traced, start))
 
             for _ in range(self.inflight):
                 submit_next()
@@ -585,10 +640,14 @@ class BatchProject:
             # a dedupe-heavy stream leaves each batch a handful of todo
             # rows, and dispatching those per-batch pays a full padded
             # chunk + device round trip each (78% of elapsed on the 1M
-            # dup-heavy run).  pending: dispatched GROUPS in flight (<=2).
-            # Writes stay in manifest order: groups finish FIFO and keep
-            # their batches in arrival order, so the resume invariant
-            # (rows n written => rows 0..n-1 written) is untouched.
+            # dup-heavy run).  pending: ASYNC-dispatched groups in
+            # flight, bounded by pipeline_depth — the software pipeline:
+            # the device scores group N while the workers featurize
+            # N+1..N+depth and the writer thread drains N-1.  Writes
+            # stay in manifest order: groups are awaited strictly FIFO
+            # and keep their batches in arrival order, so the resume
+            # invariant (rows n written => rows 0..n-1 written) is
+            # untouched at every depth.
             pending: deque = deque()
             gather: list = []
             gather_todo = 0
@@ -604,10 +663,16 @@ class BatchProject:
                 t0 = time.perf_counter()
                 prepareds = [b[6] for b in batches]
                 if any(p.todo for p in prepareds):
+                    # non-blocking submit: the future resolves in the
+                    # FIFO await below, never here
                     merged = self.classifier.merge_prepared(prepareds)
-                    device_out = self.classifier.dispatch_chunks(merged)
+                    device_fut = self.classifier.dispatch_chunks_async(
+                        merged
+                    )
+                    lanes.enter("device")
+                    lanes.chunk_inflight(len(device_fut))
                 else:
-                    merged, device_out = None, None
+                    merged, device_fut = None, None
                 dt = time.perf_counter() - t0
                 self.stats.add_stage("dispatch", dt)
                 if merged is not None:
@@ -619,7 +684,7 @@ class BatchProject:
                                 "dispatch", dt, t0=t0,
                                 note=f"group={len(batches)}",
                             )
-                pending.append((batches, merged, device_out))
+                pending.append((batches, merged, device_fut))
 
             # -- the writer thread --
             #
@@ -663,6 +728,7 @@ class BatchProject:
                         return
                     if writer_err:
                         continue  # drain: the producer must never block
+                    lanes.enter("writer")
                     try:
                         seq, batch = item
                         if seq != expect_seq:
@@ -787,6 +853,8 @@ class BatchProject:
                             )
                     except BaseException as exc:  # noqa: BLE001
                         writer_err.append(exc)
+                    finally:
+                        lanes.exit_("writer")
 
             writer = threading.Thread(
                 target=write_loop, name="batch-writer", daemon=True
@@ -798,9 +866,9 @@ class BatchProject:
                     if writer_err:
                         break  # the writer's failure is raised below
                     # pull produced batches into the coalescing buffer;
-                    # keep up to 2 dispatched groups in flight before
-                    # draining
-                    while futures and len(pending) < 2:
+                    # keep up to pipeline_depth dispatched groups in
+                    # flight before draining the oldest
+                    while futures and len(pending) < self.pipeline_depth:
                         (chunk, read_errs, keys, preset, dup_of, routes,
                          prepared, contents, pre_rows,
                          (t_read, t_feat)) = futures.popleft().result()
@@ -872,11 +940,18 @@ class BatchProject:
                         # stream tail (or an under-filled group with
                         # nothing else in flight): dispatch what we have
                         dispatch_gathered()
-                    batches, merged, device_out = pending.popleft()
+                    # await the OLDEST group (FIFO keeps manifest
+                    # order): by now the device has had the whole
+                    # featurize/coalesce interval to finish it, so the
+                    # await is usually a no-op resolve
+                    batches, merged, device_fut = pending.popleft()
                     t0 = time.perf_counter()
                     if merged is not None:
+                        outs = device_fut.result()
+                        lanes.exit_("device")
+                        lanes.chunk_inflight(-len(device_fut))
                         self.classifier.finish_chunks(
-                            merged, device_out, self.threshold
+                            merged, outs, self.threshold
                         )
                         self.classifier.scatter_merged(
                             [b[6] for b in batches], merged
@@ -897,6 +972,7 @@ class BatchProject:
                 writer.join()
             if writer_err:
                 raise writer_err[0]
+        self.stats.pipeline = lanes.occupancy()
         self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats
 

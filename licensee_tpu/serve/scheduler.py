@@ -19,15 +19,30 @@ MicroBatcher bridges the two:
     waiting (flush reason "full") or the OLDEST row has waited
     ``max_delay_ms`` (flush reason "deadline" — bounded latency for a
     partial batch).  The gathered rows merge via the kernels/batch.py
-    packers (merge_prepared) and dispatch padded to the smallest
-    fitting BUCKET shape, so the set of compiled device shapes is the
-    fixed bucket list, never per-request.
+    packers (merge_prepared) and SUBMIT asynchronously
+    (``dispatch_chunks_async``) padded to the smallest fitting BUCKET
+    shape, so the set of compiled device shapes is the fixed bucket
+    list, never per-request.  The submit path never blocks on the
+    device (the ``blocking-device-call`` analysis rule): the scheduler
+    thread goes straight back to gathering the next flush while the
+    device scores this one.
+
+  completion (one background thread)
+    Submitted groups ride a handoff queue to the completion thread,
+    bounded by an in-flight semaphore (``pipeline_depth`` permits,
+    held from submit until the group is fully answered — the overlap
+    pipeline's backpressure; depth 1 is the synchronous flush),
+    which awaits each DeviceFuture, finishes scores, fills the cache,
+    releases coalesced followers, and fires the requests' ``done``
+    events.  In steady state the await is a no-op: the device finished
+    while the scheduler was gathering flush N+1.
 
   degradation
     A request whose own deadline expired while queued answers
     ``deadline_exceeded`` instead of occupying a device slot; a device
-    dispatch that raises falls back to the host scalar Dice chain
-    (matchers/dice.py — reference semantics) so verdicts keep flowing
+    submit (or its future) that raises falls back to the host scoring
+    of the request's admitted corpus epoch (serve/reload.py
+    ``host_best`` — reference semantics) so verdicts keep flowing
     while the device is sick.
 """
 
@@ -35,15 +50,22 @@ from __future__ import annotations
 
 import math
 import os
+import queue as queue_mod
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 
+import numpy as np
+
 import licensee_tpu
 from licensee_tpu.corpus.artifact import short_fingerprint
 from licensee_tpu.kernels.batch import BlobResult
-from licensee_tpu.obs import NativeProfileSource, Observability
+from licensee_tpu.obs import (
+    NativeProfileSource,
+    Observability,
+    PipelineLanes,
+)
 from licensee_tpu.serve.cache import ResultCache
 from licensee_tpu.serve.featurize import (
     UNROUTED,
@@ -141,6 +163,8 @@ class MicroBatcher:
         threshold: float | None = None,
         buckets: tuple[int, ...] | None = None,
         start: bool = True,
+        pipeline_depth: int = 2,
+        warm_start: bool = False,
         registry=None,
         tracing: bool = True,
         trace_sample: float = 0.01,
@@ -247,6 +271,7 @@ class MicroBatcher:
             "rejected": 0,
             "expired": 0,
             "fallbacks": 0,
+            "completion_errors": 0,
             "reloads": 0,
             "reload_failed": 0,
             "reload_rejected": 0,
@@ -254,7 +279,34 @@ class MicroBatcher:
         self._flush_reasons = {"full": 0, "deadline": 0, "drain": 0}
         self._bucket_counts: dict[int, int] = {}
         self._thread: threading.Thread | None = None
+        # -- the overlap pipeline: submitted device groups ride this
+        # queue to the completion thread.  The bound is the SEMAPHORE,
+        # not the queue: a permit is acquired before each async submit
+        # and released only after the completion lane fully finishes
+        # the group, so at most ``pipeline_depth`` groups are ever
+        # submitted-but-unfinished — depth 1 really is one flush in
+        # flight (the synchronous behavior, finished on the completion
+        # thread), and the scheduler blocks on the permit (never on
+        # the device itself) --
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth!r}"
+            )
+        self.pipeline_depth = int(pipeline_depth)
+        self._device_q: queue_mod.Queue = queue_mod.Queue()
+        self._inflight_sem = threading.Semaphore(self.pipeline_depth)
+        self._completion: threading.Thread | None = None
+        # serve-side lane clocks: featurize (admission), device
+        # (submit -> future resolved), writer (response finishing on
+        # the completion thread) + the in-flight-chunks gauge
+        self._lanes = PipelineLanes().register(self.obs.registry)
+        self._warm_start = bool(warm_start)
         self._register_metrics()
+        if self._warm_start:
+            # cold-start fix: compile every bucket shape NOW, not on
+            # the first live request that happens to flush at it (the
+            # per-shape cost lands in dispatch_stats()["per_shape"])
+            self.warmup()
         if start:
             self.start()
 
@@ -419,11 +471,54 @@ class MicroBatcher:
             self._thread = threading.Thread(
                 target=self._loop, name="micro-batcher", daemon=True
             )
+            self._completion = threading.Thread(
+                target=self._completion_loop,
+                name="micro-batcher-completion",
+                daemon=True,
+            )
             self._thread.start()
+            self._completion.start()
+
+    def warmup(self, classifier=None) -> dict:
+        """Pre-compile every bucket pad shape on ``classifier`` (the
+        active one by default) so no live request ever pays a jit
+        compile: one zero-row probe dispatch per bucket through the
+        real device path.  Used at startup (``warm_start=True``) and on
+        the candidate classifier of a corpus reload BEFORE the swap —
+        the old corpus serves while the new one compiles.  Returns the
+        classifier's per-shape compile attribution (also permanently
+        visible in ``stats()["device"]["per_shape"]``).  No-op for
+        host-only / corpus-free classifiers."""
+        clf = classifier if classifier is not None else self.classifier
+        if (
+            getattr(clf, "_fn", None) is None
+            or getattr(clf, "corpus", None) is None
+        ):
+            return {}
+        from licensee_tpu.kernels.batch import PreparedBatch
+
+        W = clf.corpus.n_lanes
+        probe = PreparedBatch(
+            results=[None],
+            bits=np.zeros((1, W), dtype=np.uint32),
+            n_words=np.zeros(1, dtype=np.int32),
+            lengths=np.zeros(1, dtype=np.int32),
+            cc_fp=np.zeros(1, dtype=bool),
+            todo=[0],
+            sections=None,
+            compact=True,
+        )
+        for bucket in self.buckets:
+            clf.dispatch_chunks_async(probe, pad_to=bucket).result()
+        stats = clf.dispatch_stats()
+        return {
+            "shapes": stats["shapes"],
+            "per_shape": stats["per_shape"],
+        }
 
     def close(self) -> None:
         """Stop accepting, drain the queue (every queued request still
-        answers), and join the scheduler thread."""
+        answers), and join the scheduler + completion threads."""
         with self._cond:
             self._closed = True  # later submits raise instead of hanging
             if not self._running:
@@ -440,8 +535,15 @@ class MicroBatcher:
                 leftovers = leftovers[self.max_batch :]
             return
         if self._thread is not None:
+            # scheduler first (its final drain still submits groups),
+            # then the sentinel lets the completion thread finish the
+            # tail of the pipeline and exit
             self._thread.join()
             self._thread = None
+        if self._completion is not None:
+            self._device_q.put(None)
+            self._completion.join()
+            self._completion = None
 
     def __enter__(self):
         return self
@@ -507,6 +609,13 @@ class MicroBatcher:
                 with self._lock:
                     self._counters["reload_failed"] += 1
                 raise reload_mod.ReloadRejectedError(problems)
+            if self._warm_start:
+                # pre-compile EVERY bucket shape on the candidate while
+                # the old corpus is still serving: the first post-swap
+                # flush of any bucket must be a steady-state enqueue,
+                # never a compile cliff (validate_classifier only
+                # warmed the full-batch probe shape)
+                self.warmup(new_clf)
             new_fp = reload_mod.corpus_fingerprint(new_clf.corpus)
             with self._cond:
                 if self._closed:
@@ -615,10 +724,11 @@ class MicroBatcher:
                 self._counters["coalesced"] += 1
                 return req
         t_feat = time.perf_counter()
-        prepared = featurize_request(
-            clf, raw, filename,
-            route if self.mode == "auto" else None,
-        )
+        with self._lanes.lane("featurize"):
+            prepared = featurize_request(
+                clf, raw, filename,
+                route if self.mode == "auto" else None,
+            )
         dt_feat = time.perf_counter() - t_feat
         self.stats_stages.record("featurize", dt_feat)
         if trace is not None:
@@ -731,6 +841,12 @@ class MicroBatcher:
                 self._flush(batch, reason)
 
     def _flush(self, batch: list[ServeRequest], reason: str) -> None:
+        """One gathered micro-batch: record waits, SUBMIT the live rows
+        per classifier epoch (non-blocking), answer the fully-expired
+        rows, and hand the in-flight groups to the completion thread.
+        The scheduler thread never waits on the device here — only,
+        briefly, on an in-flight permit when ``pipeline_depth``
+        flushes are already submitted and unfinished."""
         t0 = time.perf_counter()
 
         def unexpired(r: ServeRequest) -> bool:
@@ -744,7 +860,8 @@ class MicroBatcher:
             # ownership handoff, not a race: the scheduler thread
             # popped req from the queue under the SAME lock submit()
             # held when it wrote enqueued_at, and a dequeued request's
-            # fields belong to this thread alone until done.set()
+            # fields belong to the scheduler/completion pair alone
+            # until done.set()
             # analysis: disable=lock-discipline
             enq = req.enqueued_at or req.created
             wait = t0 - enq
@@ -757,6 +874,7 @@ class MicroBatcher:
                 )
             if alive:
                 live.append(req)
+        pends: list[dict] = []
         if live:
             # one device batch PER CLASSIFIER EPOCH: rows admitted
             # before a corpus reload were featurized under the old
@@ -767,76 +885,139 @@ class MicroBatcher:
             for req in live:
                 by_clf.setdefault(id(req.clf), []).append(req)
             for grp in by_clf.values():
-                self._score_group(grp, t0)
-            dt = time.perf_counter() - t0
-            self.stats_stages.record("device", dt)
+                # the pipeline bound, taken BEFORE the async submit:
+                # at most pipeline_depth groups submitted-but-
+                # unfinished, so depth 1 means the previous flush is
+                # fully answered before this one touches the device
+                self._inflight_sem.acquire()
+                pends.append(self._submit_group(grp, t0))
             with self._lock:
                 self._flush_reasons[reason] += 1
-                self._batch_ewma = (
-                    dt
-                    if self._batch_ewma is None
-                    else 0.8 * self._batch_ewma + 0.2 * dt
-                )
-        done_t = time.perf_counter()
-        for req in batch:
-            # rows nobody could score kept result=None; scored rows
-            # carry the device (or fallback) verdict
-            scored = req.result
-            if (
-                scored is not None
-                and not scored.error
-                and req.cache_key is not None
-            ):
-                self.cache.put(req.cache_key, scored)
-            # unregister BEFORE signalling: once the key leaves
-            # _inflight no new follower can attach, so the snapshot
-            # below is complete
-            with self._lock:
-                if self._inflight.get(req.cache_key) is req:
-                    del self._inflight[req.cache_key]
-                followers = list(req.followers)
-                self._counters["completed"] += 1 + len(followers)
-            for member in (req, *followers):
-                if scored is not None and unexpired(member):
-                    # followers inherit the verdict (identical content
-                    # key => identical classification) and count as
-                    # deduplicated answers, like cache hits
-                    member.result = scored
-                    member.cached = member is not req
-                    status = "coalesced" if member is not req else "ok"
-                else:
-                    member.result = BlobResult(
-                        None, None, 0.0, error="deadline_exceeded"
-                    )
-                    status = "deadline_exceeded"
-                    with self._lock:
-                        self._counters["expired"] += 1
-                self.stats_stages.record("total", done_t - member.created)
-                if member.trace is not None:
-                    self.obs.tracer.finish(member.trace, status)
-                member.done.set()
+        # rows every member of which already expired: answered now,
+        # without ever occupying a device slot
+        live_ids = {id(r) for r in live}
+        dead = [r for r in batch if id(r) not in live_ids]
+        if dead:
+            self._finish_requests(dead, t0, time.perf_counter())
+        for pend in pends:
+            # not a race: start() writes _completion BEFORE the
+            # scheduler thread exists, and close() clears it only AFTER
+            # joining that thread — the one lock-free read here sees
+            # either the live thread or the unstarted-drain None
+            # analysis: disable=lock-discipline
+            if self._completion is None:
+                # unstarted batcher draining in close(): complete inline
+                try:
+                    self._complete_group(pend)
+                finally:
+                    self._inflight_sem.release()
+            else:
+                # the pipeline handoff — never blocks (the semaphore
+                # above already bounded the in-flight groups)
+                self._device_q.put(pend)
 
-    def _score_group(self, live: list[ServeRequest], t0: float) -> int:
-        """Merge, dispatch, and finish one classifier-epoch group of a
-        flush (every member shares ``req.clf``).  Device failure falls
-        back to the host scalar chain per request, same as before."""
+    def _submit_group(self, live: list[ServeRequest], t0: float) -> dict:
+        """Merge and ASYNC-submit one classifier-epoch group of a flush
+        (every member shares ``req.clf``).  Returns the pending record
+        the completion thread finishes; a submit-time failure rides it
+        as ``err`` so the fallback runs on the completion lane, not
+        here."""
         group = [r.prepared for r in live]
         n = sum(len(p.todo) for p in group)
         bucket = self.bucket_for(n)
         clf = live[0].clf
-        device_err = None
+        merged = future = err = None
+        t_sub = time.perf_counter()
         try:
             merged = clf.merge_prepared(group)
-            outs = clf.dispatch_chunks(merged, pad_to=bucket)
-            clf.finish_chunks(merged, outs, self.threshold)
-            clf.scatter_merged(group, merged)
-            for req in live:
-                req.result = req.prepared.results[0]
+            future = clf.dispatch_chunks_async(merged, pad_to=bucket)
+            self._lanes.enter("device")
+            self._lanes.chunk_inflight(len(future))
         except Exception as exc:  # noqa: BLE001 — device failure containment
-            device_err = exc
+            err = exc
+            future = None
+        return {
+            "live": live,
+            "merged": merged,
+            "future": future,
+            "bucket": bucket,
+            "n": n,
+            "clf": clf,
+            "t0": t0,
+            # the submit half's cost: added to the completion half's
+            # await+finish interval to form the device SERVICE time —
+            # never the time the pend sat queued behind earlier flushes
+            "submit_s": time.perf_counter() - t_sub,
+            "err": err,
+        }
+
+    def _completion_loop(self) -> None:
+        while True:
+            pend = self._device_q.get()
+            if pend is None:
+                return
+            try:
+                self._complete_group(pend)
+            except BaseException as exc:  # noqa: BLE001 — lane must survive
+                # a completion failure must never end this thread: the
+                # in-flight permits would never be released, the
+                # scheduler would block forever acquiring one, and
+                # close() would deadlock behind it.  Answer the group's
+                # waiters with an error row and keep draining.
+                with self._lock:
+                    self._counters["completion_errors"] += 1
+                for req in pend["live"]:
+                    with self._lock:
+                        if self._inflight.get(req.cache_key) is req:
+                            del self._inflight[req.cache_key]
+                        followers = list(req.followers)
+                    for member in (req, *followers):
+                        if member.result is None:
+                            member.result = BlobResult(
+                                None, None, 0.0,
+                                error=f"completion_error: {exc}",
+                            )
+                        member.done.set()
+            finally:
+                self._inflight_sem.release()
+
+    def _complete_group(self, pend: dict) -> None:
+        """Await one submitted group, finish its scores (or run the
+        per-request host fallback), fill the cache, and fire ``done``
+        for every member — the completion half of the async flush."""
+        live: list[ServeRequest] = pend["live"]
+        clf = pend["clf"]
+        merged = pend["merged"]
+        future = pend["future"]
+        bucket, n, t0 = pend["bucket"], pend["n"], pend["t0"]
+        device_err = pend["err"]
+        # service clock starts when THIS group is picked up — the time
+        # it spent queued behind earlier flushes is pipeline wait, not
+        # device time, and must not inflate the ewma that prices
+        # retry_after
+        t_begin = time.perf_counter()
+        if future is not None:
+            try:
+                outs = future.result()  # the await — only this lane blocks
+                clf.finish_chunks(merged, outs, self.threshold)
+                clf.scatter_merged([r.prepared for r in live], merged)
+                for req in live:
+                    req.result = req.prepared.results[0]
+            except Exception as exc:  # noqa: BLE001 — device failure containment
+                device_err = exc
+            self._lanes.exit_("device")
+            self._lanes.chunk_inflight(-len(future))
+        if device_err is not None:
             with self._lock:
                 self._counters["fallbacks"] += len(live)
-        dt_device = time.perf_counter() - t0
+        dt_device = pend["submit_s"] + (time.perf_counter() - t_begin)
+        self.stats_stages.record("device", dt_device)
+        with self._lock:
+            self._batch_ewma = (
+                dt_device
+                if self._batch_ewma is None
+                else 0.8 * self._batch_ewma + 0.2 * dt_device
+            )
         for req in live:
             if req.trace is not None:
                 # the batch's device attempt, shared by every rider
@@ -864,7 +1045,60 @@ class MicroBatcher:
             self._bucket_counts[bucket] = (
                 self._bucket_counts.get(bucket, 0) + 1
             )
-        return n
+        self._finish_requests(live, t0, time.perf_counter())
+
+    def _finish_requests(
+        self, reqs: list[ServeRequest], t0: float, done_t: float
+    ) -> None:
+        """Answer a set of flushed requests (scored, fallback-scored,
+        or expired) and their coalesced followers.  ``t0`` is the flush
+        time the expiry verdicts were frozen at — a member whose
+        deadline lapsed DURING device scoring still gets the verdict,
+        exactly like the synchronous path did."""
+
+        def unexpired(r: ServeRequest) -> bool:
+            return r.deadline is None or t0 <= r.deadline
+
+        with self._lanes.lane("writer"):
+            for req in reqs:
+                # rows nobody could score kept result=None; scored rows
+                # carry the device (or fallback) verdict
+                scored = req.result
+                if (
+                    scored is not None
+                    and not scored.error
+                    and req.cache_key is not None
+                ):
+                    self.cache.put(req.cache_key, scored)
+                # unregister BEFORE signalling: once the key leaves
+                # _inflight no new follower can attach, so the snapshot
+                # below is complete
+                with self._lock:
+                    if self._inflight.get(req.cache_key) is req:
+                        del self._inflight[req.cache_key]
+                    followers = list(req.followers)
+                    self._counters["completed"] += 1 + len(followers)
+                for member in (req, *followers):
+                    if scored is not None and unexpired(member):
+                        # followers inherit the verdict (identical
+                        # content key => identical classification) and
+                        # count as deduplicated answers, like cache hits
+                        member.result = scored
+                        member.cached = member is not req
+                        status = "coalesced" if member is not req else "ok"
+                    else:
+                        member.result = BlobResult(
+                            None, None, 0.0, error="deadline_exceeded"
+                        )
+                        status = "deadline_exceeded"
+                        with self._lock:
+                            self._counters["expired"] += 1
+                    self.stats_stages.record(
+                        "total", done_t - member.created
+                    )
+                    if member.trace is not None:
+                        self.obs.tracer.finish(member.trace, status)
+                    member.done.set()
 
     def _scalar_fallback(self, req: ServeRequest) -> BlobResult:
         """Host path for one Dice-bound request — the graceful-
@@ -965,12 +1199,18 @@ class MicroBatcher:
             "cache": self.cache.stats(),
             "latency_ms": self.stats_stages.snapshot(),
             "device": dispatch() if callable(dispatch) else None,
+            # the overlap pipeline's live occupancy (featurize lane =
+            # admission featurize, device lane = submit -> resolved,
+            # writer lane = response finishing) + in-flight chunks
+            "pipeline": self._lanes.occupancy(),
             "tracing": self.obs.tracer.stats(),
             "config": {
                 "mode": self.mode,
                 "max_batch": self.max_batch,
                 "max_delay_ms": self.max_delay * 1000.0,
                 "queue_depth": self.queue_depth,
+                "pipeline_depth": self.pipeline_depth,
+                "warm_start": self._warm_start,
                 "cache_entries": self.cache.capacity,
                 "cache_bytes": self.cache.max_bytes,
                 "deadline_ms": self.deadline_ms,

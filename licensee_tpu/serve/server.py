@@ -511,12 +511,13 @@ def selftest(verbose: bool = True) -> int:
         # -- the degradation exemplar: a forced device failure routes the
         # request through the scalar fallback, so its trace carries ALL
         # FIVE span kinds; trace_slow_ms=0 makes it a slow exemplar --
-        original = batcher.classifier.dispatch_chunks
-        batcher.classifier.dispatch_chunks = _raise_injected
+        # the flush path's device seam is the async submit
+        original = batcher.classifier.dispatch_chunks_async
+        batcher.classifier.dispatch_chunks_async = _raise_injected
         try:
             fb = batcher.classify(body + "\nzqfb zqfc\n", "LICENSE")
         finally:
-            batcher.classifier.dispatch_chunks = original
+            batcher.classifier.dispatch_chunks_async = original
         if (fb.key, fb.matcher) != ("mit", "dice"):
             problems.append(f"fallback verdict: {fb.as_dict()}")
         exemplar = None

@@ -1,0 +1,18 @@
+"""OK: the sync wrapper's own definition is the sanctioned home of the
+await, and one-shot callers off the pipeline may use it freely."""
+
+
+class Classifier:
+    def dispatch_chunks_async(self, prepared):
+        return self._submit(prepared)
+
+    def dispatch_chunks(self, prepared):
+        # the convenience wrapper: submit + await in one call
+        return self.dispatch_chunks_async(prepared).result()
+
+    def classify_blobs(self, contents):
+        # a one-shot path, not reachable from the pipeline entries
+        prepared = self.prepare_batch(contents)
+        outs = self.dispatch_chunks(prepared)
+        self.finish_chunks(prepared, outs, None)
+        return prepared.results

@@ -1,0 +1,14 @@
+"""TP: the scheduler flush path calls the synchronous submit+await
+wrapper instead of the async seam — the device lane serializes."""
+
+
+class Batcher:
+    def _flush(self, batch):
+        merged = self.classifier.merge_prepared(batch)
+        outs = self.classifier.dispatch_chunks(merged)  # BAD
+        self.classifier.finish_chunks(merged, outs, self.threshold)
+
+    def _submit_group(self, live):
+        group = [r.prepared for r in live]
+        merged = self.classifier.merge_prepared(group)
+        return self.classifier.dispatch_chunks(merged, pad_to=64)  # BAD

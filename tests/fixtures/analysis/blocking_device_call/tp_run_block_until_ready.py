@@ -1,0 +1,13 @@
+"""TP: the batch run loop synchronizes with the device per chunk —
+block_until_ready on the submit path defeats the overlap pipeline."""
+
+import jax
+
+
+class Project:
+    def run(self, output):
+        fut = self.classifier.dispatch_chunks_async(self.prepared)
+        for arr in fut.arrays:
+            arr.block_until_ready()  # BAD
+        jax.block_until_ready(fut.arrays)  # BAD
+        return fut

@@ -42,6 +42,7 @@ CORPUS = os.path.join(
 DIR_TO_RULE = {
     "lock_discipline": "lock-discipline",
     "blocking_call": "blocking-call",
+    "blocking_device_call": "blocking-device-call",
     "resource_leak": "resource-leak",
     "tracer_purity": "tracer-purity",
     "wallclock_time": "wallclock-time",

@@ -111,6 +111,11 @@ def _fat_details() -> dict:
                 "serial_us_per_blob": 99999.9,
                 "amdahl_ceiling_files_per_sec": 99_999_999.9,
             },
+            "overlap": {
+                "speedup": 99999.999,
+                "identical_output": True,
+                "lane_model": {"measured_over_predicted": 99999.999},
+            },
         },
         "stripes": {
             "files": 1_000_000,
@@ -193,6 +198,9 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert (
         d["host_model"]["amdahl_ceiling_files_per_sec"] == 99_999_999.9
     )
+    assert d["host_model"]["overlap_speedup"] == 99999.999
+    assert d["host_model"]["overlap_identical"] is True
+    assert d["host_model"]["overlap_vs_lane_model"] == 99999.999
     assert d["stripes"]["n"] == 4
     assert d["stripes"]["files_per_sec_1"] == 99_999_999.9
     assert d["stripes"]["files_per_sec_n"] == 99_999_999.9

@@ -129,13 +129,15 @@ def test_coalesced_run_output_order_and_dispatch_count(tmp_path):
         paths, batch_size=2, workers=1, inflight=1, coalesce_batches=4
     )
     calls = []
-    orig = project.classifier.dispatch_chunks
+    # the pipeline's device seam is the ASYNC submit (run() never calls
+    # the sync wrapper -- the blocking-device-call analysis rule)
+    orig = project.classifier.dispatch_chunks_async
 
     def counting(prepared):
         calls.append(len(prepared.todo))
         return orig(prepared)
 
-    project.classifier.dispatch_chunks = counting
+    project.classifier.dispatch_chunks_async = counting
     out = tmp_path / "out.jsonl"
     stats = project.run(str(out), resume=False)
     rows = [json.loads(line) for line in out.read_text().splitlines()]
@@ -160,13 +162,15 @@ def test_coalesce_cap_bounds_group_size(tmp_path):
         paths, batch_size=2, workers=1, inflight=1, coalesce_batches=1
     )
     calls = []
-    orig = project.classifier.dispatch_chunks
+    # the pipeline's device seam is the ASYNC submit (run() never calls
+    # the sync wrapper -- the blocking-device-call analysis rule)
+    orig = project.classifier.dispatch_chunks_async
 
     def counting(prepared):
         calls.append(len(prepared.todo))
         return orig(prepared)
 
-    project.classifier.dispatch_chunks = counting
+    project.classifier.dispatch_chunks_async = counting
     out = tmp_path / "out.jsonl"
     project.run(str(out), resume=False)
     assert calls == [2, 2]

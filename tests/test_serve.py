@@ -263,16 +263,17 @@ def test_device_failure_falls_back_to_scalar_dice(clf, mit_body):
     assert (expected.key, expected.matcher) == ("mit", "dice")
 
     with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
-        original = b.classifier.dispatch_chunks
+        # the flush path's device seam is the ASYNC submit now
+        original = b.classifier.dispatch_chunks_async
 
         def broken(*args, **kwargs):
             raise RuntimeError("injected device failure")
 
-        b.classifier.dispatch_chunks = broken
+        b.classifier.dispatch_chunks_async = broken
         try:
             result = b.classify(blob, "LICENSE")
         finally:
-            b.classifier.dispatch_chunks = original
+            b.classifier.dispatch_chunks_async = original
         assert (result.key, result.matcher) == ("mit", "dice")
         assert result.confidence == expected.confidence
         assert b.stats()["scheduler"]["fallbacks"] == 1
@@ -280,6 +281,175 @@ def test_device_failure_falls_back_to_scalar_dice(clf, mit_body):
         again = b.classify(blob, "LICENSE")
         assert again.confidence == expected.confidence
         assert b.stats()["scheduler"]["cache_hits"] == 1
+
+
+def test_device_failure_at_await_with_chunks_in_flight(clf, mit_body):
+    """The async split means the device can ALSO fail at await time,
+    on the completion thread, with several submitted flushes in
+    flight — every rider of every broken group must still answer via
+    the host fallback, and the batcher must keep serving afterwards."""
+    expected = clf.classify_blobs([dice_blob(mit_body, "aw0")])[0]
+    assert (expected.key, expected.matcher) == ("mit", "dice")
+
+    class _FailingFuture:
+        def __len__(self):
+            return 1
+
+        def result(self):
+            raise RuntimeError("injected await failure")
+
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), pipeline_depth=2
+    ) as b:
+        original = b.classifier.dispatch_chunks_async
+
+        def submit_ok_await_fails(prepared, pad_to=None):
+            return _FailingFuture()  # the SUBMIT half stays healthy
+
+        b.classifier.dispatch_chunks_async = submit_ok_await_fails
+        try:
+            reqs = [
+                b.submit(dice_blob(mit_body, f"aw{i}"), "LICENSE")
+                for i in range(6)
+            ]
+            results = [r.wait(60.0) for r in reqs]
+        finally:
+            b.classifier.dispatch_chunks_async = original
+        for res in results:
+            assert (res.key, res.matcher) == ("mit", "dice")
+            assert res.confidence == expected.confidence
+        assert b.stats()["scheduler"]["fallbacks"] >= 6
+        # the pipeline recovered: the next flush rides the real device
+        post = b.classify(dice_blob(mit_body, "aw-post"), "LICENSE")
+        assert (post.key, post.matcher) == ("mit", "dice")
+
+
+def test_completion_thread_survives_a_completion_failure(clf, mit_body):
+    """An exception escaping the completion half (here: the fallback
+    itself dying after a device failure) must not end the completion
+    thread — the bounded handoff queue would fill and wedge the
+    scheduler.  The group's waiters get an error row, the counter
+    ticks, and the NEXT flush rides the pipeline normally."""
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), pipeline_depth=2
+    ) as b:
+        original = b.classifier.dispatch_chunks_async
+
+        def broken(*args, **kwargs):
+            raise RuntimeError("injected device failure")
+
+        orig_fb = b._scalar_fallback
+
+        def bad_fallback(req):
+            raise RuntimeError("injected fallback failure")
+
+        b.classifier.dispatch_chunks_async = broken
+        b._scalar_fallback = bad_fallback
+        try:
+            res = b.submit(dice_blob(mit_body, "ce0"), "LICENSE").wait(60.0)
+        finally:
+            b.classifier.dispatch_chunks_async = original
+            b._scalar_fallback = orig_fb
+        assert res.error is not None and "completion_error" in res.error
+        assert b.stats()["scheduler"]["completion_errors"] == 1
+        post = b.classify(dice_blob(mit_body, "ce1"), "LICENSE")
+        assert (post.key, post.matcher) == ("mit", "dice")
+
+
+def test_warm_start_precompiles_bucket_shapes():
+    """The cold-start fix: warm_start=True compiles every bucket pad
+    shape in the constructor, so no live request pays a jit compile —
+    and the per-shape attribution names what each bucket's warmup
+    cost."""
+    fresh = BatchClassifier(pad_batch_to=16, mesh=None)
+    with MicroBatcher(
+        classifier=fresh, max_delay_ms=5.0, buckets=(4, 16),
+        warm_start=True,
+    ) as b:
+        stats = fresh.dispatch_stats()
+        # every bucket in the ladder (max_batch rides at the top)
+        assert set(stats["per_shape"]) == set(b.buckets)
+        assert stats["compiles"] == len(b.buckets)  # one per shape
+        compiles_before = stats["compiles"]
+        body = fixture_contents("mit/LICENSE.txt")
+        res = b.classify(body + "\nzqwarm zqcold\n", "LICENSE")
+        assert (res.key, res.matcher) == ("mit", "dice")
+        after = fresh.dispatch_stats()
+        # the live request's flush was a steady-state enqueue: the
+        # bucket shape had already been compiled by the warmup probe
+        assert after["compiles"] == compiles_before
+        assert after["dispatches"] >= 1
+        assert b.stats()["config"]["warm_start"] is True
+
+
+def test_scheduler_stats_surface_pipeline_occupancy(clf, mit_body):
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), pipeline_depth=3
+    ) as b:
+        res = b.classify(dice_blob(mit_body, "occ"), "LICENSE")
+        assert (res.key, res.matcher) == ("mit", "dice")
+        stats = b.stats()
+        pipe = stats["pipeline"]
+        assert set(pipe["occupancy"]) == {"featurize", "device", "writer"}
+        assert pipe["inflight_chunks"] == 0  # drained between flushes
+        assert stats["config"]["pipeline_depth"] == 3
+
+
+def test_pipeline_depth_bounds_submitted_unfinished_groups(clf, mit_body):
+    """The in-flight bound is submit-to-ANSWERED, not queue residency:
+    with pipeline_depth=1 a second flush must not touch the device
+    until the completion thread has fully finished the first — the
+    documented 'depth 1 = synchronous flush' contract."""
+    release = threading.Event()
+    lock = threading.Lock()
+    inflight = [0]
+    max_inflight = [0]
+    original = clf.dispatch_chunks_async
+
+    class _GatedFuture:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __len__(self):
+            return len(self._inner)
+
+        def result(self):
+            assert release.wait(60.0), "test never released the gate"
+            outs = self._inner.result()
+            with lock:
+                inflight[0] -= 1
+            return outs
+
+    def gated_submit(prepared, pad_to=None):
+        with lock:
+            inflight[0] += 1
+            max_inflight[0] = max(max_inflight[0], inflight[0])
+        return _GatedFuture(original(prepared, pad_to=pad_to))
+
+    with MicroBatcher(
+        classifier=clf, max_delay_ms=5.0, buckets=(4,), pipeline_depth=1
+    ) as b:
+        b.classifier.dispatch_chunks_async = gated_submit
+        try:
+            r0 = b.submit(dice_blob(mit_body, "pd0"), "LICENSE")
+            # let flush 0 submit and park on the gated await, then
+            # offer a second flush: the scheduler must block on the
+            # in-flight permit, never reaching the device
+            deadline = time.monotonic() + 10.0
+            while inflight[0] == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert inflight[0] == 1
+            r1 = b.submit(dice_blob(mit_body, "pd1"), "LICENSE")
+            time.sleep(0.3)  # time for a (buggy) second submit to land
+            assert max_inflight[0] == 1
+            release.set()
+            res = [r.wait(60.0) for r in (r0, r1)]
+        finally:
+            b.classifier.dispatch_chunks_async = original
+            release.set()
+        for r in res:
+            assert (r.key, r.matcher) == ("mit", "dice")
+    assert max_inflight[0] == 1
 
 
 def test_auto_mode_routes_and_skips_unscored_filenames(mit_body):
@@ -637,12 +807,13 @@ def test_scalar_fallback_row_carries_trace_with_all_five_spans(
         classifier=clf, max_delay_ms=5.0, buckets=(4,),
         trace_sample=1.0, trace_slow_ms=0.0,
     ) as b:
-        original = b.classifier.dispatch_chunks
+        # the flush path's device seam is the ASYNC submit now
+        original = b.classifier.dispatch_chunks_async
 
         def broken(*args, **kwargs):
             raise RuntimeError("injected device failure")
 
-        b.classifier.dispatch_chunks = broken
+        b.classifier.dispatch_chunks_async = broken
         try:
             out: list[str] = []
             serve_session(
@@ -653,7 +824,7 @@ def test_scalar_fallback_row_carries_trace_with_all_five_spans(
                 out.append,
             )
         finally:
-            b.classifier.dispatch_chunks = original
+            b.classifier.dispatch_chunks_async = original
         row = json.loads(out[0])
         assert (row["key"], row["matcher"]) == ("mit", "dice")
         trace = next(
@@ -1009,17 +1180,18 @@ def test_scalar_fallback_scores_against_admitted_corpus(
         expected = b.classifier.classify_blobs([blob])[0]
         assert (expected.key, expected.matcher) == ("mit", "dice")
         new_clf = b.classifier
-        original = new_clf.dispatch_chunks
+        # the flush path's device seam is the ASYNC submit now
+        original = new_clf.dispatch_chunks_async
 
         def broken(*args, **kwargs):
             raise RuntimeError("injected device failure")
 
-        new_clf.dispatch_chunks = broken
+        new_clf.dispatch_chunks_async = broken
         try:
             rq = b.submit(blob, "LICENSE")
             res = rq.wait(60.0)
         finally:
-            new_clf.dispatch_chunks = original
+            new_clf.dispatch_chunks_async = original
         assert (res.key, res.matcher) == ("mit", "dice")
         assert res.confidence == expected.confidence
         assert rq.corpus_fp == fp_new

@@ -589,3 +589,50 @@ def test_cli_stripes_runs_stub_end_to_end(tmp_path, capsys, monkeypatch):
     # per-shard dumps are the runner's internal inputs)
     merged = json.loads(stats_file.read_text())
     assert merged["total"] == len(paths)
+
+
+def test_chips_per_stripe_lanes_forward_respects_explicit_mesh(
+    tmp_path, capsys, monkeypatch
+):
+    """`--chips-per-stripe K` auto-forwards `--device-lanes auto` to
+    each worker — but lanes are mutually exclusive with an explicit
+    numeric `--mesh` (BatchClassifier raises), so an operator who
+    pinned per-dispatch sharding must NOT get lanes forwarded on top
+    of it (every stripe would die at startup)."""
+    stub = tmp_path / "stub_worker.py"
+    stub.write_text(STUB)
+    manifest = tmp_path / "m.txt"
+    manifest.write_text("\n".join(f"/nope/L_{i}" for i in range(6)) + "\n")
+
+    import licensee_tpu.parallel.stripes as stripes_mod
+
+    captured: list[list[str]] = []
+
+    def stub_argv(man, out, index, count, forward=(), resume=True):
+        captured.append(list(forward))
+        return [
+            sys.executable, str(stub), man, out, str(index), str(count),
+        ]
+
+    monkeypatch.setattr(stripes_mod, "stripe_argv", stub_argv)
+
+    for case, (extra, want_lanes) in enumerate((
+        ([], True),                      # default: lanes auto-forward
+        (["--mesh", "auto"], True),      # "auto" is overridden by lanes
+        (["--mesh", "2,1"], False),      # explicit shard: no lanes
+    )):
+        captured.clear()
+        rc, _out = _main(
+            ["batch-detect", str(manifest), "--stripes", "2",
+             "--chips-per-stripe", "2",
+             "--output", str(tmp_path / f"out-{case}.jsonl"),
+             "--no-resume", *extra],
+            capsys,
+        )
+        assert rc == 0
+        assert captured, "stripe_argv never called"
+        for fwd in captured:
+            has_lanes = "--device-lanes" in fwd
+            assert has_lanes == want_lanes, (extra, fwd)
+            if want_lanes:
+                assert fwd[fwd.index("--device-lanes") + 1] == "auto"
