@@ -11,12 +11,25 @@ a thread entry (``threading.Thread(target=...)``, ``executor.submit``,
 ``threading.Timer``), is a finding.  ``__init__`` is exempt — object
 construction happens-before any thread start.
 
-**blocking-call** — inside the router dispatch/handler call paths
-(the pre-flight gate for the ROADMAP's selectors/asyncio router core),
-calls that park the carrying thread are findings: ``time.sleep``,
-blocking socket verbs, file ``open``, ``subprocess`` waits, and the
-fleet's own ``oneshot`` probe round trip.  Entry points are the
-session/dispatch methods; reachability follows intra-module calls.
+**blocking-call** — inside the router dispatch/handler call paths AND
+every event-loop callback (the selectors core of serve/eventloop.py
+carries all fleet and serve socket I/O on ONE thread — a single
+blocking primitive there stalls every connection at once), calls that
+park the carrying thread are findings: ``time.sleep``, blocking socket
+verbs (``recv``/``sendall``/``accept``/``connect``/``makefile``), file
+``open``, ``subprocess`` waits, the fleet's own ``oneshot`` probe
+round trip, and the synchronous ``dispatch_chunks`` device wrapper.
+Entry points are the session/dispatch methods plus the loop-callback
+surface: any ``_on_*``/``on_*`` scope (the fd-event convention), the
+named timer callbacks, and every function handed to the loop BY
+REFERENCE (``call_later``/``call_soon*``/``run_sync`` args, lambdas
+passed to the connect/LineConn factories, ``on_*`` rebinding);
+reachability follows intra-module calls, including through class
+instantiation into ``__init__``.
+The sanctioned non-blocking verbs (EAGAIN-terminated ``recv`` on a
+non-blocking socket, the self-pipe drain, the accept pass) carry
+explicit ``# analysis: disable=blocking-call`` pragmas at their call
+sites.
 """
 
 from __future__ import annotations
@@ -109,6 +122,76 @@ HANDLER_ENTRY_NAMES = {
     "_race", "_attempt", "_emit",
 }
 
+# timer callbacks the event loop dispatches (EventLoop.call_later
+# targets in the gated modules).  fd-event callbacks need no list:
+# every scope named ``_on_*``/``on_*`` is treated as a loop callback
+# by convention — see check_blocking_call.
+LOOP_TIMER_ENTRY_NAMES = {
+    "_beat", "_sweep", "_probe_tick", "_probe_send",
+    "_attempt_timeout", "_hedge_fire", "_dispatch_round",
+    "_submit", "_begin", "_start_op", "_fill", "_push", "_flush",
+    "_split_lines", "_flush_writes",
+    "_run_loop",  # the loop thread itself IS loop code
+}
+
+# calls whose function arguments run ON the loop thread: callbacks are
+# handed over BY REFERENCE (or as lambdas), so plain call-edge
+# reachability never sees them — check_blocking_call collects these
+# references (and the call names inside lambda arguments) as extra
+# entry points.  Deliberately NOT here: ``submit`` (the ops executor —
+# its thunks block by design) and ``Thread`` (its own thread).
+LOOP_SCHEDULING_NAMES = {
+    "call_later", "call_soon", "call_soon_threadsafe", "run_sync",
+    "register", "modify",
+    # loop-callback factories: their function args / on_* keywords fire
+    # on the loop
+    "connect_unix", "LineConn",
+}
+
+
+def _loop_callback_refs(tree) -> set[str]:
+    """Names of functions handed to the event loop by reference: args
+    to the scheduling verbs above, call targets inside lambda args to
+    those verbs, and values bound to ``on_*`` attributes
+    (``conn.on_line = self.handle_line``)."""
+
+    def ref_name(expr) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    refs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and target.attr.startswith("on_")
+                ):
+                    name = ref_name(node.value)
+                    if name is not None:
+                        refs.add(name)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fname = ref_name(node.func)
+        if fname not in LOOP_SCHEDULING_NAMES:
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in args:
+            name = ref_name(arg)
+            if name is not None:
+                refs.add(name)  # non-function names miss by_name: inert
+            elif isinstance(arg, ast.Lambda):
+                for sub in ast.walk(arg.body):
+                    if isinstance(sub, ast.Call):
+                        name = ref_name(sub.func)
+                        if name is not None:
+                            refs.add(name)
+    return refs
+
 # fully-qualified calls that block the carrying thread
 BLOCKING_QUALIFIED = {
     "time.sleep": "sleeps on the handler path",
@@ -131,8 +214,13 @@ BLOCKING_METHODS = {
     "recv_into": "blocks on a socket read",
     "sendall": "blocks on a socket write",
     "accept": "blocks accepting a connection",
+    "connect": "dials a socket synchronously (use connect_ex on a "
+               "non-blocking socket)",
     "makefile": "wraps a blocking socket stream",
     "communicate": "waits on a subprocess",
+    "dispatch_chunks": "is the synchronous device submit+await "
+                       "wrapper; the loop must never wait on the "
+                       "device",
 }
 # bare names that resolve to module functions known to block (the
 # wire-layer probe helpers imported into the gated modules)
@@ -224,16 +312,34 @@ def check_blocking_device_call(module):
 
 @rule(
     "blocking-call",
-    dirs=("licensee_tpu/fleet/router", "licensee_tpu/serve/server"),
+    dirs=(
+        "licensee_tpu/fleet/router",
+        "licensee_tpu/serve/server",
+        "licensee_tpu/serve/eventloop",
+    ),
     doc=(
-        "A dispatch/handler path calls a blocking primitive "
-        "(time.sleep, socket verbs, file I/O, subprocess waits)"
+        "A dispatch/handler path or an event-loop callback (fd event "
+        "or timer) calls a blocking primitive (time.sleep, socket "
+        "verbs, file I/O, subprocess waits, the sync dispatch_chunks "
+        "wrapper) — one blocked loop callback stalls every connection"
     ),
 )
 def check_blocking_call(module):
     scopes = _scopes(module)
     imports = _imports(module)
-    reachable = scopes.module_reachable(HANDLER_ENTRY_NAMES)
+    entries = set(HANDLER_ENTRY_NAMES) | LOOP_TIMER_ENTRY_NAMES
+    # the fd-callback convention: LineConn/LoopJsonlServer/connect_unix
+    # hand the loop `_on_*` bound methods and `on_*` closures — every
+    # one runs ON the loop thread
+    entries |= {
+        scope.name
+        for scope in scopes.iter_scopes()
+        if scope.name.startswith(("_on_", "on_"))
+    }
+    # callbacks the loop receives by reference or inside lambdas —
+    # invisible to call-edge reachability
+    entries |= _loop_callback_refs(module.tree)
+    reachable = scopes.module_reachable(entries)
     findings = []
     seen: set[int] = set()
     for scope in reachable:

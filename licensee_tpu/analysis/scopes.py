@@ -335,6 +335,13 @@ class ModuleScopes:
         by_name: dict[str, list[FunctionScope]] = {}
         for scope in self.iter_scopes():
             by_name.setdefault(scope.name, []).append(scope)
+        # instantiating a class runs its __init__ where the call sits:
+        # `Conn(...)` on the loop thread makes Conn.__init__ (and
+        # whatever it calls) loop code
+        for cls in self.classes:
+            init = cls.functions.get("__init__")
+            if init is not None:
+                by_name.setdefault(cls.name, []).append(init)
         frontier = [
             s for name in entry_names for s in by_name.get(name, [])
         ]

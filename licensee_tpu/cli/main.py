@@ -1187,6 +1187,7 @@ def cmd_fleet(args) -> int:
         supervisor=supervisor,
         hedge_ms=None if hedge_ms == "off" else hedge_ms,
         probe_interval_s=args.probe_interval_ms / 1000.0,
+        pool_per_worker=args.pool_per_worker,
     )
     from licensee_tpu.serve.server import SocketInUseError
 
@@ -1211,6 +1212,16 @@ def cmd_fleet(args) -> int:
         router.close()
         supervisor.stop()
         return 1
+    # long-lived serving process: the boot-time heap (imports, corpus,
+    # supervisor state) never becomes garbage, but untuned gen2 GC
+    # re-scans it forever — on the router's event loop that is a
+    # ~100 ms stall per pass at saturation, pure tail latency.  Freeze
+    # the boot heap out of collection; the saturation bench measures
+    # the router under the same setting.
+    import gc
+
+    gc.collect()
+    gc.freeze()
     import signal as signallib
     import threading
 
@@ -1766,6 +1777,16 @@ def build_parser() -> argparse.ArgumentParser:
             "default off).  A duplicate the twin has cached or in "
             "flight coalesces by content hash; otherwise the extra "
             "device load is bounded by the hedge rate (~5% at auto)"
+        ),
+    )
+    fleet.add_argument(
+        "--pool-per-worker", type=bounded(int, 1), default=4,
+        metavar="N",
+        help=(
+            "Pipelined backend connections the router may open per "
+            "worker (default 4).  Many requests ride each connection "
+            "at once; more connections spread head-of-line blocking, "
+            "at the cost of more worker session threads"
         ),
     )
     fleet.add_argument(

@@ -18,7 +18,6 @@ from __future__ import annotations
 _EXPORTS = {
     "Router": "licensee_tpu.fleet.router",
     "FrontServer": "licensee_tpu.fleet.router",
-    "route_session": "licensee_tpu.fleet.router",
     "Supervisor": "licensee_tpu.fleet.supervisor",
     "WorkerHandle": "licensee_tpu.fleet.supervisor",
     "default_worker_argv": "licensee_tpu.fleet.supervisor",

@@ -5,8 +5,11 @@ don't need a device path.
 The faults are real OS-level faults against real processes — SIGKILL
 (crash), SIGSTOP (wedge: alive but silent), and a SIGSTOP/SIGCONT duty
 cycle (slow-walk: the brownout that health checks miss but tail
-latency exposes).  The selftest (fleet/selftest.py) drives them under
-live traffic and asserts the client never sees an error.
+latency exposes) — plus :class:`Slowloris`, the slow/partial-WRITER
+client (dribbled bytes, or a half-close mid-line) that a correct
+event-loop server must reap without spending a thread or a pool slot
+on it.  The selftest (fleet/selftest.py) drives them under live
+traffic and asserts the client never sees an error.
 
 The stub worker (``python -m licensee_tpu.fleet.faults --socket P``)
 speaks the serve JSONL contract — content rows, ``stats``/``trace``/
@@ -96,6 +99,216 @@ class SlowWalker:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+
+
+class Slowloris:
+    """The slow/partial-writer fault against a JSONL socket server: a
+    client that starts a request line and never finishes it.
+
+    ``mode="dribble"`` sends one byte of a request every
+    ``byte_interval_s`` — forever mid-line, never a newline.
+    ``mode="half_close"`` sends half a line then shuts down its write
+    side (the torn client).  Either way a correct event-loop server
+    must REAP the connection once the partial line has stalled past its
+    ``stall_timeout_s`` — without holding a session, a thread, or a
+    backend pool slot meanwhile.
+
+    ``run()`` blocks until the server closes the connection or
+    ``give_up_s`` passes, and returns ``{"reaped", "elapsed_s",
+    "sent_bytes"}`` — the selftest's gate is ``reaped=True`` while
+    normal traffic on OTHER connections kept answering."""
+
+    def __init__(self, path: str, *, mode: str = "dribble",
+                 byte_interval_s: float = 0.2, give_up_s: float = 30.0):
+        if mode not in ("dribble", "half_close"):
+            raise ValueError(f"unknown slowloris mode {mode!r}")
+        self.path = path
+        self.mode = mode
+        self.byte_interval_s = float(byte_interval_s)
+        self.give_up_s = float(give_up_s)
+
+    def run(self) -> dict:
+        import socket as socketlib
+
+        payload = b'{"content": "never finished'
+        sent = 0
+        t0 = time.perf_counter()
+        sock = socketlib.socket(
+            socketlib.AF_UNIX, socketlib.SOCK_STREAM
+        )
+        try:
+            sock.settimeout(self.give_up_s)
+            sock.connect(self.path)
+            if self.mode == "half_close":
+                sock.sendall(payload)
+                sent = len(payload)
+                sock.shutdown(socketlib.SHUT_WR)
+            deadline = t0 + self.give_up_s
+            poll_s = (
+                self.byte_interval_s if self.mode == "dribble" else 0.2
+            )
+            while time.perf_counter() < deadline:
+                if self.mode == "dribble":
+                    try:
+                        sock.sendall(payload[sent % len(payload):][:1])
+                        sent += 1
+                    except OSError:
+                        # EPIPE/reset on send: the server dropped us
+                        return self._result(True, t0, sent)
+                # a read tells us whether the server hung up: EOF (or
+                # reset) == reaped; timeout == still tolerated
+                sock.settimeout(poll_s)
+                try:
+                    if sock.recv(4096) == b"":
+                        return self._result(True, t0, sent)
+                    # any actual bytes would be a protocol violation —
+                    # the server must never answer a half request; keep
+                    # watching, the gate is the close
+                except socketlib.timeout:
+                    continue
+                except OSError:
+                    return self._result(True, t0, sent)
+            return self._result(False, t0, sent)
+        except OSError:
+            return self._result(False, t0, sent)
+        finally:
+            sock.close()
+
+    def _result(self, reaped: bool, t0: float, sent: int) -> dict:
+        return {
+            "reaped": reaped,
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+            "sent_bytes": sent,
+        }
+
+
+def open_loop_client(
+    path: str,
+    rate: float,
+    duration_s: float,
+    timeout_s: float = 30.0,
+) -> dict:
+    """One open-loop JSONL client connection for the saturation bench:
+    request lines go out at a fixed TARGET RATE regardless of how the
+    server is doing (real-traffic arrival — a struggling server does
+    not slow its users down), responses are counted (and latency-
+    stamped) from raw chunks.  Runs as a SUBPROCESS (``python -m
+    licensee_tpu.fleet.faults --open-loop-client ...``) so the load
+    generator never shares the router process's GIL — in-process client
+    threads were the measurement fighting the measured.
+
+    Returns ``{"sent", "answered", "stalled", "elapsed_s",
+    "send_elapsed_s", "lats_ms"}`` — per-request latencies in
+    milliseconds, matched to send stamps by response order (the session
+    answers in request order).  ``send_elapsed_s`` covers only the send
+    window: ``sent / send_elapsed_s`` is the OFFERED arrival rate,
+    while ``elapsed_s`` additionally spans the queue drain after the
+    last send."""
+    import socket as socketlib
+
+    line = (json.dumps({"content": "saturation probe"}) + "\n").encode(
+        "utf-8"
+    )
+    stamps: deque = deque()
+    lats: list[float] = []
+    state = {"sent": 0, "answered": 0, "stalled": False}
+    final: dict = {"n": None}
+    t0 = time.perf_counter()
+    sock = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    try:
+        try:
+            sock.connect(path)
+            sock.settimeout(timeout_s)
+        except OSError:
+            state["stalled"] = True
+            return {**state, "elapsed_s": 0.0, "lats_ms": []}
+
+        def read_loop() -> None:
+            # responses are ordered per session: counting newlines in
+            # raw chunks matches them to send stamps without a readline
+            # (or a parse) per row
+            while True:
+                if final["n"] is not None and state["answered"] >= final["n"]:
+                    return
+                try:
+                    chunk = sock.recv(1 << 16)
+                except OSError:  # timeout: a stalled client
+                    state["stalled"] = True
+                    return
+                if not chunk:
+                    state["stalled"] = True
+                    return
+                k = chunk.count(b"\n")
+                if k:
+                    now = time.perf_counter()
+                    for _ in range(k):
+                        lats.append((now - stamps.popleft()) * 1000.0)
+                    state["answered"] += k
+
+        reader = threading.Thread(target=read_loop, daemon=True)
+        reader.start()
+        tick_s = 0.01
+        per_tick = rate * tick_s
+        credit = 0.0
+        next_tick = t0
+        try:
+            while time.perf_counter() - t0 < duration_s:
+                credit += per_tick
+                n = int(credit)
+                credit -= n
+                if n:
+                    now = time.perf_counter()
+                    stamps.extend([now] * n)
+                    state["sent"] += n
+                    sock.sendall(line * n)  # the tick's burst, one write
+                next_tick += tick_s
+                delay = next_tick - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            # the drain sentinel: sent AFTER the loop, then final["n"]
+            # is armed — the reader always has one more response coming
+            # and exits exactly when everything (sentinel included)
+            # answered
+            stamps.append(time.perf_counter())
+            sock.sendall(line)
+            state["sent"] += 1
+        except OSError:
+            state["stalled"] = True
+        send_elapsed = time.perf_counter() - t0
+        final["n"] = state["sent"]
+        reader.join(timeout=timeout_s + 5.0)
+        if reader.is_alive() or state["answered"] < state["sent"]:
+            state["stalled"] = True
+        return {
+            **state,
+            "elapsed_s": round(time.perf_counter() - t0, 4),
+            "send_elapsed_s": round(send_elapsed, 4),
+            "lats_ms": [round(x, 2) for x in lats],
+        }
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _client_main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="licensee-tpu-open-loop-client",
+        description="Open-loop saturation client (bench harness)",
+    )
+    parser.add_argument("--open-loop-client", required=True,
+                        metavar="SOCKET")
+    parser.add_argument("--rate", type=float, required=True)
+    parser.add_argument("--duration-s", type=float, required=True)
+    parser.add_argument("--timeout-s", type=float, default=30.0)
+    args = parser.parse_args(argv)
+    out = open_loop_client(
+        args.open_loop_client, args.rate, args.duration_s,
+        timeout_s=args.timeout_s,
+    )
+    sys.stdout.write(json.dumps(out) + "\n")
+    return 0
 
 
 # -- the stub worker ---------------------------------------------------
@@ -266,24 +479,52 @@ class _StubServer(socketserver.ThreadingMixIn,
 
 class _StubHandler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
+        # responses are coalesced per read-batch — one sendall carries
+        # every answer the batch produced, exactly like the real
+        # worker's event-loop transport (serve/eventloop.py flushes
+        # writes once per loop pass).  Per-line flushing made the STUB
+        # the syscall bottleneck of the router saturation bench.
         state: _StubState = self.server.state
-        for raw in self.rfile:
-            line = raw.decode("utf-8", errors="replace").strip()
-            if not line:
-                continue
+        sock = self.connection
+        buf = bytearray()
+        while True:
             try:
-                msg = json.loads(line)
-            except ValueError:
-                msg = {}
-            row = _stub_answer(state, msg)
-            if row is None:
-                state.hang_forever.wait()  # wedged, forever
-                return
-            try:
-                self.wfile.write(json.dumps(row).encode("utf-8") + b"\n")
-                self.wfile.flush()
+                chunk = sock.recv(65536)
             except OSError:
                 return
+            if not chunk:
+                return
+            buf += chunk
+            if b"\n" not in chunk:
+                continue
+            *lines, rest = buf.split(b"\n")
+            buf = bytearray(rest)
+            out = bytearray()
+            for raw in lines:
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    msg = {}
+                row = _stub_answer(state, msg)
+                if row is None:
+                    # wedge: answers already produced still flush —
+                    # same client view as the per-line writer gave
+                    if out:
+                        try:
+                            sock.sendall(out)
+                        except OSError:
+                            return
+                    state.hang_forever.wait()  # wedged, forever
+                    return
+                out += json.dumps(row).encode("utf-8") + b"\n"
+            if out:
+                try:
+                    sock.sendall(out)
+                except OSError:
+                    return
 
 
 def stub_main(argv=None) -> int:
@@ -343,4 +584,6 @@ def stub_main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    if "--open-loop-client" in sys.argv:
+        sys.exit(_client_main(sys.argv[1:]))
     sys.exit(stub_main())

@@ -1,6 +1,7 @@
 """The fleet router: one client-facing socket fronting N serve workers
 — least-loaded dispatch, health-checked failover, backpressure-aware
-retries, and tail-cutting hedged requests.
+retries, and tail-cutting hedged requests, all carried by ONE
+single-threaded non-blocking event loop (serve/eventloop.py).
 
 Dean & Barroso's "The Tail at Scale" is the playbook:
 
@@ -24,51 +25,365 @@ Dean & Barroso's "The Tail at Scale" is the playbook:
   seen: a blob already cached or in flight there coalesces via the
   content-hash key (ResultCache/MicroBatcher), and otherwise the extra
   load is bounded by the hedge rate (~5% at a p95-derived delay).  The
-  loser's late answer is discarded and its connection recycled.
+  loser's late answer is discarded when it eventually arrives.
+
+**The I/O core.**  Every client connection, every backend connection,
+every health probe, and every timeout is a callback on one
+``selectors`` event loop — no thread is ever parked on a socket, so a
+slow or dead backend can never stall an unrelated client.  Requests
+are **pipelined** onto a bounded per-worker connection pool
+(``pool_per_worker``): a backend connection carries many in-flight
+requests at once, correlated back to their clients by FIFO order (the
+worker answers in request order by contract) and cross-checked against
+the trace ID the wire protocol carries — a response echoing the wrong
+trace is a protocol violation that kills the connection and fails its
+in-flight requests over rather than ever answering the wrong client.
+A connection that dies with requests in flight fails ALL of them over;
+a request that times out closes its (head-of-line-blocked) connection,
+failing the requests queued behind it over too.
 
 Trace IDs are minted HERE and forwarded on the wire (``"trace"``
 field); the worker adopts the ID (obs/tracing.py), so the router tail
 shows ``route``/``hedge``/``failover`` spans and the worker tail shows
 the serving spans — same 16-hex handle end to end.
+
+Threading contract: the request state machines live on the loop thread
+and need no locks.  ``dispatch()`` is the blocking facade (submit via
+``call_soon_threadsafe``, wait on an event); ``stats()`` /
+``outstanding()`` / ``pick()`` snapshot loop-owned state via
+``run_sync``.  Long ops verbs (the fan-out Prometheus scrape, the
+rolling fleet reload) run on a small ops executor, never on the loop.
+The analyzer's ``blocking-call`` rule walks every loop callback in
+this module: a blocking primitive on the loop thread is a CI finding.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from queue import Empty, SimpleQueue
 
-from licensee_tpu.fleet.wire import ConnectionPool, WireError, oneshot
+from licensee_tpu.fleet.wire import WireError, oneshot
 from licensee_tpu.obs import Observability, merge_expositions
-from licensee_tpu.serve.server import JsonlUnixServer
+from licensee_tpu.serve.eventloop import (
+    EventLoop,
+    LineConn,
+    LoopClosedError,
+    LoopJsonlServer,
+    connect_unix,
+    drop_close,
+    drop_line,
+)
 from licensee_tpu.serve.stats import LatencyStats
+
+# how long a no-backend request waits between re-pick attempts while
+# the whole fleet is down (a restart may bring a worker back before
+# the dispatch deadline) — a timer wakeup, never a parked thread
+_REPICK_DELAY_S = 0.05
+
+# wire trace IDs are 64-bit, rendered 16-hex — same space the tracer
+# mints from (obs/tracing.py); the mint-only fast path masks into it
+_WIRE_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+class _Attempt:
+    """One request sent to one backend connection: the FIFO entry that
+    a response line (or a connection death, or a timeout) resolves.
+    ``deadline`` is the monotonic instant the router's timeout sweep
+    declares this attempt head-of-line dead — one periodic sweep over
+    the FIFO heads replaces the timer-per-attempt heap churn that was
+    measurable at saturation."""
+
+    __slots__ = ("request", "backend", "conn", "is_hedge", "resolved",
+                 "deadline")
+
+    def __init__(self, request: "_Request", backend: "Backend",
+                 is_hedge: bool):
+        self.request = request
+        self.backend = backend
+        self.conn: "_BackendConn | None" = None
+        self.is_hedge = is_hedge
+        self.resolved = False
+        self.deadline = 0.0
+
+
+class _Request:
+    """One routed client request's event-loop state machine.
+
+    ``msg`` may be None: the front session's fast path skips the
+    client-line parse for content rows, and :attr:`rid` then parses the
+    wire line lazily — only the rare finishing paths (queue_full,
+    deadline, error rows, slow exemplars) ever need the request id."""
+
+    __slots__ = ("msg", "wire_line", "trace", "wire_trace",
+                 "tried", "queue_full_rows", "arms", "t0", "deadline",
+                 "hedge_timer", "hedge_started", "first_round",
+                 "finished", "last_reason", "on_done", "repick_timer")
+
+    def __init__(self, msg: dict | None, wire_line: str, trace,
+                 wire_trace, on_done):
+        self.msg = msg
+        self.wire_line = wire_line
+        self.trace = trace
+        self.wire_trace = wire_trace
+        self.tried: set[str] = set()
+        self.queue_full_rows: list[dict] = []
+        self.arms: list[_Attempt] = []
+        self.t0 = 0.0
+        self.deadline = 0.0
+        self.hedge_timer = None
+        self.hedge_started = False
+        self.first_round = True
+        self.finished = False
+        self.last_reason = "no healthy backend"
+        self.on_done = on_done
+        self.repick_timer = None
+
+    @property
+    def rid(self):
+        if self.msg is None:
+            try:
+                parsed = json.loads(self.wire_line)
+                self.msg = parsed if isinstance(parsed, dict) else {}
+            except ValueError:
+                self.msg = {}
+        return self.msg.get("id")
+
+
+class _BackendConn:
+    """One pipelined JSONL connection to a worker: a FIFO of in-flight
+    attempts, resolved strictly in order as response lines arrive (the
+    worker's in-order session contract), each response cross-checked
+    against the trace ID its request carried."""
+
+    __slots__ = ("router", "backend", "state", "fifo", "line_conn",
+                 "_pending_lines", "_abort_connect")
+
+    def __init__(self, router: "Router", backend: "Backend"):
+        self.router = router
+        self.backend = backend
+        self.state = "connecting"
+        self.fifo: deque[_Attempt] = deque()
+        self.line_conn: LineConn | None = None
+        self._pending_lines: list[str] = []
+        self._abort_connect = connect_unix(
+            router.loop, backend.socket_path, router.probe_timeout_s,
+            self._on_connected, self._on_connect_error,
+        )
+
+    def inflight(self) -> int:
+        return len(self.fifo)
+
+    def send(self, attempt: _Attempt) -> None:
+        attempt.conn = self
+        if self.state == "closed":
+            # the dial failed synchronously (ECONNREFUSED on a freshly
+            # killed worker's stale socket): buffering here would strand
+            # the attempt forever — fail it over NOW instead
+            self.router._attempt_resolved(
+                attempt, "fail",
+                f"{self.backend.name}: connection already closed",
+            )
+            return
+        self.fifo.append(attempt)
+        line = attempt.request.wire_line
+        if self.state == "open":
+            try:
+                self.line_conn.write_line_on_loop(line)
+            except OSError:
+                pass  # close already failed the FIFO over
+        else:
+            self._pending_lines.append(line)
+
+    # -- loop callbacks --
+
+    def _on_connected(self, sock) -> None:
+        self.state = "open"
+        self.line_conn = LineConn(
+            self.router.loop, sock,
+            on_line=self._on_line, on_close=self._on_close,
+        )
+        pending, self._pending_lines = self._pending_lines, []
+        for line in pending:
+            try:
+                self.line_conn.write_line_on_loop(line)
+            except OSError:
+                return
+
+    def _on_connect_error(self, exc: Exception) -> None:
+        self.state = "closed"
+        self._fail_over(f"connect failed: {exc}")
+
+    def _on_line(self, text: str) -> None:
+        if not self.fifo:
+            self.close("response with no request in flight")
+            return
+        attempt = self.fifo.popleft()
+        expected = attempt.request.wire_trace
+        # the hot path avoids a json.loads per response: the trace
+        # cross-check and the queue_full/error detection run as
+        # substring probes on the raw line (a 16-hex trace ID cannot
+        # appear by accident), and the full parse happens only on the
+        # rare paths — backpressure rows, protocol violations, and the
+        # blocking dispatch() facade's caller thread
+        if (
+            expected is not None
+            and '"trace"' in text
+            and expected not in text
+        ):
+            # pipelining's integrity check: the worker's in-order
+            # contract says this response belongs to the FIFO head, but
+            # the echoed trace disagrees — the stream is out of sync.
+            # Never deliver a mis-correlated verdict: fail this attempt
+            # over and burn the connection (its position is unknowable).
+            try:
+                got = json.loads(text).get("trace")
+            except (ValueError, AttributeError):
+                got = "<unparseable>"
+            self.router._attempt_resolved(
+                attempt, "fail",
+                f"{self.backend.name}: trace mismatch "
+                f"(sent {expected}, response echoes {got})",
+            )
+            self.close("pipelined response trace mismatch")
+            return
+        if (
+            '"error"' in text
+            or '"id"' not in text
+            or not text.endswith("}")
+        ):
+            try:
+                row = json.loads(text)
+                if not isinstance(row, dict):
+                    raise ValueError("response must be a JSON object")
+            except ValueError as exc:
+                # the head attempt is already popped: fail it over with
+                # everything behind it — the stream is unreadable
+                self.router._attempt_resolved(
+                    attempt, "fail",
+                    f"{self.backend.name}: bad response line: {exc}",
+                )
+                self.close(f"bad response line: {exc}")
+                return
+            outcome = (
+                "queue_full" if row.get("error") == "queue_full" else "ok"
+            )
+            self.router._attempt_resolved(attempt, outcome, row, text)
+            return
+        self.router._attempt_resolved(attempt, "ok", None, text)
+
+    def _on_close(self, reason) -> None:
+        self.state = "closed"
+        self._fail_over(f"connection lost: {reason}")
+
+    def _fail_over(self, why: str) -> None:
+        if self in self.backend.conns:
+            self.backend.conns.remove(self)
+        pending, self.fifo = list(self.fifo), deque()
+        for attempt in pending:
+            self.router._attempt_resolved(
+                attempt, "fail", f"{self.backend.name}: {why}"
+            )
+
+    def close(self, reason: str | None = None) -> None:
+        if self.state == "closed":
+            return
+        if self.state == "connecting":
+            self.state = "closed"
+            self._abort_connect()
+            # abort fires _on_connect_error -> _fail_over, but only for
+            # a still-pending dial; a raced completion lands in a
+            # "closed" conn whose fifo we still own
+            self._fail_over(reason or "closed")
+            return
+        self.state = "closed"
+        conn, self.line_conn = self.line_conn, None
+        if conn is not None:
+            # LineConn.close fires _on_close exactly once -> _fail_over
+            conn.on_close = self._on_close
+            conn.close(reason)
 
 
 class Backend:
-    """The router's view of one worker: socket, pool, probed load, and
-    per-backend counters."""
+    """The router's view of one worker: connection pool, probed load,
+    and per-backend counters.  Loop-thread-owned; the metrics collector
+    and ``as_dict`` read the plain ints lock-free (GIL-atomic)."""
 
-    def __init__(self, name: str, socket_path: str, probe_timeout_s: float):
+    def __init__(self, name: str, socket_path: str):
         self.name = name
         self.socket_path = socket_path
-        self.pool = ConnectionPool(
-            socket_path, connect_timeout=probe_timeout_s
-        )
+        self.conns: list[_BackendConn] = []
         self.healthy = False
         self.probed_load = 0
         self.probe_failures = 0
+        self.probe_rounds = 0
         self.outstanding = 0  # routed requests in flight right now
         self.dispatched = 0
         self.ok = 0
         self.failed = 0
         self.queue_full = 0
         self.last_stats: dict = {}
+        # probe plumbing (loop-owned)
+        self.probe_conn: LineConn | None = None
+        self.probe_abort = None
+        self.probe_inflight = False
+        self.probe_deadline = 0.0
 
     def load(self) -> int:
         return self.probed_load + self.outstanding
+
+    def pool_inflight(self) -> int:
+        return sum(c.inflight() for c in list(self.conns))
+
+    def acquire_conn(self, router: "Router") -> _BackendConn:
+        """The pipelining pool policy: reuse an idle connection, grow
+        the pool while every connection is busy and the bound allows,
+        else pipeline onto the least-loaded connection.  One pass, one
+        ``len`` per connection — this runs once per request at
+        saturation, where the two-comprehension version was
+        measurable."""
+        least = None
+        least_n = 0
+        closed_seen = False
+        for conn in self.conns:
+            if conn.state == "closed":
+                # a dial that failed synchronously closes the conn
+                # before (or despite) its place in the pool list —
+                # prune below, never reuse
+                closed_seen = True
+                continue
+            n = len(conn.fifo)
+            if n == 0 and conn.state == "open":
+                if closed_seen:
+                    self.conns = [
+                        c for c in self.conns if c.state != "closed"
+                    ]
+                return conn
+            if least is None or n < least_n:
+                least = conn
+                least_n = n
+        if closed_seen:
+            self.conns = [c for c in self.conns if c.state != "closed"]
+        if len(self.conns) < router.pool_per_worker:
+            conn = _BackendConn(router, self)
+            if conn.state != "closed":  # a sync dial failure stays out
+                self.conns.append(conn)
+            return conn
+        return least
+
+    def close_conns(self) -> None:
+        for conn in list(self.conns):
+            conn.close("router shutdown")
+        self.conns.clear()
+        if self.probe_conn is not None:
+            conn, self.probe_conn = self.probe_conn, None
+            conn.close("router shutdown")
+        if self.probe_abort is not None:
+            abort, self.probe_abort = self.probe_abort, None
+            abort()
 
     def as_dict(self) -> dict:
         return {
@@ -80,6 +395,8 @@ class Backend:
             "ok": self.ok,
             "failed": self.failed,
             "queue_full": self.queue_full,
+            "pool_conns": len(self.conns),
+            "pool_inflight": self.pool_inflight(),
         }
 
 
@@ -91,8 +408,9 @@ class Router:
     optional: when given, its draining/stopped flags veto dispatch (the
     drain protocol) and the supervisor reads ``outstanding()`` back.
     ``hedge_ms`` is ``None``/"off" (no hedging), a number (fixed delay
-    in ms), or "auto" (the p95 of recent request latencies, refreshed
-    per dispatch, floored at ``hedge_floor_ms``)."""
+    in ms), or "auto" (the p95 of recent request latencies, floored at
+    ``hedge_floor_ms``).  ``pool_per_worker`` bounds the pipelined
+    connection pool each backend may grow."""
 
     def __init__(
         self,
@@ -106,7 +424,8 @@ class Router:
         hedge_ms=None,
         hedge_floor_ms: float = 5.0,
         hedge_min_samples: int = 20,
-        max_concurrency: int = 64,
+        max_concurrency: int = 1024,
+        pool_per_worker: int = 4,
         registry=None,
         tracing: bool = True,
         trace_sample: float = 0.01,
@@ -120,6 +439,10 @@ class Router:
             hedge_ms = float(hedge_ms)
             if not (hedge_ms >= 0):
                 raise ValueError(f"hedge_ms must be >= 0, got {hedge_ms!r}")
+        if int(pool_per_worker) < 1:
+            raise ValueError(
+                f"pool_per_worker must be >= 1, got {pool_per_worker!r}"
+            )
         self.hedge_ms = hedge_ms
         self.hedge_floor_ms = float(hedge_floor_ms)
         self.hedge_min_samples = int(hedge_min_samples)
@@ -130,14 +453,16 @@ class Router:
         self.probe_timeout_s = float(probe_timeout_s)
         self.request_timeout_s = float(request_timeout_s)
         self.dispatch_wait_s = float(dispatch_wait_s)
+        self.max_concurrency = int(max_concurrency)
+        self.pool_per_worker = int(pool_per_worker)
         self.backends: dict[str, Backend] = {
-            name: Backend(name, path, probe_timeout_s)
+            name: Backend(name, path)
             for name, path in backends.items()
         }
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
-        self._probe_thread: threading.Thread | None = None
+        self.loop = EventLoop(name="fleet-router")
         self._latency = LatencyStats(capacity=1024)
+        self._hedge_p95_cache: tuple[float, float] | None = None
+        # loop-owned request accounting
         self._counters = {
             "requests": 0,
             "ok": 0,
@@ -150,15 +475,38 @@ class Router:
             "queue_full_returned": 0,
             "no_backend": 0,
         }
+        self._active = 0
+        self._admission: deque = deque()
+        # every admitted, unfinished request — the shutdown path must
+        # be able to answer requests parked on a repick timer, which
+        # are reachable from nowhere else once their timer is dropped
+        self._inflight: set = set()
+        self._draining = False
+        self._probe_timer = None
+        self._first_probe_round = threading.Event()
+        self._started = False
+        self._closing = False
         self.obs = Observability(
             registry,
             tracing=tracing,
             trace_sample=trace_sample,
             trace_slow_ms=trace_slow_ms,
         )
-        self._executor = ThreadPoolExecutor(
-            max_workers=int(max_concurrency),
-            thread_name_prefix="fleet-dispatch",
+        # the mint-only fast path: with head sampling off the router
+        # still needs a wire trace ID per request (pipelining
+        # correlation), but nothing else — mint IDs from a loop-owned
+        # counter and skip the Trace object, its spans, and the
+        # tracer's lock entirely.  Slow exemplars stay honest via
+        # Tracer.note_slow from the measured request latency.
+        self._mint_only = self.obs.tracer.mint_only
+        self._wire_seq = 0
+        self._wire_base = random.Random().getrandbits(64)
+        self._timeout_sweep_timer = None
+        # the ops lane: long front-socket verbs (the fan-out Prometheus
+        # scrape, the rolling fleet reload) block BY DESIGN — they run
+        # here, never on the loop
+        self._ops = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="fleet-ops"
         )
         self._register_metrics()
 
@@ -181,6 +529,15 @@ class Router:
         ).set_fn(
             lambda: sum(b.outstanding for b in self.backends.values())
         )
+        reg.gauge(
+            "fleet_loop_lag_ms",
+            "Smoothed router event-loop lag (heartbeat lateness); a "
+            "blocked loop grows this before the tail latencies do",
+        ).set_fn(self.loop.lag_ms)
+        reg.gauge(
+            "fleet_loop_max_lag_ms",
+            "Decaying max of the router event-loop lag",
+        ).set_fn(self.loop.max_lag_ms)
         events = reg.counter(
             "fleet_requests_total",
             "Router lifecycle events by kind (requests, ok, failovers, "
@@ -197,51 +554,92 @@ class Router:
             "Routed requests by backend worker and outcome",
             labels=("backend", "outcome"),
         )
+        pool_conns = reg.gauge(
+            "fleet_pool_connections",
+            "Open pipelined connections per backend worker",
+            labels=("backend",),
+        )
+        pool_inflight = reg.gauge(
+            "fleet_pool_inflight",
+            "Requests in flight on the pipelined pool per backend",
+            labels=("backend",),
+        )
         hist = reg.histogram(
             "fleet_request_seconds",
             "Client-visible routed request latency (retries and hedges "
             "included)",
         )
-        self._latency_hist = hist
+        # the solo child, resolved ONCE: family.observe() walks
+        # labels() -> dict lookup per call, which is measurable at
+        # per-request rates on the loop thread
+        self._latency_hist = hist.labels()
 
         def collect(_reg) -> None:
-            with self._lock:
-                counters = dict(self._counters)
-                rows = [
-                    (b.name, b.ok, b.failed, b.queue_full)
-                    for b in self.backends.values()
-                ]
-            for k, v in counters.items():
+            # loop-owned ints read lock-free: a torn read is impossible
+            # under the GIL, and a scrape tolerates one-event staleness
+            for k, v in dict(self._counters).items():
                 events.labels(event=k).sync(v)
-            for name, ok, failed, qf in rows:
-                per_worker.labels(backend=name, outcome="ok").sync(ok)
+            for name, b in list(self.backends.items()):
+                per_worker.labels(backend=name, outcome="ok").sync(b.ok)
                 per_worker.labels(backend=name, outcome="failed").sync(
-                    failed
+                    b.failed
                 )
                 per_worker.labels(backend=name, outcome="queue_full").sync(
-                    qf
+                    b.queue_full
                 )
+                pool_conns.labels(backend=name).set(len(b.conns))
+                pool_inflight.labels(backend=name).set(b.pool_inflight())
 
         reg.add_collector(collect)
 
     # -- lifecycle --
 
     def start(self) -> None:
-        self.probe_all()  # synchronous first round: pick() works now
-        if self._probe_thread is None:
-            self._probe_thread = threading.Thread(
-                target=self._probe_loop, name="fleet-prober", daemon=True
-            )
-            self._probe_thread.start()
+        """Start the loop and the probe machinery; returns once the
+        first probe round has resolved (success or failure) for every
+        backend, so ``pick()`` has a health view immediately.
+        Idempotent: a second start() (manual start + ``__enter__``)
+        must not arm a SECOND self-rescheduling probe/sweep chain."""
+        if self._started:
+            return
+        self._started = True
+        self.loop.start()
+        self.loop.call_soon_threadsafe(self._probe_tick)
+        self.loop.call_soon_threadsafe(self._arm_timeout_sweep)
+        self._first_probe_round.wait(self.probe_timeout_s + 2.0)
 
     def close(self) -> None:
-        self._stop.set()
-        if self._probe_thread is not None:
-            self._probe_thread.join()
-            self._probe_thread = None
-        self._executor.shutdown(wait=False)
+        try:
+            self.loop.run_sync(self._shutdown_on_loop)
+        except (LoopClosedError, TimeoutError):
+            pass
+        self.loop.stop()
+        self._ops.shutdown(wait=False)
+
+    def _shutdown_on_loop(self) -> None:
+        self._closing = True
+        if self._probe_timer is not None:
+            self._probe_timer.cancel()
+            self._probe_timer = None
+        if self._timeout_sweep_timer is not None:
+            self._timeout_sweep_timer.cancel()
+            self._timeout_sweep_timer = None
+        # answer EVERY waiting client before the loop stops: requests
+        # still in the admission queue, and admitted requests parked on
+        # a repick timer (no attempt in any FIFO — close_conns would
+        # never reach them, and loop.stop() drops their timers)
+        while self._admission:
+            req = self._admission.popleft()
+            row = {"id": req.rid, "error": "router_closed"}
+            if req.trace is not None:
+                self.obs.tracer.finish(req.trace, "router_closed")
+            if req.wire_trace is not None:
+                row["trace"] = req.wire_trace
+            self._deliver(req, row, admitted=False)
+        for req in list(self._inflight):
+            self._finish_error(req, "router_closed")
         for backend in self.backends.values():
-            backend.pool.close()
+            backend.close_conns()
 
     def __enter__(self):
         self.start()
@@ -250,105 +648,326 @@ class Router:
     def __exit__(self, *exc):
         self.close()
 
-    # -- health probes --
+    # -- health probes (event-loop state machine) --
 
-    def _probe_loop(self) -> None:
-        while not self._stop.wait(self.probe_interval_s):
-            self.probe_all()
-
-    def probe_all(self) -> None:
+    def _probe_tick(self) -> None:
+        """One probe pass: time out overdue probes, send fresh ones on
+        the persistent per-backend probe connections."""
+        if self._closing:
+            return
+        now = time.perf_counter()
         for backend in self.backends.values():
-            self._probe(backend)
+            if backend.probe_inflight:
+                if now >= backend.probe_deadline:
+                    self._probe_failed(backend, close_conn=True)
+            else:
+                self._probe_send(backend)
+        self._probe_timer = self.loop.call_later(
+            self.probe_interval_s, self._probe_tick
+        )
 
-    def _probe(self, backend: Backend) -> None:
+    def _probe_send(self, backend: Backend) -> None:
+        backend.probe_inflight = True
+        backend.probe_deadline = (
+            time.perf_counter() + self.probe_timeout_s
+        )
+        if backend.probe_conn is None:
+            if backend.probe_abort is None:
+                backend.probe_abort = connect_unix(
+                    self.loop, backend.socket_path, self.probe_timeout_s,
+                    lambda sock, b=backend: self._probe_connected(b, sock),
+                    lambda exc, b=backend: self._probe_conn_failed(b),
+                )
+            return
         try:
-            # the probe performs its blocking round trip BY DESIGN, on
-            # the dedicated prober thread — never on a session/dispatch
-            # thread; the handler-path walk reaches it only through
-            # coarse name-based call matching
-            # analysis: disable=blocking-call
-            row = oneshot(
-                backend.socket_path, {"op": "stats"}, self.probe_timeout_s
-            )
+            backend.probe_conn.write_line('{"op": "stats"}')
+        except OSError:
+            self._probe_failed(backend, close_conn=True)
+
+    def _probe_connected(self, backend: Backend, sock) -> None:
+        backend.probe_abort = None
+        backend.probe_conn = LineConn(
+            self.loop, sock,
+            on_line=lambda text, b=backend: self._probe_line(b, text),
+            on_close=lambda reason, b=backend: self._probe_closed(b),
+        )
+        if backend.probe_inflight:
+            try:
+                backend.probe_conn.write_line('{"op": "stats"}')
+            except OSError:
+                self._probe_failed(backend, close_conn=True)
+
+    def _probe_conn_failed(self, backend: Backend) -> None:
+        backend.probe_abort = None
+        if backend.probe_inflight:
+            self._probe_failed(backend, close_conn=False)
+
+    def _probe_closed(self, backend: Backend) -> None:
+        backend.probe_conn = None
+        if backend.probe_inflight:
+            self._probe_failed(backend, close_conn=False)
+
+    def _probe_line(self, backend: Backend, text: str) -> None:
+        try:
+            row = json.loads(text)
             stats = row.get("stats") or {}
             sched = stats.get("scheduler") or {}
             load = int(sched.get("queue_depth") or 0) + int(
                 sched.get("in_flight") or 0
             )
-        except (WireError, TypeError, ValueError):
-            with self._lock:
-                backend.probe_failures += 1
-                backend.healthy = False
+        except (ValueError, TypeError, AttributeError):
+            self._probe_failed(backend, close_conn=True)
             return
-        with self._lock:
-            backend.probe_failures = 0
-            backend.healthy = True
-            backend.probed_load = load
-            backend.last_stats = stats
+        backend.probe_inflight = False
+        backend.probe_failures = 0
+        backend.healthy = True
+        backend.probed_load = load
+        backend.last_stats = stats
+        self._probe_round_done(backend)
 
-    # -- dispatch --
+    def _probe_failed(self, backend: Backend, close_conn: bool) -> None:
+        backend.probe_inflight = False
+        backend.probe_failures += 1
+        backend.healthy = False
+        if close_conn:
+            if backend.probe_conn is not None:
+                conn, backend.probe_conn = backend.probe_conn, None
+                conn.on_close = drop_close
+                conn.close("probe failed")
+            if backend.probe_abort is not None:
+                abort, backend.probe_abort = backend.probe_abort, None
+                abort()
+        self._probe_round_done(backend)
+
+    def _probe_round_done(self, backend: Backend) -> None:
+        backend.probe_rounds += 1
+        if all(b.probe_rounds > 0 for b in self.backends.values()):
+            self._first_probe_round.set()
+
+    # -- dispatch decision (loop thread; public facade below) --
+
+    def _pick(self, exclude=frozenset()) -> str | None:
+        # a single hand-rolled min pass: this runs once per request at
+        # saturation, where two list comprehensions plus a keyed min
+        # were measurable
+        supervisor = self.supervisor
+        best_name = None
+        best_load = 0
+        for name, b in self.backends.items():
+            if name in exclude or not b.healthy:
+                continue
+            if supervisor is not None and not supervisor.dispatchable(
+                name
+            ):
+                continue
+            load = b.probed_load + b.outstanding
+            if (
+                best_name is None
+                or load < best_load
+                or (load == best_load and name < best_name)
+            ):
+                best_name = name
+                best_load = load
+        return best_name
 
     def pick(self, exclude=frozenset()) -> str | None:
         """The least-loaded healthy, non-draining worker outside
         ``exclude`` — the dispatch decision: the router's probed health
-        view (read under the lock) plus the supervisor's drain/stop
-        veto."""
-        with self._lock:
-            candidates = [
-                b
-                for name, b in self.backends.items()
-                if name not in exclude and b.healthy
-            ]
-        # health was just read under the lock; only the supervisor's
-        # drain/stop veto remains (dispatchable() would re-take the
-        # lock per candidate to re-read the same flag)
-        supervisor = self.supervisor
-        if supervisor is not None:
-            candidates = [
-                b for b in candidates if supervisor.dispatchable(b.name)
-            ]
-        if not candidates:
-            return None
-        return min(candidates, key=lambda b: (b.load(), b.name)).name
+        view plus the supervisor's drain/stop veto."""
+        try:
+            return self.loop.run_sync(self._pick, exclude)
+        except (LoopClosedError, TimeoutError):
+            return self._pick(exclude)
 
     def outstanding(self, name: str | None = None) -> int:
         """Routed requests currently in flight (one worker, or all) —
         the supervisor's drain barrier reads this."""
-        with self._lock:
+
+        def _read() -> int:
             if name is not None:
                 backend = self.backends.get(name)
                 return backend.outstanding if backend is not None else 0
             return sum(b.outstanding for b in self.backends.values())
 
-    def _attempt(self, backend: Backend, line: str):
-        """One request/response round trip against one worker.
-        Returns ("ok" | "queue_full" | "fail", row_or_reason, dt_s)."""
-        t0 = time.perf_counter()
-        with self._lock:
-            backend.outstanding += 1
-            backend.dispatched += 1
         try:
-            conn = backend.pool.checkout()
-            try:
-                row = conn.request(line, self.request_timeout_s)
-            except WireError:
-                backend.pool.discard(conn)
-                raise
-            backend.pool.checkin(conn)
-        except WireError as exc:
-            with self._lock:
-                backend.outstanding -= 1
-                backend.failed += 1
-                backend.healthy = False  # fail fast until a probe clears it
-            return ("fail", str(exc), time.perf_counter() - t0)
-        dt = time.perf_counter() - t0
-        with self._lock:
-            backend.outstanding -= 1
-            if row.get("error") == "queue_full":
-                backend.queue_full += 1
-                return ("queue_full", row, dt)
-            backend.ok += 1
-        return ("ok", row, dt)
+            return self.loop.run_sync(_read)
+        except (LoopClosedError, TimeoutError):
+            return _read()
+
+    # -- the request state machine (loop thread) --
+
+    def _submit(self, msg: dict | None, raw_line: str, on_done) -> None:
+        """Loop-thread entry: admit one routed request.  ``msg`` may be
+        None (the front session's no-parse fast path); the request id
+        is then recovered lazily, only on paths that need it."""
+        self._counters["requests"] += 1
+        if self._mint_only:
+            # head sampling is off: no Trace object can ever be
+            # retained at start, so mint the wire-correlation ID from
+            # the loop-owned counter and skip the tracer entirely
+            trace = None
+            self._wire_seq += 1
+            wire_trace = (
+                f"{(self._wire_base + self._wire_seq) & _WIRE_MASK:016x}"
+            )
+        else:
+            if msg is None:
+                try:
+                    parsed = json.loads(raw_line)
+                    msg = parsed if isinstance(parsed, dict) else {}
+                except ValueError:
+                    msg = {}
+            trace = self.obs.tracer.start(msg.get("id"))
+            wire_trace = trace.trace_id if trace is not None else None
+        if wire_trace is None:
+            wire_line = raw_line
+        else:
+            # splice the minted trace into the raw line instead of
+            # re-serializing the whole object (a dict copy + dumps per
+            # request is measurable at saturation).  A client-supplied
+            # "trace" key becomes a duplicate; JSON parsers take the
+            # LAST occurrence, so the router's ID still wins — same
+            # override {**msg, "trace": ...} used to perform.
+            stripped = raw_line.rstrip()
+            if stripped.endswith("}") and not stripped.endswith("{}"):
+                wire_line = (
+                    f'{stripped[:-1]},"trace":"{wire_trace}"}}'
+                )
+            else:
+                wire_line = json.dumps(
+                    {**(msg or {}), "trace": wire_trace}
+                )
+        req = _Request(msg, wire_line, trace, wire_trace, on_done)
+        if self._closing:
+            self._deliver(req, {"id": req.rid, "error": "router_closed"},
+                          admitted=False)
+            return
+        if self._active >= self.max_concurrency:
+            self._admission.append(req)
+            return
+        self._begin(req)
+
+    def _begin(self, req: _Request) -> None:
+        self._active += 1
+        self._inflight.add(req)
+        req.t0 = time.perf_counter()
+        req.deadline = req.t0 + self.dispatch_wait_s
+        self._dispatch_round(req)
+
+    def _dispatch_round(self, req: _Request) -> None:
+        req.repick_timer = None
+        if req.finished:
+            return
+        if self._closing:
+            self._finish_error(req, "router_closed")
+            return
+        now = time.perf_counter()
+        if now >= req.deadline:
+            self._finish_deadline(req)
+            return
+        name = self._pick(exclude=req.tried)
+        if name is None:
+            if req.queue_full_rows:
+                # no untried replica left and at least one answered
+                # queue_full: surface the backpressure NOW — the
+                # client's retry_after backoff beats burning the
+                # dispatch window hammering shedding workers
+                self._finish_queue_full(req)
+                return
+            if req.tried:
+                # every current backend failed this request; a restart
+                # may bring one back before the deadline
+                req.tried.clear()
+            req.repick_timer = self.loop.call_later(
+                _REPICK_DELAY_S, self._dispatch_round, req
+            )
+            return
+        if not req.first_round:
+            self._counters["retries"] += 1
+        req.first_round = False
+        self._send_arm(req, name, is_hedge=False)
+
+    def _send_arm(self, req: _Request, name: str, is_hedge: bool) -> None:
+        req.tried.add(name)
+        backend = self.backends[name]
+        if req.trace is not None:
+            req.trace.add_span(
+                "hedge" if is_hedge else "route", 0.0,
+                note=f"to={name} load={backend.load()}",
+            )
+        attempt = _Attempt(req, backend, is_hedge)
+        req.arms.append(attempt)
+        backend.outstanding += 1
+        backend.dispatched += 1
+        # deadline BEFORE send: a synchronously-failing send resolves
+        # the attempt re-entrantly; the periodic sweep only ever sees
+        # unresolved FIFO entries, each already stamped
+        attempt.deadline = time.perf_counter() + self.request_timeout_s
+        backend.acquire_conn(self).send(attempt)
+        if attempt.resolved or req.finished:
+            return
+        if not is_hedge and not req.hedge_started:
+            delay = self._hedge_delay_s()
+            if delay is not None:
+                req.hedge_timer = self.loop.call_later(
+                    delay, self._hedge_fire, req
+                )
+
+    def _arm_timeout_sweep(self) -> None:
+        """(Re)arm the attempt-timeout sweep.  One periodic timer for
+        the whole router replaces a ``call_later`` + ``cancel`` per
+        request — at saturation that heap churn was one of the largest
+        single per-request costs.  FIFO order makes the sweep O(pool):
+        attempts on one connection share a timeout, so only each FIFO
+        HEAD can be the oldest — precision is the sweep period (at most
+        ``request_timeout_s/8``), fine for a seconds-scale backstop."""
+        if self._closing:
+            return
+        period = max(0.05, min(self.request_timeout_s / 8.0, 0.5))
+        self._timeout_sweep_timer = self.loop.call_later(
+            period, self._timeout_sweep
+        )
+
+    def _timeout_sweep(self) -> None:
+        now = time.perf_counter()
+        for backend in self.backends.values():
+            for conn in list(backend.conns):
+                fifo = conn.fifo
+                if fifo and fifo[0].deadline <= now:
+                    self._attempt_timeout(fifo[0])
+        self._arm_timeout_sweep()
+
+    def _attempt_timeout(self, attempt: _Attempt) -> None:
+        if attempt.resolved:
+            return
+        conn = attempt.conn
+        if conn is not None and conn.state != "closed":
+            # the connection is head-of-line blocked on this request:
+            # closing it fails this attempt AND everything queued
+            # behind it over to other replicas
+            conn.close(
+                f"request timeout after {self.request_timeout_s}s"
+            )
+        if not attempt.resolved:
+            # belt and braces: an attempt must NEVER outlive its
+            # deadline unresolved (a stranded request would hang its
+            # client)
+            self._attempt_resolved(
+                attempt, "fail",
+                f"{attempt.backend.name}: request timeout after "
+                f"{self.request_timeout_s}s",
+            )
+
+    def _hedge_fire(self, req: _Request) -> None:
+        req.hedge_timer = None
+        if req.finished or self._closing:
+            return
+        second = self._pick(exclude=req.tried)
+        if second is None:
+            return
+        self._counters["hedges_started"] += 1
+        req.hedge_started = True
+        self._send_arm(req, second, is_hedge=True)
 
     def _hedge_delay_s(self) -> float | None:
         """Seconds to wait before hedging, or None (hedging off / not
@@ -357,200 +976,248 @@ class Router:
             return None
         if self.hedge_ms != "auto":
             return float(self.hedge_ms) / 1000.0
+        # the auto p95 snapshot sorts the latency reservoir — too much
+        # per-request work at saturation, so memoize for 50 ms
+        now = time.perf_counter()
+        cached = self._hedge_p95_cache
+        if cached is not None and now - cached[0] < 0.05:
+            return cached[1]
         snap = self._latency.snapshot()
         if (snap["count"] or 0) < self.hedge_min_samples:
-            return None
-        return max(snap["p95_ms"], self.hedge_floor_ms) / 1000.0
+            delay = None
+        else:
+            delay = max(snap["p95_ms"], self.hedge_floor_ms) / 1000.0
+        self._hedge_p95_cache = (now, delay)
+        return delay
+
+    def _attempt_resolved(
+        self, attempt: _Attempt, outcome: str, payload, text=None
+    ) -> None:
+        """One arm came back: a response row ("ok"/"queue_full") or a
+        death ("fail", payload is the reason string).  ``text`` is the
+        raw response line when one exists — the serialization fast path
+        for front sessions."""
+        if attempt.resolved:
+            return
+        attempt.resolved = True
+        backend = attempt.backend
+        backend.outstanding -= 1
+        if outcome == "ok":
+            backend.ok += 1
+        elif outcome == "queue_full":
+            backend.queue_full += 1
+        else:
+            backend.failed += 1
+            backend.healthy = False  # fail fast until a probe clears it
+        req = attempt.request
+        if req.finished:
+            return  # a hedge loser's late answer: discarded
+        if outcome == "ok":
+            self._finish_ok(req, payload, attempt, text)
+            return
+        if outcome == "queue_full":
+            req.queue_full_rows.append(payload)
+            self._counters["queue_full_failovers"] += 1
+            if req.trace is not None:
+                req.trace.add_span(
+                    "failover", 0.0,
+                    note=f"queue_full from {backend.name}",
+                )
+        else:
+            req.last_reason = str(payload)
+            self._counters["failovers"] += 1
+            if req.trace is not None:
+                req.trace.add_span(
+                    "failover", 0.0,
+                    note=f"{backend.name}: {req.last_reason[:120]}",
+                )
+        if any(not a.resolved for a in req.arms):
+            return  # a twin is still racing: let it finish
+        if req.hedge_timer is not None:
+            req.hedge_timer.cancel()
+            req.hedge_timer = None
+        # every arm is dead: the next retry round starts from scratch
+        # and may arm a FRESH hedge (the per-round racing semantics of
+        # the old inline core) — the post-failover straggler window is
+        # exactly where tail-cutting pays
+        req.hedge_started = False
+        self._dispatch_round(req)
+
+    # -- finishing --
+
+    def _finish_ok(self, req: _Request, payload: dict,
+                   attempt: _Attempt, text=None) -> None:
+        if req.hedge_started:
+            self._counters[
+                "hedges_won" if attempt.is_hedge else "hedges_lost"
+            ] += 1
+        dt = time.perf_counter() - req.t0
+        self._latency.record(dt)
+        self._latency_hist.observe(dt)
+        self._counters["ok"] += 1
+        if req.trace is not None:
+            self.obs.tracer.finish(req.trace, "ok")
+        elif self._mint_only and dt * 1000.0 >= self.obs.tracer.slow_ms:
+            # no Trace object on the mint-only path — retain the slow
+            # exemplar (span-less) from the measured latency instead
+            self.obs.tracer.note_slow(
+                req.wire_trace, req.rid, req.t0, dt
+            )
+        # the serialization fast path: splice "worker" into the raw
+        # response line instead of parsing + re-dumping the row — front
+        # sessions write this text verbatim, and the blocking
+        # dispatch() facade parses it on ITS thread, never the loop.
+        # ``payload is None`` marks the fast path (_on_line verified
+        # the line carries id + matching trace and no error field).
+        if payload is None:
+            out_text = (
+                f'{text[:-1]},"worker":"{attempt.backend.name}"}}'
+            )
+            self._deliver(req, None, out_text)
+            return
+        payload.setdefault("id", req.rid)
+        payload["worker"] = attempt.backend.name
+        out_text = None
+        if (
+            text is not None
+            and text.endswith("}")
+            and '"id"' in text
+        ):
+            out_text = (
+                f'{text[:-1]},"worker":"{attempt.backend.name}"}}'
+            )
+        self._deliver(req, payload, out_text)
+
+    def _finish_queue_full(self, req: _Request) -> None:
+        self._counters["queue_full_returned"] += 1
+        if req.trace is not None:
+            self.obs.tracer.finish(req.trace, "queue_full")
+        row = min(
+            req.queue_full_rows,
+            key=lambda r: r.get("retry_after") or float("inf"),
+        )
+        row.setdefault("id", req.rid)
+        self._deliver(req, row)
+
+    def _finish_deadline(self, req: _Request) -> None:
+        if req.queue_full_rows:
+            self._finish_queue_full(req)
+            return
+        self._counters["no_backend"] += 1
+        if req.trace is not None:
+            self.obs.tracer.finish(req.trace, "no_backend")
+        row = {
+            "id": req.rid,
+            "error": f"no_backend_available: {req.last_reason}",
+        }
+        if req.wire_trace is not None:
+            row["trace"] = req.wire_trace
+        self._deliver(req, row)
+
+    def _finish_error(self, req: _Request, error: str) -> None:
+        row = {"id": req.rid, "error": error}
+        if req.trace is not None:
+            self.obs.tracer.finish(req.trace, error)
+        if req.wire_trace is not None:
+            row["trace"] = req.wire_trace
+        self._deliver(req, row)
+
+    def _deliver(self, req: _Request, row: dict, text=None,
+                 admitted: bool = True) -> None:
+        if req.finished:
+            return
+        req.finished = True
+        for timer in (req.hedge_timer, req.repick_timer):
+            if timer is not None:
+                timer.cancel()
+        req.hedge_timer = req.repick_timer = None
+        if admitted:
+            self._active -= 1
+            self._inflight.discard(req)
+            if not self._draining:
+                # a synchronously-finishing _begin (shutdown, instant
+                # error) re-enters _deliver; the guard leaves the drain
+                # to the OUTERMOST frame so a deep admission backlog
+                # cannot grow the stack
+                self._draining = True
+                try:
+                    while (
+                        self._admission
+                        and self._active < self.max_concurrency
+                    ):
+                        self._begin(self._admission.popleft())
+                finally:
+                    self._draining = False
+        try:
+            req.on_done(row, text)
+        except Exception:  # noqa: BLE001 — a dead client must not kill the loop
+            pass
+
+    # -- blocking facade (any thread) --
 
     def dispatch(self, msg: dict) -> dict:
-        """Route one classification request: pick, attempt (maybe
-        hedged), fail over on death/backpressure.  Always returns a
-        response row for the client."""
-        t0 = time.perf_counter()
-        rid = msg.get("id")
-        trace = self.obs.tracer.start(rid)
-        wire_msg = dict(msg)
-        if trace is not None:
-            wire_msg["trace"] = trace.trace_id
-        line = json.dumps(wire_msg)
-        with self._lock:
-            self._counters["requests"] += 1
-        tried: set[str] = set()
-        queue_full_rows: list[dict] = []
-        last_reason = "no healthy backend"
-        deadline = t0 + self.dispatch_wait_s
-        first_round = True
-        while time.perf_counter() < deadline:
-            name = self.pick(exclude=tried)
-            if name is None:
-                if queue_full_rows:
-                    # no untried replica left and at least one answered
-                    # queue_full: surface the backpressure NOW — the
-                    # client's retry_after backoff beats burning the
-                    # dispatch window hammering shedding workers
-                    break
-                if tried:
-                    # every current backend failed this request; a
-                    # restart may bring one back before the deadline
-                    tried = set()
-                # bounded 50 ms poll while the whole fleet is down —
-                # the asyncio router core replaces this parked thread
-                # with a timer wakeup (ROADMAP: async I/O core)
-                # analysis: disable=blocking-call
-                time.sleep(0.05)
-                continue
-            if not first_round:
-                with self._lock:
-                    self._counters["retries"] += 1
-            first_round = False
-            outcome, payload, winner = self._race(name, line, trace, tried)
-            if outcome == "ok":
-                dt = time.perf_counter() - t0
-                self._latency.record(dt)
-                self._latency_hist.observe(dt)
-                with self._lock:
-                    self._counters["ok"] += 1
-                if trace is not None:
-                    self.obs.tracer.finish(trace, "ok")
-                payload.setdefault("id", rid)
-                payload["worker"] = winner
-                return payload
-            if outcome == "queue_full":
-                queue_full_rows.append(payload)
-                with self._lock:
-                    self._counters["queue_full_failovers"] += 1
-                if trace is not None:
-                    trace.add_span(
-                        "failover", 0.0, note=f"queue_full from {winner}"
-                    )
-                continue
-            # death/timeout: retry elsewhere — content requests are
-            # idempotent by construction (pure function of content)
-            last_reason = str(payload)
-            with self._lock:
-                self._counters["failovers"] += 1
-            if trace is not None:
-                trace.add_span(
-                    "failover", 0.0, note=f"{winner}: {last_reason[:120]}"
-                )
-        if queue_full_rows:
-            with self._lock:
-                self._counters["queue_full_returned"] += 1
-            if trace is not None:
-                self.obs.tracer.finish(trace, "queue_full")
-            row = min(
-                queue_full_rows,
-                key=lambda r: r.get("retry_after") or float("inf"),
-            )
-            row.setdefault("id", rid)
-            return row
-        with self._lock:
-            self._counters["no_backend"] += 1
-        if trace is not None:
-            self.obs.tracer.finish(trace, "no_backend")
-        row = {"id": rid, "error": f"no_backend_available: {last_reason}"}
-        if trace is not None:
-            row["trace"] = trace.trace_id
-        return row
+        """Route one classification request and block for its row —
+        the cross-thread facade over the event-loop state machine.
+        Always returns a response row for the client."""
+        if not self._started:
+            # no loop thread exists to run the state machine — fail
+            # fast instead of stalling out the dispatch budget
+            return {"id": msg.get("id"), "error": "router_not_started"}
+        done = threading.Event()
+        box: dict = {}
 
-    def _race(self, first: str, line: str, trace, tried: set):
-        """One dispatch round: the primary attempt plus, after the
-        hedge delay, an optional duplicate on a second worker.  First
-        answer wins; a failed arm waits for its twin before the round
-        reports failure.  Returns (outcome, payload, worker_name)."""
-        tried.add(first)
-        if trace is not None:
-            trace.add_span(
-                "route", 0.0,
-                note=f"to={first} load={self.backends[first].load()}",
-            )
-        hedge_delay = self._hedge_delay_s()
-        if hedge_delay is None:
-            # no hedge possible this round: run the attempt on the
-            # caller's thread — a thread spawn + queue handoff per
-            # request is pure overhead when nothing races
-            outcome, payload, _dt = self._attempt(
-                self.backends[first], line
-            )
-            return (outcome, payload, first)
-        results: SimpleQueue = SimpleQueue()
+        def on_done(row, text=None) -> None:
+            # fast-path deliveries carry only the spliced line; the
+            # parse happens HERE, on the caller's thread, not the loop
+            box["row"] = row
+            box["text"] = text
+            done.set()
 
-        # arms run on fresh daemon threads, deliberately NOT on
-        # self._executor: an arm can block up to request_timeout_s on a
-        # wedged worker, and a bounded shared pool would let a few
-        # stuck arms head-of-line-block every new session dispatch —
-        # the per-spawn cost is paid only on hedge-capable rounds
-        def run(name: str) -> None:
-            results.put((name, self._attempt(self.backends[name], line)))
-
-        threading.Thread(
-            target=run, args=(first,), daemon=True,
-            name=f"fleet-attempt-{first}",
-        ).start()
-        arms = [first]
-        start = time.perf_counter()
-        hedge_at = start + hedge_delay
-        deadline = start + self.request_timeout_s + 1.0
-        seen: dict[str, tuple] = {}
-        while time.perf_counter() < deadline:
-            now = time.perf_counter()
-            # clamp: the clock may cross `deadline` between the loop
-            # check and here, and a negative timeout raises ValueError
-            wait = max(deadline - now, 0.0)
-            if hedge_at is not None:
-                wait = min(wait, max(hedge_at - now, 0.0) + 1e-4)
+        raw_line = json.dumps(msg)
+        if not self.loop.call_soon_threadsafe(
+            self._submit, msg, raw_line, on_done
+        ):
+            return {"id": msg.get("id"), "error": "router_closed"}
+        # the state machine always answers by dispatch deadline +
+        # request timeout; the margin covers admission queueing
+        budget = self.dispatch_wait_s + self.request_timeout_s + 60.0
+        if not done.wait(budget):
+            return {
+                "id": msg.get("id"),
+                "error": f"internal_error: dispatch stalled > {budget}s",
+            }
+        row = box["row"]
+        if row is None:
             try:
-                name, res = results.get(timeout=wait)
-            except Empty:
-                name = None
-            if name is None:
-                if hedge_at is not None and time.perf_counter() >= hedge_at:
-                    hedge_at = None
-                    second = self.pick(exclude=tried)
-                    if second is not None:
-                        tried.add(second)
-                        arms.append(second)
-                        with self._lock:
-                            self._counters["hedges_started"] += 1
-                        if trace is not None:
-                            trace.add_span(
-                                "hedge", 0.0, note=f"to={second}"
-                            )
-                        threading.Thread(
-                            target=run, args=(second,), daemon=True,
-                            name=f"fleet-hedge-{second}",
-                        ).start()
-                continue
-            outcome, payload, _dt = res
-            seen[name] = res
-            if outcome == "ok":
-                if len(arms) == 2:
-                    won_by_hedge = name == arms[1]
-                    with self._lock:
-                        self._counters[
-                            "hedges_won" if won_by_hedge else "hedges_lost"
-                        ] += 1
-                return ("ok", payload, name)
-            if len(seen) < len(arms):
-                continue  # a twin is still racing: let it finish
-            # every arm answered without a verdict: report the least
-            # severe outcome (queue_full beats a dead connection — the
-            # client can at least back off)
-            for arm_name, (arm_outcome, arm_payload, _d) in seen.items():
-                if arm_outcome == "queue_full":
-                    return ("queue_full", arm_payload, arm_name)
-            return (outcome, payload, name)
-        return ("fail", f"race timeout after {self.request_timeout_s}s",
-                first)
+                row = json.loads(box["text"])
+            except ValueError:
+                # a worker line that slipped the fast-path substring
+                # heuristics but is not JSON: an error row, never an
+                # exception out of the blocking facade
+                row = {"id": msg.get("id"),
+                       "error": "internal_error: unparseable worker "
+                       "response"}
+        return row
 
     # -- ops surface (front-socket verbs + CLI) --
 
     def stats(self) -> dict:
-        with self._lock:
-            counters = dict(self._counters)
-            backends = {
-                name: b.as_dict() for name, b in self.backends.items()
+        def _snapshot() -> dict:
+            return {
+                "counters": dict(self._counters),
+                "backends": {
+                    name: b.as_dict()
+                    for name, b in self.backends.items()
+                },
+                "active": self._active,
+                "admission_queued": len(self._admission),
             }
+
+        try:
+            snap = self.loop.run_sync(_snapshot)
+        except (LoopClosedError, TimeoutError):
+            snap = _snapshot()
+        backends = snap["backends"]
         if self.supervisor is not None:
             sup = self.supervisor.status()
             for name, row in backends.items():
@@ -558,9 +1225,14 @@ class Router:
         return {
             "uptime_s": self.obs.uptime_s(),
             "router": {
-                **counters,
+                **snap["counters"],
                 "latency_ms": self._latency.snapshot(),
                 "hedge_ms": self.hedge_ms,
+                "active": snap["active"],
+                "admission_queued": snap["admission_queued"],
+                "loop_lag_ms": self.loop.lag_ms(),
+                "loop_max_lag_ms": self.loop.max_lag_ms(),
+                "pool_per_worker": self.pool_per_worker,
             },
             "backends": backends,
             "tracing": self.obs.tracer.stats(),
@@ -574,9 +1246,9 @@ class Router:
         for name, backend in self.backends.items():
             try:
                 # a fleet scrape IS a synchronous fan-out by contract:
-                # it runs on the stats verb's session writer thread and
-                # tolerates probe_timeout_s per worker; the async core
-                # will pipeline these round trips
+                # it runs on the ops executor (front sessions) or the
+                # caller's thread (CLI), never on the event loop, and
+                # tolerates probe_timeout_s per worker
                 # analysis: disable=blocking-call
                 row = oneshot(
                     backend.socket_path,
@@ -605,157 +1277,233 @@ class Router:
         return self.supervisor.reload_fleet(corpus)
 
 
-class _RouterSession:
-    """One client session on the front socket: parse lines, dispatch
-    concurrently, answer IN REQUEST ORDER (same contract as a worker
-    session, so clients cannot tell a router from a worker)."""
 
-    def __init__(self, router: Router, write_line):
+# front-session inbound flow control: above HIGH queued response slots
+# the client socket read pauses (the kernel buffer then pushes back on
+# an open-loop client outrunning the fleet), resuming below LOW
+_SESSION_HIGH = 1024
+_SESSION_LOW = 256
+
+
+class _FrontSession:
+    """One client session on the front socket, entirely on the router's
+    event loop: parse lines, dispatch concurrently, answer IN REQUEST
+    ORDER (same contract as a worker session, so clients cannot tell a
+    router from a worker).
+
+    Each request occupies one slot in an ordered queue; content rows
+    dispatch immediately and fill their slot whenever they finish,
+    while ops verbs (stats/trace/prometheus/reload) start only when
+    their slot reaches the HEAD — so a stats row reports "as of this
+    point in the session", exactly like the old writer thread."""
+
+    def __init__(self, router: Router, server: "FrontServer",
+                 conn: LineConn):
         self.router = router
-        self._write_line = write_line
-        self._pending: deque = deque()  # ("fut", Future) | ("op", ...)
-        self._cond = threading.Condition()
-        self._closed = False
-        self.requests = 0
-        self.responses = 0
-        self._writer = threading.Thread(
-            target=self._drain, name="fleet-writer", daemon=True
+        self.server = server
+        self.conn = conn
+        self.slots: deque[dict] = deque()
+        self.paused = False
+        conn.on_line = self.handle_line
+        conn.on_close = self._on_close
+
+    def _on_close(self, _reason) -> None:
+        self.server.forget_connection(self.conn)
+        self.slots.clear()  # in-flight fills find no slot: dropped
+
+    def _push(self, kind: str, payload=None, row=None) -> None:
+        self.slots.append(
+            {"kind": kind, "payload": payload, "row": row,
+             "text": None, "started": False}
         )
-        self._writer.start()
+        if not self.paused and len(self.slots) > _SESSION_HIGH:
+            self.paused = True
+            self.conn.pause_reading()
+        self._flush()
 
-    def _emit(self, kind, payload) -> None:
-        with self._cond:
-            self._pending.append((kind, payload))
-            self._cond.notify_all()
+    def _submit_content(self, line: str, msg: dict | None = None) -> None:
+        """Queue a content row's slot and dispatch it — unlike _push
+        the slot is born started (routing begins now, not at the head)
+        and nothing flushes until the dispatch fills it."""
+        slot = {"kind": "content", "payload": None, "row": None,
+                "text": None, "started": True}
+        self.slots.append(slot)
+        if not self.paused and len(self.slots) > _SESSION_HIGH:
+            self.paused = True
+            self.conn.pause_reading()
+        self.router._submit(
+            msg, line,
+            lambda row, text=None, s=slot: self._fill(s, row, text),
+        )
 
-    def _drain(self) -> None:
-        while True:
-            with self._cond:
-                while not self._pending and not self._closed:
-                    self._cond.wait()
-                if not self._pending and self._closed:
-                    return
-                kind, payload = self._pending.popleft()
-            if kind == "fut":
-                try:
-                    row = payload.result()
-                except Exception as exc:  # noqa: BLE001 — session containment
-                    row = {"id": None, "error": f"internal_error: {exc}"}
-            elif kind == "stats":
-                rid, fmt = payload
-                if fmt == "prometheus":
-                    row = {"id": rid,
-                           "prometheus": self.router.prometheus()}
-                else:
-                    row = {"id": rid, "stats": self.router.stats()}
-            elif kind == "trace":
-                rid, n = payload
-                row = {"id": rid, "traces": self.router.trace_tail(n)}
-            elif kind == "reload":
-                rid, corpus = payload
-                try:
-                    # a fleet reload IS a long synchronous ops verb by
-                    # contract: it runs on this session's writer thread
-                    # (same as the prometheus fan-out scrape) and holds
-                    # only this session's response stream, never the
-                    # dispatch path
-                    row = {"id": rid,
-                           "reload": self.router.reload_fleet(corpus)}
-                except Exception as exc:  # noqa: BLE001 — session containment
-                    row = {"id": rid, "error": f"reload_failed: {exc}"}
-            else:
-                row = payload
+    def _fill(self, slot: dict, row: dict, text=None) -> None:
+        slot["row"] = row
+        slot["text"] = text
+        self._flush()
+
+    def _flush(self) -> None:
+        """Write every ready head slot; start the head ops verb when it
+        surfaces.  Iterative (never recursive): a burst of inline ops
+        verbs must not grow the stack."""
+        while self.slots:
+            head = self.slots[0]
+            if head["row"] is None and head["text"] is None:
+                if not head["started"]:
+                    self._start_op(head)  # inline ops fill head now
+                if head["row"] is None and head["text"] is None:
+                    return  # waiting on a dispatch or a deferred op
+            self.slots.popleft()
             try:
-                self._write_line(json.dumps(row))
-            except (OSError, ValueError):
-                return
-            self.responses += 1
+                # the router spliced a ready-to-write line for routed
+                # content rows; ops verbs and error rows serialize here
+                self.conn.write_line_on_loop(
+                    head["text"] or json.dumps(head["row"])
+                )
+            except OSError:
+                return  # client went away; _on_close drops the rest
+            if self.paused and len(self.slots) < _SESSION_LOW:
+                self.paused = False
+                self.conn.resume_reading()
 
     def handle_line(self, line: str) -> None:
         line = line.strip()
         if not line:
             return
-        self.requests += 1
+        if (
+            '"op"' not in line
+            and line.startswith("{")
+            and line.endswith("}")
+        ):
+            # content-row fast path: without the '"op"' substring the
+            # line cannot carry an ops verb, so skip the parse entirely
+            # — the WORKER validates the payload anyway (one validator,
+            # serve/server.py), including lines that turn out to be
+            # malformed JSON.  At saturation the per-request
+            # ``json.loads`` here was the single largest loop cost.
+            self._submit_content(line)
+            return
         try:
             msg = json.loads(line)
             if not isinstance(msg, dict):
                 raise ValueError("request must be a JSON object")
         except ValueError as exc:
-            self._emit("raw", {"id": None, "error": f"bad_request: {exc}"})
+            self._push("raw",
+                       row={"id": None, "error": f"bad_request: {exc}"})
             return
         rid = msg.get("id")
         op = msg.get("op")
+        if op is None:
+            # content row: the WORKER validates the payload (one
+            # validator, serve/server.py) — the router only owns routing
+            self._submit_content(line, msg)
+            return
         if op == "stats":
             fmt = msg.get("format")
             if fmt not in (None, "json", "prometheus"):
-                self._emit(
-                    "raw",
-                    {"id": rid,
-                     "error": f"bad_request: unknown stats format {fmt!r}"},
+                self._push("raw", row={
+                    "id": rid,
+                    "error": f"bad_request: unknown stats format {fmt!r}",
+                })
+            else:
+                self._push(
+                    "prometheus" if fmt == "prometheus" else "stats", rid
                 )
-                return
-            self._emit("stats", (rid, fmt))
-            return
-        if op == "trace":
+        elif op == "trace":
             n = msg.get("n", 20)
             if isinstance(n, bool) or not isinstance(n, int) or n < 0:
-                self._emit(
-                    "raw",
-                    {"id": rid,
-                     "error": "bad_request: n must be a non-negative int"},
-                )
-                return
-            self._emit("trace", (rid, n))
-            return
-        if op == "reload":
+                self._push("raw", row={
+                    "id": rid,
+                    "error": "bad_request: n must be a non-negative int",
+                })
+            else:
+                self._push("trace", (rid, n))
+        elif op == "reload":
             corpus = msg.get("corpus")
             if not isinstance(corpus, str) or not corpus:
-                self._emit(
-                    "raw",
-                    {"id": rid,
-                     "error": "bad_request: reload needs a 'corpus' "
-                     "source string"},
-                )
-                return
-            self._emit("reload", (rid, corpus))
-            return
-        if op is not None:
-            self._emit(
-                "raw", {"id": rid, "error": f"bad_request: unknown op {op!r}"}
-            )
-            return
-        # content rows: the WORKER validates the payload (one
-        # validator, serve/server.py) — the router only owns routing
-        self._emit("fut", self.router._executor.submit(
-            self.router.dispatch, msg
-        ))
+                self._push("raw", row={
+                    "id": rid,
+                    "error": "bad_request: reload needs a 'corpus' "
+                    "source string",
+                })
+            else:
+                self._push("reload", (rid, corpus))
+        else:
+            self._push("raw", row={
+                "id": rid, "error": f"bad_request: unknown op {op!r}",
+            })
 
-    def finish(self) -> None:
-        with self._cond:
-            self._closed = True
-            self._cond.notify_all()
-        self._writer.join()
+    def _start_op(self, slot: dict) -> None:
+        """Run the head slot's ops verb — it starts only once every
+        earlier response has been written, so a stats row reports "as
+        of this point in the session".  Cheap loop-state snapshots
+        (trace) run inline; stats (supervisor lock), the fan-out
+        scrape, and the rolling reload can block and go to the ops
+        executor, filling their slot back via
+        ``call_soon_threadsafe``."""
+        slot["started"] = True
+        kind = slot["kind"]
+        if kind == "stats":
+            rid = slot["payload"]
+            # stats consults the supervisor, whose lock the monitor
+            # thread holds ACROSS a worker respawn's fork+exec — a
+            # stats verb landing in that window must wait on the ops
+            # executor, never on the loop thread
+            self._defer(slot, lambda: {
+                "id": rid, "stats": self.router.stats()
+            })
+        elif kind == "trace":
+            rid, n = slot["payload"]
+            slot["row"] = {
+                "id": rid, "traces": self.router.trace_tail(n)
+            }
+        elif kind == "prometheus":
+            rid = slot["payload"]
+            self._defer(slot, lambda: {
+                "id": rid, "prometheus": self.router.prometheus()
+            })
+        elif kind == "reload":
+            rid, corpus = slot["payload"]
+
+            def run_reload() -> dict:
+                try:
+                    return {"id": rid,
+                            "reload": self.router.reload_fleet(corpus)}
+                except Exception as exc:  # noqa: BLE001 — session containment
+                    return {"id": rid, "error": f"reload_failed: {exc}"}
+
+            self._defer(slot, run_reload)
+
+    def _defer(self, slot: dict, fn) -> None:
+        loop = self.router.loop
+
+        def run() -> None:
+            try:
+                row = fn()
+            except Exception as exc:  # noqa: BLE001 — session containment
+                row = {"id": None, "error": f"internal_error: {exc}"}
+            loop.call_soon_threadsafe(self._fill, slot, row)
+
+        self.router._ops.submit(run)
 
 
-def route_session(router: Router, lines, write_line) -> dict:
-    """Run one front-socket session over an iterable of lines."""
-    session = _RouterSession(router, write_line)
-    try:
-        for line in lines:
-            session.handle_line(line)
-    finally:
-        session.finish()
-    return {"requests": session.requests, "responses": session.responses}
+class FrontServer(LoopJsonlServer):
+    """The client-facing Unix socket: one JSONL session per connection,
+    all sharing one router AND its event loop — accepts, reads, writes,
+    dispatch, and slowloris reaping are all callbacks on the router's
+    single loop thread."""
 
-
-class FrontServer(JsonlUnixServer):
-    """The client-facing Unix socket: one JSONL session per
-    connection, all sharing one router (same transport class as a
-    worker — serve/server.py)."""
-
-    def __init__(self, path: str, router: Router):
+    def __init__(self, path: str, router: Router,
+                 stall_timeout_s: float = 30.0):
         self.router = router
-        super().__init__(path)
+        router.loop.start()  # idempotent; the loop must carry accepts
+        super().__init__(
+            path, loop=router.loop, stall_timeout_s=stall_timeout_s
+        )
 
-    def run_session(self, lines, write_line) -> None:
-        route_session(self.router, lines, write_line)
+    def handle_connection(self, sock) -> None:
+        conn = LineConn(
+            self.loop, sock, on_line=drop_line, on_close=drop_close
+        )
+        self.track_connection(conn)
+        _FrontSession(self.router, self, conn)
+

@@ -99,6 +99,93 @@ def _worker_trace_ids(socket_path: str) -> set[str]:
     }
 
 
+def _saturation_smoke(
+    front_path: str, router, problems: list[str], stub: bool,
+) -> dict:
+    """The open-loop burst gate: C client connections write EVERY
+    request line up front (no request/response lockstep — open-loop
+    arrival, the shape that used to stall the thread-per-attempt
+    router), while a slowloris dribbles a never-finished line
+    alongside.  The gates:
+
+    * every request answers (no stalled client) with zero errors;
+    * the router's event-loop lag gauge stayed bounded — a blocked
+      loop callback shows up here in seconds, long before p99 does;
+    * the slowloris was reaped by the stall sweep, having held no
+      session, thread, or backend pool slot meanwhile."""
+    n_conns = 4 if stub else 2
+    n_per_conn = 100 if stub else 25
+    lag_budget_ms = 500.0 if stub else 1500.0
+    counts = [0] * n_conns
+    failures: list[str] = []
+
+    def client(idx: int) -> None:
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(front_path)
+                s.settimeout(120.0)
+                f = s.makefile("rwb")
+                for i in range(n_per_conn):
+                    f.write((json.dumps(
+                        {"id": i, "content": f"burst {idx} {i}"}
+                    ) + "\n").encode("utf-8"))
+                f.flush()  # all lines in flight at once: open-loop
+                for _ in range(n_per_conn):
+                    row = json.loads(f.readline())
+                    if row.get("error"):
+                        failures.append(f"burst error: {row}")
+                    counts[idx] += 1
+        except (OSError, ValueError) as exc:
+            failures.append(f"burst client {idx}: {exc}")
+
+    loris = faults.Slowloris(
+        front_path, mode="dribble", byte_interval_s=0.25, give_up_s=30.0
+    )
+    loris_box: dict = {}
+    loris_thread = threading.Thread(
+        target=lambda: loris_box.update(loris.run()), daemon=True
+    )
+    loris_thread.start()
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(n_conns)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180.0)
+    elapsed = time.perf_counter() - t0
+    loris_thread.join(timeout=40.0)
+    answered = sum(counts)
+    if answered != n_conns * n_per_conn:
+        problems.append(
+            f"saturation burst: {answered}/{n_conns * n_per_conn} "
+            f"answered — a client stalled"
+        )
+    if failures:
+        problems.append(
+            f"saturation burst: {len(failures)} failures, "
+            f"e.g. {failures[:3]}"
+        )
+    lag_ms = router.stats()["router"]["loop_max_lag_ms"]
+    if not (lag_ms < lag_budget_ms):
+        problems.append(
+            f"event-loop lag {lag_ms}ms >= {lag_budget_ms}ms during "
+            f"the open-loop burst — something blocked the loop"
+        )
+    if not loris_box.get("reaped"):
+        problems.append(
+            f"slowloris was not reaped during the burst: {loris_box}"
+        )
+    return {
+        "requests": answered,
+        "rps": round(answered / elapsed, 1) if elapsed > 0 else None,
+        "max_lag_ms": lag_ms,
+        "slowloris": loris_box,
+    }
+
+
 def selftest(
     verbose: bool = True,
     stub: bool = False,
@@ -106,6 +193,7 @@ def selftest(
     n_requests: int = 120,
 ) -> int:
     problems: list[str] = []
+    saturation: dict | None = None
     tmpdir = tempfile.mkdtemp(prefix="licensee-fleet-")
     sockets = {
         f"w{i}": os.path.join(tmpdir, f"w{i}.sock")
@@ -143,12 +231,18 @@ def selftest(
             )
             raise _Abort()
         router.start()
-        server = FrontServer(front_path, router)
+        # stall_timeout_s=2: honest clients write whole lines — only a
+        # slowloris sits mid-line for seconds, and the smoke wants its
+        # reap to land inside the test budget
+        server = FrontServer(front_path, router, stall_timeout_s=2.0)
         server_thread = threading.Thread(
             target=server.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True,
         )
         server_thread.start()
+
+        # -- open-loop saturation smoke (+ slowloris reap) --
+        saturation = _saturation_smoke(front_path, router, problems, stub)
 
         blobs = _client_blobs(stub)
         rows = _drive_traffic(
@@ -248,6 +342,7 @@ def selftest(
         summary = {
             "fleet_selftest": "ok" if not problems else "FAIL",
             "stub_workers": stub,
+            "saturation": saturation,
             "problems": problems,
         }
         sys.stderr.write(json.dumps(summary) + "\n")
@@ -657,7 +752,14 @@ def _drive_traffic(
                             problems.append("w0 had no pid at kill time")
                         else:
                             faults.kill(pid)
-                    time.sleep(0.005)
+                    # unpaced burst right before the kill: the paced
+                    # stream can be fully drained at kill time (the
+                    # probe conn's EOF flips the backend unhealthy the
+                    # same instant, so nothing would ever fail over) —
+                    # the gate wants the kill to land WITH requests in
+                    # flight on the victim
+                    if not (kill_at - 10 <= i + 1 < kill_at):
+                        time.sleep(0.005)
             except OSError as exc:
                 problems.append(f"client writer failed: {exc}")
 

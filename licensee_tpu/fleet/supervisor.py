@@ -43,7 +43,7 @@ import sys
 import threading
 import time
 
-from licensee_tpu.fleet.wire import WireError, oneshot
+from licensee_tpu.fleet.wire import ConnectionPool, WireError, oneshot
 from licensee_tpu.parallel.distributed import (
     apply_visible_chips,
     chips_for_worker,
@@ -247,6 +247,21 @@ class Supervisor:
         # clobbered respawn argv — the second roll is refused
         # deterministically instead, mirroring the worker-level verb.
         self._reload_fleet_lock = threading.Lock()
+        # one parked connection per worker for the recurring health
+        # probe: N workers x a fast probe interval used to dial a fresh
+        # socket every round.  The pool's stale-park retry absorbs
+        # worker restarts; max_idle=1 because probes are serial per
+        # worker (one monitor thread).
+        # connect_timeout=probe_timeout_s: the pool's default 2 s dial
+        # would outlast a fast probe budget and stall the serial
+        # monitor thread on a worker wedged at accept
+        self._probe_pools = {
+            name: ConnectionPool(
+                h.socket_path, max_idle=1,
+                connect_timeout=self.probe_timeout_s,
+            )
+            for name, h in self.workers.items()
+        }
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -287,6 +302,8 @@ class Supervisor:
         for handle in handles:
             self._terminate(handle, sigterm_timeout_s)
             handle.state = STOPPED
+        for pool in self._probe_pools.values():
+            pool.close()
 
     def __enter__(self):
         self.start()
@@ -408,11 +425,16 @@ class Supervisor:
     def probe(self, name: str) -> dict | None:
         """One ``{"op": "stats"}`` round trip to a worker; the stats
         dict, or None when the worker cannot answer."""
-        handle = self.workers[name]
-        try:
-            row = oneshot(
-                handle.socket_path, {"op": "stats"}, self.probe_timeout_s
+        pool = self._probe_pools.get(name)
+        if pool is None:  # dynamically added worker (tests)
+            handle = self.workers[name]
+            pool = ConnectionPool(
+                handle.socket_path, max_idle=1,
+                connect_timeout=self.probe_timeout_s,
             )
+            self._probe_pools[name] = pool
+        try:
+            row = pool.request({"op": "stats"}, self.probe_timeout_s)
         except WireError:
             return None
         stats = row.get("stats")

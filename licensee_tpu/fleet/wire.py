@@ -1,15 +1,26 @@
-"""Fleet-side JSONL wire helpers: one-shot control requests (health
-probes, scrapes) and a per-backend connection pool for the router's
-request path.
+"""Fleet-side JSONL wire helpers: one-shot control requests, and the
+pooled probe path the supervisor's health checks ride.
 
 Every worker speaks the serve transport (serve/server.py): one JSON
 object per line in, one per line out, in request order.  The fleet tier
 talks to workers over the same contract — a probe is just a session of
-one ``{"op": "stats"}`` line, and a routed request is a session of one
-classification line.  Pooled connections carry ONE in-flight request at
-a time, so the worker's in-order response guarantee is trivially the
-router's per-request correctness; a sick connection is closed, never
-reused.
+one ``{"op": "stats"}`` line.  Pooled connections carry ONE in-flight
+request at a time, so the worker's in-order response guarantee is
+trivially the caller's per-request correctness; a sick connection is
+closed, never reused.  (The ROUTER's request path no longer lives here:
+it pipelines over non-blocking per-worker pools on the event loop —
+fleet/router.py.)
+
+Probes reuse a parked connection instead of dialing per probe: N
+workers × a fast probe interval used to cost a fresh socket (and three
+syscalls) every round, and the timeout path could strand the fd.
+``ConnectionPool.request`` now guarantees the connection is either
+parked healthy or CLOSED — every exception path, timeout included,
+releases the fd — and retries ONCE on a fresh dial when a REUSED
+connection fails at the connection level (the parked socket had gone
+stale across a worker restart; a liveness verdict should not flap for
+that).  Timeouts are never retried: a wedged worker's probe must cost
+one timeout, not two.
 
 House rules (script/lint): monotonic clocks only, no print.
 """
@@ -24,8 +35,14 @@ from collections import deque
 
 class WireError(OSError):
     """The backend could not answer: connect/send/recv failed or timed
-    out, or the response line was not JSON.  The router treats every
-    WireError the same way — the attempt failed, fail over."""
+    out, or the response line was not JSON.  ``kind`` says which
+    failure class: "connect" (dial failed), "timeout" (the peer is
+    there but silent), "closed" (peer hung up), or "protocol" (bad
+    response line) — the pool's retry policy keys off it."""
+
+    def __init__(self, message: str, kind: str = "io"):
+        super().__init__(message)
+        self.kind = kind
 
 
 class Connection:
@@ -40,7 +57,9 @@ class Connection:
             self._file = self._sock.makefile("rwb")
         except OSError as exc:
             self._sock.close()
-            raise WireError(f"connect {path!r}: {exc}") from exc
+            raise WireError(
+                f"connect {path!r}: {exc}", kind="connect"
+            ) from exc
 
     def request(self, line: str, timeout: float) -> dict:
         """Send one request line, block for one response row."""
@@ -49,16 +68,25 @@ class Connection:
             self._file.write(line.encode("utf-8") + b"\n")
             self._file.flush()
             raw = self._file.readline()
+        except socket.timeout as exc:
+            raise WireError(
+                f"io {self.path!r}: {exc}", kind="timeout"
+            ) from exc
         except OSError as exc:
             raise WireError(f"io {self.path!r}: {exc}") from exc
         if not raw:
-            raise WireError(f"{self.path!r}: peer closed the connection")
+            raise WireError(
+                f"{self.path!r}: peer closed the connection",
+                kind="closed",
+            )
         try:
             row = json.loads(raw.decode("utf-8", errors="replace"))
             if not isinstance(row, dict):
                 raise ValueError("response must be a JSON object")
         except ValueError as exc:
-            raise WireError(f"{self.path!r}: bad response: {exc}") from exc
+            raise WireError(
+                f"{self.path!r}: bad response: {exc}", kind="protocol"
+            ) from exc
         return row
 
     def close(self) -> None:
@@ -70,6 +98,12 @@ class Connection:
             self._sock.close()
         except OSError:
             pass
+
+
+# WireError kinds where a parked connection's failure says "this socket
+# went stale" (worker restarted under us) rather than "the worker is
+# sick" — worth one fresh dial before reporting failure
+_RETRY_FRESH_KINDS = ("connect", "closed", "io")
 
 
 class ConnectionPool:
@@ -113,23 +147,65 @@ class ConnectionPool:
         for conn in idle:
             conn.close()
 
-    def request(self, payload: dict, timeout: float) -> dict:
-        """Pooled single request/response round trip."""
-        conn = self.checkout()
+    def request(
+        self, payload: dict, timeout: float, *, retry_fresh: bool = True
+    ) -> dict:
+        """Pooled single request/response round trip — the probe
+        primitive (supervisor health checks ride this every interval).
+
+        The connection is either parked back healthy or CLOSED: every
+        exception path — the probe-timeout path included — releases the
+        fd in ``finally``, so a fast probe cadence can never leak
+        sockets.  When a REUSED connection fails at the connection
+        level (stale park across a worker restart), one fresh dial
+        retries before the failure is reported; a "timeout" is never
+        retried — a wedged worker must cost one probe timeout, not
+        two."""
+        line = json.dumps(payload)
+        reused = False
+        with self._lock:
+            conn = self._idle.pop() if self._idle else None
+            reused = conn is not None
+        if conn is None:
+            conn = Connection(self.path, self.connect_timeout)
+        ok = False
         try:
-            row = conn.request(json.dumps(payload), timeout)
-        except WireError:
-            self.discard(conn)
-            raise
-        self.checkin(conn)
-        return row
+            row = conn.request(line, timeout)
+            ok = True
+            return row
+        except WireError as exc:
+            if not (
+                reused
+                and retry_fresh
+                and exc.kind in _RETRY_FRESH_KINDS
+            ):
+                raise
+        finally:
+            if ok:
+                self.checkin(conn)
+            else:
+                conn.close()
+        # the stale-park retry: one fresh dial, same guarantees
+        conn = Connection(self.path, self.connect_timeout)
+        ok = False
+        try:
+            row = conn.request(line, timeout)
+            ok = True
+            return row
+        finally:
+            if ok:
+                self.checkin(conn)
+            else:
+                conn.close()
 
 
 def oneshot(path: str, payload: dict, timeout: float = 2.0) -> dict:
-    """Un-pooled request/response on a fresh connection — the probe
-    primitive (supervisor health checks, stats scrapes).  A fresh
-    connection per probe means a probe can never be queued behind a
-    stuck request on a shared stream."""
+    """Un-pooled request/response on a fresh connection — for one-off
+    control verbs (CLI scrapes, reload verbs with their own long
+    timeouts).  The socket is closed in ``finally`` on every path.
+    Recurring probes should ride ``ConnectionPool.request`` instead:
+    a fresh dial per probe interval is measurable churn at fleet
+    scale."""
     conn = Connection(path, timeout)
     try:
         return conn.request(json.dumps(payload), timeout)

@@ -147,6 +147,15 @@ class Tracer:
         self.retained = 0
         self.slow = 0
 
+    @property
+    def mint_only(self) -> bool:
+        """True when head sampling is off (``sample_rate == 0``): no
+        trace can be retained at start time, so a caller that only
+        needs wire-correlation IDs may mint them itself and skip the
+        Trace object — handing measured durations back through
+        :meth:`note_slow` to keep the slow-exemplar ring honest."""
+        return self._stride == 0
+
     def start(self, request_id=None, trace_id: str | None = None) -> Trace:
         """Mint a trace — or, with ``trace_id``, ADOPT an upstream hop's
         ID (the fleet router forwards its ID to the worker so both tails
@@ -161,6 +170,32 @@ class Tracer:
             trace_id = f"{(self._base + seq) & 0xFFFFFFFFFFFFFFFF:016x}"
         sampled = self._stride > 0 and (seq % self._stride == 0)
         return Trace(trace_id, request_id, time.perf_counter(), sampled)
+
+    def note_slow(
+        self,
+        trace_id: str,
+        request_id,
+        t_start: float,
+        dur_s: float,
+        status: str = "ok",
+    ) -> bool:
+        """Retain a span-less slow exemplar for a request the caller
+        timed itself — the mint-only fast path (sampling off) skips
+        Trace objects entirely, so the router hands the measured
+        duration back here only when it crosses ``slow_ms``.  Returns
+        True when retained."""
+        if dur_s * 1000.0 < self.slow_ms:
+            return False
+        trace = Trace(trace_id, request_id, t_start, False)
+        trace.status = status
+        trace.dur_s = dur_s
+        with self._lock:
+            self.retained += 1
+            self.slow += 1
+            self._ring.append(trace)
+        if self.log_path:
+            self._log_exemplar(trace)
+        return True
 
     def finish(self, trace: Trace, status: str = "ok") -> bool:
         """Close the trace; returns True when it was retained (sampled
@@ -227,11 +262,16 @@ class NullTracer:
     sample_rate = 0.0
     slow_ms = float("inf")
     log_path = None
+    mint_only = False  # no IDs at all: wire lines go out un-spliced
 
     def start(self, request_id=None, trace_id=None):
         return None
 
     def finish(self, trace, status="ok") -> bool:
+        return False
+
+    def note_slow(self, trace_id, request_id, t_start, dur_s,
+                  status="ok") -> bool:
         return False
 
     def tail(self, n: int = 20) -> list:

@@ -40,15 +40,27 @@ from __future__ import annotations
 import base64
 import json
 import os
+import queue
 import re
-import socket
-import socketserver
-import stat
 import threading
 from collections import deque
 
 from licensee_tpu.corpus.artifact import short_fingerprint
+from licensee_tpu.serve.eventloop import (
+    LineConn,
+    LoopJsonlServer,
+    SocketInUseError,
+    drop_close,
+    drop_line,
+    prepare_unix_socket_path,
+)
 from licensee_tpu.serve.scheduler import MicroBatcher, QueueFullError
+
+__all__ = [
+    "serve_session", "serve_stdio", "serve_unix", "selftest",
+    "selftest_reload", "JsonlUnixServer", "UnixServer",
+    "SocketInUseError", "prepare_unix_socket_path",
+]
 
 # an upstream hop's trace ID (the fleet router's): 16 lowercase hex
 TRACE_ID_RE = re.compile(r"\A[0-9a-f]{16}\Z")
@@ -339,106 +351,121 @@ def serve_stdio(batcher: MicroBatcher, stdin=None, stdout=None) -> dict:
     return serve_session(batcher, stdin, write_line)
 
 
-class SocketInUseError(OSError):
-    """The Unix socket path is owned by a LIVE server (a connect
-    succeeded), or by something that is not a socket at all — binding
-    over it would hijack a running worker or destroy a user's file."""
+# sentinel marking end-of-stream on a session inbox
+_EOF = object()
+
+# inbound flow control: pause the socket read above HIGH queued lines,
+# resume below LOW — the kernel socket buffer then pushes back on a
+# client outrunning its session, exactly as blocking reads once did
+_INBOX_HIGH = 1024
+_INBOX_LOW = 256
 
 
-def prepare_unix_socket_path(path: str) -> None:
-    """Make ``path`` bindable: unlink a STALE socket file (the leftover
-    of a SIGKILLed worker — bind would otherwise fail with EADDRINUSE
-    forever), but refuse to touch a live server's socket or a
-    non-socket file.  Liveness is probed by connecting: a dead owner's
-    socket refuses (ECONNREFUSED), a live one accepts."""
-    try:
-        st = os.lstat(path)
-    except OSError:
-        return  # nothing there: bind will create it
-    if not stat.S_ISSOCK(st.st_mode):
-        raise SocketInUseError(
-            f"{path!r} exists and is not a socket; refusing to unlink"
+class _SessionPump:
+    """Glue between one LineConn (loop thread) and one session thread:
+    lines flow loop -> inbox -> session, responses flow session ->
+    ``conn.write_line`` -> loop.  The socket never parks the session
+    thread, and the session never parks the loop."""
+
+    def __init__(self, server: "JsonlUnixServer", conn: LineConn):
+        self.server = server
+        self.conn = conn
+        self.inbox: queue.SimpleQueue = queue.SimpleQueue()
+        self._paused = False  # loop-thread written, session-thread read
+        conn.on_line = self._on_line
+        conn.on_close = self._on_close
+        self.thread = threading.Thread(
+            target=self._run_session_thread,
+            name="serve-session",
+            daemon=True,
         )
-    import errno
+        self.thread.start()
 
-    probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    try:
-        probe.settimeout(1.0)
-        probe.connect(path)
-    except socket.timeout:
-        # a listener that is merely SLOW to accept (wedged worker with
-        # a full backlog) is still an owner — hijacking it on a probe
-        # timeout would be exactly the theft this function prevents
-        raise SocketInUseError(
-            f"{path!r}: liveness probe timed out (a wedged owner?); "
-            "refusing to unlink"
-        ) from None
-    except OSError as exc:
-        if exc.errno == errno.ENOENT:
-            return  # unlinked between lstat and connect: bindable now
-        if exc.errno not in (errno.ECONNREFUSED, errno.ECONNRESET):
-            # EACCES and friends: we cannot PROVE the owner is dead,
-            # so the conservative answer is refusal, not unlink
-            raise SocketInUseError(
-                f"{path!r}: liveness probe failed ({exc}); "
-                "refusing to unlink"
-            ) from exc
-        # ECONNREFUSED/ECONNRESET: provably no accepting owner — the
-        # leftover of a SIGKILLed worker.  Reclaim the path.
+    # -- loop side --
+
+    def _on_line(self, line: str) -> None:
+        self.inbox.put(line)
+        if not self._paused and self.inbox.qsize() > _INBOX_HIGH:
+            self._paused = True
+            self.conn.pause_reading()
+
+    def _on_close(self, _reason) -> None:
+        self.server.forget_connection(self.conn)
+        self.inbox.put(_EOF)
+
+    # -- session side --
+
+    def _lines(self):
+        while True:
+            item = self.inbox.get()
+            if item is _EOF:
+                return
+            if self._paused and self.inbox.qsize() < _INBOX_LOW:
+                self._paused = False
+                self.conn.resume_reading_soon()
+            yield item
+
+    def _run_session_thread(self) -> None:
         try:
-            os.unlink(path)
+            self.server.run_session(self._lines(), self.conn.write_line)
         except OSError:
-            pass
-    else:
-        raise SocketInUseError(
-            f"{path!r} is owned by a live server; refusing to unlink"
+            pass  # peer (or server) went away mid-session
+        finally:
+            # flush the already-queued responses, then close
+            self.conn.close_when_drained()
+
+
+class JsonlUnixServer(LoopJsonlServer):
+    """A Unix-socket JSONL server whose socket I/O rides the event loop
+    (serve/eventloop.py): accepts, reads, writes, and slow-client
+    reaping are loop callbacks, so a client that dribbles bytes or
+    stops reading can never hold a thread.  Each connection still gets
+    ONE session thread running ``run_session(lines, write_line)`` — the
+    session may block on batcher results; the transport never blocks on
+    the session's behalf.  Subclasses implement ``run_session`` — the
+    serve worker runs the batcher session over this plumbing."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        loop=None,
+        stall_timeout_s: float = 30.0,
+    ):
+        super().__init__(path, loop=loop, stall_timeout_s=stall_timeout_s)
+
+    def handle_connection(self, sock) -> None:
+        conn = LineConn(
+            self.loop, sock, on_line=drop_line, on_close=drop_close
         )
-    finally:
-        probe.close()
-
-
-class JsonlUnixServer(
-    socketserver.ThreadingMixIn, socketserver.UnixStreamServer
-):
-    """A threading Unix-socket server speaking one JSONL session per
-    connection.  Subclasses implement ``run_session(lines, write_line)``
-    — the serve worker runs the batcher session, the fleet router runs
-    its routing session, over the same transport plumbing."""
-
-    daemon_threads = True
-    allow_reuse_address = True
-
-    def __init__(self, path: str):
-        prepare_unix_socket_path(path)
-        super().__init__(path, _UnixHandler)
+        self.track_connection(conn)
+        _SessionPump(self, conn)
 
     def run_session(self, lines, write_line) -> None:
         raise NotImplementedError
 
 
+
 class UnixServer(JsonlUnixServer):
     """One JSONL session per connection, all sharing one batcher (and
-    therefore one cache and one device pipeline)."""
+    therefore one cache and one device pipeline).  Exposes the
+    transport's event-loop lag as ``serve_loop_lag_ms`` on the
+    batcher's registry — the gauge that says whether the I/O core
+    itself ever stalls."""
 
-    def __init__(self, path: str, batcher: MicroBatcher):
+    def __init__(self, path: str, batcher: MicroBatcher, **kwargs):
         self.batcher = batcher
-        super().__init__(path)
+        super().__init__(path, **kwargs)
+        try:
+            batcher.obs.registry.gauge(
+                "serve_loop_lag_ms",
+                "Smoothed transport event-loop lag (heartbeat lateness)",
+            ).set_fn(self.loop.lag_ms)
+        except (AttributeError, ValueError):
+            pass  # a bare batcher stub without obs, or a re-bind
 
     def run_session(self, lines, write_line) -> None:
         serve_session(self.batcher, lines, write_line)
-
-
-class _UnixHandler(socketserver.StreamRequestHandler):
-    def handle(self) -> None:
-        lock = threading.Lock()
-
-        def write_line(line: str) -> None:
-            with lock:
-                self.wfile.write(line.encode("utf-8") + b"\n")
-                self.wfile.flush()
-
-        lines = (raw.decode("utf-8", errors="replace") for raw in self.rfile)
-        self.server.run_session(lines, write_line)
 
 
 def serve_unix(batcher: MicroBatcher, path: str) -> None:

@@ -1,0 +1,22 @@
+"""Clean: loop callbacks only move bytes and hand blocking work off —
+the sanctioned non-blocking recv carries its pragma, and the slow scrape
+goes to the ops executor instead of running on the loop."""
+
+
+class LoopConn:
+    def _on_readable(self):
+        # non-blocking socket: EAGAIN ends the pass, it never parks
+        # the loop thread
+        # analysis: disable=blocking-call
+        chunk = self.sock.recv(65536)
+        self.buf += chunk
+
+    def _start_op(self, slot):
+        # blocking fan-out scrape: deferred to the ops lane, the loop
+        # only enqueues
+        self.ops.submit(self._scrape_workers, slot)
+
+    def _sweep(self):
+        for conn in list(self.conns):
+            if conn.stalled():
+                conn.close("slowloris")

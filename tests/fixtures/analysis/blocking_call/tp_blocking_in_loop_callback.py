@@ -1,0 +1,18 @@
+"""TP: event-loop callbacks must never block — a socket read in an fd
+callback, a sleep plus a sync device dispatch in a timer callback, and
+a synchronous dial in a connect callback."""
+
+import time
+
+
+class LoopConn:
+    def _on_readable(self):
+        chunk = self.sock.recv(65536)  # BAD
+        self.buf += chunk
+
+    def _probe_tick(self):
+        time.sleep(0.25)  # BAD
+        return self.classifier.dispatch_chunks(self.batch)  # BAD
+
+    def on_writable(self, mask):
+        self.sock.connect(self.path)  # BAD

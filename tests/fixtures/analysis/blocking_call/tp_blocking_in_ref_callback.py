@@ -1,0 +1,33 @@
+"""TP: callbacks handed to the loop BY REFERENCE — call_later /
+call_soon_threadsafe arguments, lambdas passed to connect factories,
+``on_*`` attribute rebinding — run on the loop thread too, even though
+plain call-edge reachability never sees an invocation."""
+
+import time
+
+
+class Router:
+    def start(self):
+        self.loop.call_soon_threadsafe(self._arm_sweep)
+
+    def _arm_sweep(self):
+        self.timer = self.loop.call_later(0.5, self._sweep_once)
+
+    def _sweep_once(self):
+        time.sleep(0.01)  # BAD
+
+    def _dial(self):
+        connect_unix(
+            self.loop, self.path, 1.0,
+            lambda sock: self._connected(sock),
+            lambda exc: None,
+        )
+
+    def _connected(self, sock):
+        self.stream = sock.makefile("rwb")  # BAD
+
+    def _rebind(self, conn):
+        conn.on_line = self.handle_probe_line
+
+    def handle_probe_line(self, text):
+        self.log = open("/tmp/x")  # BAD

@@ -103,6 +103,15 @@ def _fat_details() -> dict:
             "failover_errors": 99_999_999,
             "failover_max_stall_s": 99999.999,
             "restart_recovery_s": 99999.999,
+            "router_saturation": {
+                "deadline_ms": 99999.9,
+                "pr4_closed_loop_rps": 99999.9,
+                "rounds": [{"target_rps": 99_999_999.9}] * 16,
+                "max_rps": 99_999_999.9,
+                "p99_ms_at_max": 99999.99,
+                "x_vs_pr4_closed_loop": 99999.99,
+                "loop_max_lag_ms": 99999.999,
+            },
         },
         "host_model": {
             "z" * 30: 9.9,

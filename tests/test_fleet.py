@@ -764,3 +764,384 @@ def test_reload_fleet_selftest_stub_mode_passes():
     from licensee_tpu.fleet.selftest import selftest_reload
 
     assert selftest_reload(verbose=False, stub=True) == 0
+
+
+# -- pipelined multiplexing: interleaving, correlation, failover --
+
+
+class ScriptedWorker:
+    """A test-local worker speaking raw JSONL over a Unix socket with
+    per-connection scripting — the knife for pipelined-multiplexing
+    edge cases the protocol-faithful stub cannot reach: a wrong trace
+    echo, death with requests in flight, per-request service delays.
+    Probes (``{"op": "stats"}``) always answer healthy; content rows
+    go to ``on_content(ctx, msg, write_row)`` where ``ctx`` carries
+    the connection socket and every content msg it has received."""
+
+    def __init__(self, tmp_path, name: str, on_content):
+        self.path = str(tmp_path / f"{name}.sock")
+        self.on_content = on_content
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.path)
+        self._listener.listen(16)
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn) -> None:
+        ctx = {"conn": conn, "msgs": []}
+        f = conn.makefile("rwb")
+
+        def write_row(row: dict) -> None:
+            try:
+                f.write(json.dumps(row).encode("utf-8") + b"\n")
+                f.flush()
+            except (OSError, ValueError):
+                pass
+
+        try:
+            while True:
+                raw = f.readline()
+                if not raw:
+                    return
+                try:
+                    msg = json.loads(raw)
+                except ValueError:
+                    continue
+                if msg.get("op") == "stats":
+                    write_row({
+                        "id": msg.get("id"),
+                        "stats": {"scheduler": {
+                            "queue_depth": 0, "in_flight": 0,
+                        }},
+                    })
+                    continue
+                ctx["msgs"].append(msg)
+                self.on_content(ctx, msg, write_row)
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def test_pipelined_requests_interleave_on_one_connection(tmp_path):
+    """Three clients' requests pipeline onto ONE backend connection
+    (pool bound 1); the worker holds every response until all three
+    lines have arrived, then answers — each response must resolve to
+    ITS client, cross-checked by the echoed trace ID."""
+
+    def on_content(ctx, msg, write_row):
+        if len(ctx["msgs"]) < 3:
+            return
+        for m in ctx["msgs"]:  # answer in request order: the contract
+            write_row({
+                "id": m["id"], "key": "stub-mit", "matcher": "scripted",
+                "confidence": 99.0, "cached": False,
+                "echo": m["content"], "trace": m.get("trace"),
+            })
+        ctx["msgs"].clear()
+
+    worker = ScriptedWorker(tmp_path, "wscript", on_content)
+    rows: dict[int, dict] = {}
+    try:
+        with Router(
+            {"wscript": worker.path}, probe_interval_s=0.05,
+            pool_per_worker=1, trace_sample=1.0,
+        ) as router:
+
+            def send(i: int) -> None:
+                rows[i] = router.dispatch(
+                    {"id": i, "content": f"blob-{i}"}
+                )
+
+            threads = [
+                threading.Thread(target=send, args=(i,))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            stats = router.stats()
+    finally:
+        worker.close()
+    assert set(rows) == {0, 1, 2}
+    traces = set()
+    for i, row in rows.items():
+        assert not row.get("error"), row
+        assert row["echo"] == f"blob-{i}", row
+        traces.add(row["trace"])
+    assert len(traces) == 3  # three distinct minted trace IDs
+    # everything rode one pipelined connection
+    assert stats["backends"]["wscript"]["pool_conns"] <= 1
+
+
+def test_out_of_order_completion_across_pool_connections(tmp_path):
+    """Submission order slow-then-fast; completion order fast-then-slow
+    — the pool (bound 2) must not head-of-line block the fast request
+    behind the slow one, and each answer resolves to its own client."""
+
+    def on_content(ctx, msg, write_row):
+        time.sleep(float(msg["content"].split(":")[1]) / 1000.0)
+        write_row({
+            "id": msg["id"], "key": "stub-mit", "matcher": "scripted",
+            "confidence": 99.0, "cached": False,
+            "echo": msg["content"], "trace": msg.get("trace"),
+        })
+
+    worker = ScriptedWorker(tmp_path, "wpool", on_content)
+    done_order: list[tuple[str, dict]] = []
+    try:
+        with Router(
+            {"wpool": worker.path}, probe_interval_s=0.05,
+            pool_per_worker=2,
+        ) as router:
+
+            def send(tag: str, delay_ms: int) -> None:
+                row = router.dispatch(
+                    {"id": tag, "content": f"sleep:{delay_ms}"}
+                )
+                done_order.append((tag, row))
+
+            slow = threading.Thread(target=send, args=("slow", 600))
+            fast = threading.Thread(target=send, args=("fast", 10))
+            slow.start()
+            time.sleep(0.15)  # the slow request is in flight first
+            fast.start()
+            slow.join(timeout=30.0)
+            fast.join(timeout=30.0)
+    finally:
+        worker.close()
+    assert [tag for tag, _ in done_order] == ["fast", "slow"]
+    by_tag = dict(done_order)
+    assert by_tag["slow"]["echo"] == "sleep:600"
+    assert by_tag["fast"]["echo"] == "sleep:10"
+
+
+def test_trace_mismatch_burns_connection_and_fails_over(
+    tmp_path, stub_fleet
+):
+    """A response echoing the WRONG trace ID is a protocol violation:
+    the router must never deliver the mis-correlated verdict — the
+    attempt fails over to the healthy twin and the poisoned connection
+    is closed."""
+
+    def on_content(ctx, msg, write_row):
+        write_row({
+            "id": msg["id"], "key": "evil", "matcher": "scripted",
+            "confidence": 0.0, "cached": False,
+            "trace": "beefbeefbeefbeef",
+        })
+
+    worker = ScriptedWorker(tmp_path, "wbad", on_content)
+    good = stub_fleet.spawn("wgood")
+    try:
+        with Router(
+            {"wbad": worker.path, "wgood": good},
+            probe_interval_s=0.05, trace_sample=1.0,
+        ) as router:
+            rows = [
+                router.dispatch({"id": i, "content": f"x{i}"})
+                for i in range(4)
+            ]
+            stats = router.stats()
+    finally:
+        worker.close()
+    assert all(not r.get("error") for r in rows), rows
+    # the poisoned verdict never reached a client
+    assert all(r.get("key") == "stub-mit" for r in rows), rows
+    assert all(r.get("worker") == "wgood" for r in rows), rows
+    assert stats["router"]["failovers"] >= 1
+
+
+def test_backend_death_with_three_in_flight_fails_all_over(
+    tmp_path, stub_fleet
+):
+    """The backend dies with 3 requests pipelined and unanswered on one
+    connection: all 3 fail over to the surviving replica with zero
+    client-visible errors."""
+    died = threading.Event()
+
+    def on_content(ctx, msg, write_row):
+        if len(ctx["msgs"]) >= 3:
+            died.set()
+            # die: 3 in flight, none answered.  shutdown, not close —
+            # the makefile wrapper holds the fd open past close()
+            ctx["conn"].shutdown(socket.SHUT_RDWR)
+
+    worker = ScriptedWorker(tmp_path, "wdead", on_content)
+    # the survivor reports a standing queue so all 3 first land on the
+    # (idle-looking) scripted worker
+    survivor = stub_fleet.spawn("wsurvivor", "--report-load", "50")
+    rows: list[dict] = []
+    lock = threading.Lock()
+    try:
+        with Router(
+            {"wdead": worker.path, "wsurvivor": survivor},
+            probe_interval_s=0.05, pool_per_worker=1,
+        ) as router:
+
+            def send(i: int) -> None:
+                row = router.dispatch({"id": i, "content": f"c{i}"})
+                with lock:
+                    rows.append(row)
+
+            threads = [
+                threading.Thread(target=send, args=(i,))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            stats = router.stats()
+    finally:
+        worker.close()
+    assert died.is_set()
+    assert len(rows) == 3
+    assert all(not r.get("error") for r in rows), rows
+    assert all(r.get("worker") == "wsurvivor" for r in rows), rows
+    assert stats["router"]["failovers"] >= 3
+
+
+# -- slowloris: slow/partial writers are reaped, never hold a slot --
+
+
+def test_slowloris_dribble_reaped_while_traffic_flows(
+    stub_fleet, tmp_path
+):
+    """A client dribbling bytes of a never-finished line is reaped by
+    the stall sweep while normal traffic on other connections keeps
+    answering — the attack holds no session, thread, or pool slot."""
+    sockets = {"w0": stub_fleet.spawn("w0")}
+    front = str(tmp_path / "front.sock")
+    with Router(sockets, probe_interval_s=0.05) as router:
+        server = FrontServer(front, router, stall_timeout_s=1.0)
+        thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        thread.start()
+        try:
+            box: dict = {}
+            loris = faults.Slowloris(
+                front, mode="dribble", byte_interval_s=0.1,
+                give_up_s=20.0,
+            )
+            lt = threading.Thread(target=lambda: box.update(loris.run()))
+            lt.start()
+            rows = []
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(front)
+                s.settimeout(10.0)
+                f = s.makefile("rwb")
+                for i in range(10):
+                    f.write(
+                        json.dumps({"id": i, "content": f"c{i}"}).encode()
+                        + b"\n"
+                    )
+                    f.flush()
+                    rows.append(json.loads(f.readline()))
+            lt.join(timeout=25.0)
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+    assert all(r.get("key") == "stub-mit" for r in rows)
+    assert box.get("reaped") is True, box
+    # reaped by the stall sweep, well before the client gave up
+    assert box["elapsed_s"] < 10.0, box
+
+
+def test_slowloris_half_close_reaped(stub_fleet, tmp_path):
+    """A client that half-closes mid-line is reaped immediately (EOF
+    with a partial line can never complete a request)."""
+    sockets = {"w0": stub_fleet.spawn("w0")}
+    front = str(tmp_path / "front.sock")
+    with Router(sockets, probe_interval_s=0.05) as router:
+        server = FrontServer(front, router, stall_timeout_s=5.0)
+        thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        thread.start()
+        try:
+            result = faults.Slowloris(
+                front, mode="half_close", give_up_s=10.0
+            ).run()
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+    assert result["reaped"] is True, result
+    # the EOF-mid-line path reaps at once — no stall timeout needed
+    assert result["elapsed_s"] < 3.0, result
+
+
+# -- shutdown under load: every waiting client gets an answer --
+
+
+def test_router_close_answers_queued_and_repick_parked_clients(tmp_path):
+    """close() with every backend down must answer EVERY waiting
+    client: requests parked on a repick timer (admitted, no healthy
+    backend) and requests still in the admission queue would otherwise
+    hang until the dispatch-stall budget once loop.stop() drops their
+    timers."""
+    dead = str(tmp_path / "never-booted.sock")
+    rows: list[dict] = []
+    lock = threading.Lock()
+    router = Router(
+        {"w0": dead}, probe_interval_s=0.05,
+        dispatch_wait_s=60.0, max_concurrency=2,
+    )
+    router.start()
+
+    def send(i: int) -> None:
+        row = router.dispatch({"id": i, "content": f"c{i}"})
+        with lock:
+            rows.append(row)
+
+    threads = [
+        threading.Thread(target=send, args=(i,)) for i in range(5)
+    ]
+    for t in threads:
+        t.start()
+    # let 2 requests admit + park on repick and 3 queue in admission
+    deadline = time.perf_counter() + 5.0
+    while time.perf_counter() < deadline:
+        snap = router.stats()["router"]
+        if snap["active"] == 2 and snap["admission_queued"] == 3:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError(f"load never parked: {router.stats()}")
+    t0 = time.perf_counter()
+    router.close()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not any(t.is_alive() for t in threads)
+    # answered at close, not after the 60 s dispatch window
+    assert time.perf_counter() - t0 < 5.0
+    assert len(rows) == 5
+    assert all(r["error"] == "router_closed" for r in rows), rows
