@@ -1,0 +1,97 @@
+"""The declared JSONL wire-protocol schema the contract checker
+(rules_protocol.py) holds every surface to.
+
+The protocol is hand-rolled and spoken INDEPENDENTLY by five code
+paths — the event-loop router (fleet/router.py), the real serve worker
+(serve/server.py), the protocol-faithful stub worker (fleet/faults.py),
+the pooled probe/one-shot helpers (fleet/wire.py + supervisor), and the
+``stats``/``fleet`` CLI clients — so the one honest definition of
+"protocol-faithful" is a schema the analyzer can diff every surface
+against.  Editing the wire format is a TWO-PLACE change by design:
+the code and this schema, and CI fails until both moved.
+
+``content`` is the implicit op: a request line with no ``"op"`` key and
+a ``content``/``content_b64`` body.  Error codes travel as the
+``"error"`` response field; a code with prose carries it after a colon
+(``"bad_request: missing 'content'"``) and the checker matches on the
+prefix.
+"""
+
+from __future__ import annotations
+
+# request ops -> the request fields each may carry.  "content" is the
+# op-less classification row.
+REQUEST_OPS: dict[str, tuple[str, ...]] = {
+    "content": (
+        "content", "content_b64", "id", "filename", "deadline_ms", "trace",
+    ),
+    "stats": ("id", "format"),
+    "trace": ("id", "n"),
+    "reload": ("id", "corpus"),
+}
+
+# error codes a response row's "error" field may carry (prefix before
+# the first ":"), and which surfaces may mint them
+ERROR_CODES: tuple[str, ...] = (
+    "bad_request",
+    "internal_error",
+    "queue_full",
+    "reload_failed",
+    "reload_in_progress",
+    # the fleet-level roll mutex refusal, carried inside the reload
+    # result object the front-door verb echoes to clients
+    "fleet_reload_in_progress",
+    "no_backend_available",
+    "router_closed",
+    "router_not_started",
+)
+
+# response-row fields a client may read; every one must have at least
+# one producer somewhere in the program
+RESPONSE_FIELDS: tuple[str, ...] = (
+    "id",
+    "key",
+    "matcher",
+    "confidence",
+    "cached",
+    "closest",
+    "attribution",
+    "corpus",
+    "trace",
+    "error",
+    "retry_after",
+    "problems",
+    "stats",
+    "prometheus",
+    "traces",
+    "reload",
+)
+
+# every wire "op" the checker enumerates: request verbs plus error
+# codes (the error vocabulary is as much protocol as the verbs — a
+# client that retries on "queue_full" must never meet a worker that
+# spells it differently)
+WIRE_OPS: tuple[str, ...] = tuple(REQUEST_OPS) + ERROR_CODES
+
+# dict keys watched by the extraction pass: request fields, response
+# fields, and the op discriminator itself
+WATCHED_KEYS: frozenset[str] = frozenset(
+    {"op"}
+    | {f for fields in REQUEST_OPS.values() for f in fields}
+    | set(RESPONSE_FIELDS)
+)
+
+# role detection, by path basename: the real worker transport and the
+# stub that must stay protocol-faithful to it.  Basenames (not full
+# paths) so fixture programs can cast their own players.
+WORKER_BASENAMES: tuple[str, ...] = ("server.py",)
+STUB_BASENAMES: tuple[str, ...] = ("faults.py",)
+
+# modules that legitimately speak the wire protocol; facts found in
+# other modules are ignored (a random dict with an "op" key in a
+# corpus loader is not a wire request)
+SURFACE_BASENAMES: tuple[str, ...] = (
+    "router.py", "server.py", "faults.py", "wire.py", "supervisor.py",
+    "selftest.py", "main.py", "bench.py", "batch.py", "scheduler.py",
+    "eventloop.py",
+)

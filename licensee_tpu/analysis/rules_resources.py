@@ -1,5 +1,6 @@
-"""Resource-leak rule: sockets, ``Popen`` handles, and file objects
-must be released on every path.
+"""Resource-leak rules: sockets, ``Popen`` handles, and file objects
+must be released on every path — including when ownership crosses a
+module boundary through a returned value.
 
 A resource-creating call is clean when any of these hold:
 
@@ -16,14 +17,24 @@ it — on a week-long worker that is a descriptor leak), a local that is
 never closed, and a local closed only on the happy path (the
 stale-socket and SIGKILL-restart bugs of the fleet tier were exactly
 this class).
+
+The per-file rule stops at the function that CREATED the resource.
+The whole-program extension follows the "returned" escape to its
+callers: a module-level function whose return value derives from a
+resource factory (directly, or through another returning function) is
+itself a factory, and every cross-module caller is held to the same
+with/finally/escape discipline at its call site.  The per-call
+syntactic classification (``function_call_facts``) is shared between
+both passes and exported into the module summary, so the program rule
+runs from cache without re-parsing.
 """
 
 from __future__ import annotations
 
 import ast
 
-from licensee_tpu.analysis.core import rule
-from licensee_tpu.analysis.rules_concurrency import _imports
+from licensee_tpu.analysis.core import Finding, program_rule, rule
+from licensee_tpu.analysis.scopes import module_imports
 
 RESOURCE_FACTORIES = {
     "open": "file handle",
@@ -44,26 +55,23 @@ CLOSE_METHODS = {
     "shutdown", "release", "unlink", "cleanup", "__exit__",
 }
 
-
-def _resource_calls(fn_node, imports):
-    """(call, kind) for resource factories lexically in this function,
-    excluding nested defs (they are visited as their own functions)."""
-    out = []
-
-    def visit(node):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            return
-        if isinstance(node, ast.Call):
-            qn = imports.qualify(node.func)
-            if qn in RESOURCE_FACTORIES:
-                out.append((node, RESOURCE_FACTORIES[qn]))
-        for child in ast.iter_child_nodes(node):
-            visit(child)
-
-    for stmt in fn_node.body:
-        visit(stmt)
-    return out
+# call-site dispositions that leak when the callee hands back a live
+# resource, with the message tail explaining each
+LEAKY_DISPOSITIONS = {
+    "bare": (
+        "its result is never bound — the {kind} closes only when the "
+        "GC collects the temporary; use `with`"
+    ),
+    "unclosed": (
+        "'{name}' is never closed in this function and never handed "
+        "off; use `with` or `try/finally`"
+    ),
+    "happy": (
+        "'{name}' is closed only on the happy path — an exception "
+        "between here and the close leaks it; use `with` or "
+        "`try/finally`"
+    ),
+}
 
 
 def _walk_body(fn_node):
@@ -143,6 +151,8 @@ def _with_context_names(fn_node) -> set[str]:
 class _FakeModuleFn:
     """Module-level statements analyzed as one pseudo-function."""
 
+    col_offset = 0
+
     def __init__(self, tree):
         self.body = [
             n
@@ -153,11 +163,135 @@ class _FakeModuleFn:
         ]
 
 
-def _iter_function_nodes(tree):
+def iter_function_nodes(tree):
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             yield node
     yield _FakeModuleFn(tree)
+
+
+def _calls_in(fn_node):
+    """Every Call lexically in this function, nested defs excluded
+    (they are visited as their own functions)."""
+    out = []
+
+    def visit(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            out.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in getattr(fn_node, "body", []):
+        visit(stmt)
+    return out
+
+
+def function_call_facts(fn_node) -> dict:
+    """{call_node: (bound_name | None, disposition)} for every call in
+    the function.  Dispositions: ``with`` / ``consumed`` (handed off,
+    returned, or stored) / ``ctxlater`` (entered via ``with name``) /
+    ``finally`` / ``escape`` / ``happy`` (closed on the happy path
+    only) / ``unclosed`` / ``bare`` (never bound)."""
+    with_items = set()
+    assigned_to: dict[int, str] = {}  # id(call) -> local name
+    consumed: set[int] = set()
+    for stmt in getattr(fn_node, "body", []):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        with_items.add(id(item.context_expr))
+            elif isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Call) and len(
+                    node.targets
+                ) == 1:
+                    target = node.targets[0]
+                    if isinstance(target, ast.Name):
+                        assigned_to[id(node.value)] = target.id
+                    elif isinstance(
+                        target, (ast.Attribute, ast.Subscript)
+                    ):
+                        consumed.add(id(node.value))  # escapes
+            elif isinstance(node, ast.Call):
+                for arg in [
+                    *node.args, *[kw.value for kw in node.keywords]
+                ]:
+                    if isinstance(arg, ast.Call):
+                        consumed.add(id(arg))  # hand-off to callee
+            elif isinstance(node, (ast.Return, ast.Yield)):
+                if isinstance(node.value, ast.Call):
+                    consumed.add(id(node.value))
+    ctx_names = _with_context_names(fn_node)
+    facts: dict = {}
+    for call in _calls_in(fn_node):
+        if id(call) in with_items:
+            facts[call] = (None, "with")
+            continue
+        if id(call) in consumed:
+            facts[call] = (None, "consumed")
+            continue
+        name = assigned_to.get(id(call))
+        if name is None:
+            facts[call] = (None, "bare")
+            continue
+        if name in ctx_names:
+            facts[call] = (name, "ctxlater")
+            continue
+        if _finally_closes(fn_node, name):
+            facts[call] = (name, "finally")
+            continue
+        if _escapes(fn_node, name, call):
+            facts[call] = (name, "escape")
+            continue
+        closes_somewhere = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in CLOSE_METHODS
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == name
+            for n in _walk_body(fn_node)
+        )
+        facts[call] = (name, "happy" if closes_somewhere else "unclosed")
+    return facts
+
+
+def returns_facts(fn_node, imports) -> tuple[str | None, set[str]]:
+    """What a function hands back: a resource kind when it returns a
+    factory's result (directly or through a local), plus the qualified
+    names of other calls whose results it returns — the propagation
+    edges of the cross-module ownership fixed point."""
+    bindings: dict[str, str] = {}  # local name -> qualified call name
+    for node in _walk_body(fn_node):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            qn = imports.qualify(node.value.func)
+            if qn is not None:
+                bindings[node.targets[0].id] = qn
+    kind = None
+    ret_calls: set[str] = set()
+    for node in _walk_body(fn_node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        val = node.value
+        qn = None
+        if isinstance(val, ast.Call):
+            qn = imports.qualify(val.func)
+        elif isinstance(val, ast.Name):
+            qn = bindings.get(val.id)
+        if qn is None:
+            continue
+        if qn in RESOURCE_FACTORIES:
+            kind = RESOURCE_FACTORIES[qn]
+        elif not qn.startswith("self."):
+            ret_calls.add(qn)
+    return kind, ret_calls
 
 
 @rule(
@@ -169,45 +303,15 @@ def _iter_function_nodes(tree):
     ),
 )
 def check_resource_leak(module):
-    imports = _imports(module)
+    imports = module_imports(module)
     findings = []
-    for fn_node in _iter_function_nodes(module.tree):
-        with_items = set()
-        assigned_to: dict[int, str] = {}  # id(call) -> local name
-        consumed: set[int] = set()
-        # classify each resource call by its syntactic position
-        for stmt in getattr(fn_node, "body", []):
-            for node in ast.walk(stmt):
-                if isinstance(node, ast.With):
-                    for item in node.items:
-                        if isinstance(item.context_expr, ast.Call):
-                            with_items.add(id(item.context_expr))
-                elif isinstance(node, ast.Assign):
-                    if isinstance(node.value, ast.Call) and len(
-                        node.targets
-                    ) == 1:
-                        target = node.targets[0]
-                        if isinstance(target, ast.Name):
-                            assigned_to[id(node.value)] = target.id
-                        elif isinstance(
-                            target, (ast.Attribute, ast.Subscript)
-                        ):
-                            consumed.add(id(node.value))  # escapes
-                elif isinstance(node, ast.Call):
-                    for arg in [
-                        *node.args, *[kw.value for kw in node.keywords]
-                    ]:
-                        if isinstance(arg, ast.Call):
-                            consumed.add(id(arg))  # hand-off to callee
-                elif isinstance(node, (ast.Return, ast.Yield)):
-                    if isinstance(node.value, ast.Call):
-                        consumed.add(id(node.value))
-        ctx_names = _with_context_names(fn_node)
-        for call, kind in _resource_calls(fn_node, imports):
-            if id(call) in with_items or id(call) in consumed:
+    for fn_node in iter_function_nodes(module.tree):
+        for call, (name, disp) in function_call_facts(fn_node).items():
+            qn = imports.qualify(call.func)
+            if qn not in RESOURCE_FACTORIES:
                 continue
-            name = assigned_to.get(id(call))
-            if name is None:
+            kind = RESOURCE_FACTORIES[qn]
+            if disp == "bare":
                 findings.append(
                     module.finding(
                         "resource-leak",
@@ -217,22 +321,7 @@ def check_resource_leak(module):
                         "`with`",
                     )
                 )
-                continue
-            if name in ctx_names:
-                continue  # opened here, entered via `with name` later
-            if _finally_closes(fn_node, name):
-                continue
-            if _escapes(fn_node, name, call):
-                continue
-            closes_somewhere = any(
-                isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Attribute)
-                and n.func.attr in CLOSE_METHODS
-                and isinstance(n.func.value, ast.Name)
-                and n.func.value.id == name
-                for n in _walk_body(fn_node)
-            )
-            if closes_somewhere:
+            elif disp == "happy":
                 findings.append(
                     module.finding(
                         "resource-leak",
@@ -242,7 +331,7 @@ def check_resource_leak(module):
                         "leaks it; use `with` or `try/finally`",
                     )
                 )
-            else:
+            elif disp == "unclosed":
                 findings.append(
                     module.finding(
                         "resource-leak",
@@ -251,4 +340,74 @@ def check_resource_leak(module):
                         "function and never handed off; use `with`",
                     )
                 )
+    return findings
+
+
+# -- the cross-module ownership pass -----------------------------------
+
+
+def _resolve_fn(program, summary, ref):
+    """A call reference (qualified dotted name, or a bare local name)
+    -> the (rel, function name) key of a module-level function."""
+    if "." in ref:
+        for rel, sid in program.resolve(ref):
+            sc = program.by_rel[rel].scopes[sid]
+            if sc.owner is None:
+                return (rel, sc.name)
+        return None
+    for sc in summary.scopes:
+        if sc.owner is None and sc.name == ref:
+            return (summary.rel, ref)
+    return None
+
+
+@program_rule(
+    "resource-leak",
+    doc=(
+        "(whole-program) a function returns a live socket/file/Popen "
+        "handle — ownership crossed the module boundary — and a caller "
+        "neither closes it on all paths nor hands it on"
+    ),
+)
+def check_cross_module_ownership(program):
+    # fixed point: functions returning a factory's result, directly or
+    # through other returning functions
+    factories: dict[tuple[str, str], str] = {}
+    for s in program.by_rel.values():
+        for fname, info in s.ret_facts.items():
+            if info.get("kind"):
+                factories[(s.rel, fname)] = info["kind"]
+    changed = True
+    while changed:
+        changed = False
+        for s in program.by_rel.values():
+            for fname, info in s.ret_facts.items():
+                key = (s.rel, fname)
+                if key in factories:
+                    continue
+                for ref in info.get("calls", ()):
+                    target = _resolve_fn(program, s, ref)
+                    if target is not None and target in factories:
+                        factories[key] = factories[target]
+                        changed = True
+                        break
+    if not factories:
+        return []
+    findings = []
+    for s in program.by_rel.values():
+        for q, line, disp, bound in s.pcalls:
+            tail = LEAKY_DISPOSITIONS.get(disp)
+            if tail is None:
+                continue
+            target = _resolve_fn(program, s, q)
+            if target is None or target not in factories:
+                continue
+            kind = factories[target]
+            callee = q.split(".")[-1]
+            findings.append(Finding(
+                s.rel, line, "resource-leak",
+                f"{callee}() (defined in {target[0]}) returns a live "
+                f"{kind}, and "
+                + tail.format(kind=kind, name=bound or callee),
+            ))
     return findings

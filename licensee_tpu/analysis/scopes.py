@@ -27,15 +27,71 @@ MUTATOR_METHODS = {
 LOCK_FACTORIES = ("threading.Lock", "threading.RLock", "threading.Condition")
 
 
+def rel_to_modname(rel: str) -> str:
+    """Repo-relative path -> dotted module name: the join key between
+    the per-file import tables and the program-wide symbol table
+    (``licensee_tpu/fleet/wire.py`` -> ``licensee_tpu.fleet.wire``;
+    a package ``__init__.py`` names the package itself)."""
+    parts = [p for p in rel.replace("\\", "/").split("/") if p]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][: -len(".py")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def rel_basename(rel: str) -> str:
+    """The final path component of a repo-relative path — the role/
+    surface key the protocol and blocking rules match on."""
+    return rel.replace("\\", "/").rsplit("/", 1)[-1]
+
+
+def rel_to_package(rel: str) -> str:
+    """The dotted ENCLOSING package of a repo-relative path — the base
+    relative imports resolve against (for a package ``__init__.py``
+    that is the package itself)."""
+    modname = rel_to_modname(rel)
+    base = rel.replace("\\", "/").rsplit("/", 1)[-1]
+    if base == "__init__.py":
+        return modname
+    return modname.rsplit(".", 1)[0] if "." in modname else ""
+
+
+def _canonical_relative(dotted: str, package: str) -> str:
+    """Resolve a leading-dot relative import against the importing
+    module's enclosing ``package`` (``.wire.oneshot`` inside package
+    ``licensee_tpu.fleet`` -> ``licensee_tpu.fleet.wire.oneshot``; each
+    extra dot climbs one package).  An over-deep relative import (more
+    dots than packages) is left as-is — it would not import either."""
+    level = len(dotted) - len(dotted.lstrip("."))
+    if level == 0 or not package:
+        return dotted
+    base = package.split(".")
+    climb = level - 1
+    if climb >= len(base):
+        return dotted
+    base = base[: len(base) - climb]
+    tail = dotted[level:]
+    return ".".join(base + [tail]) if tail else ".".join(base)
+
+
 class ImportTable:
     """name -> dotted qualified name, from every import in the tree
-    (function-local imports included — they bind names the same way)."""
+    (function-local imports included — they bind names the same way).
+    When ``package`` is given, relative imports are canonicalized
+    against it so cross-module resolution sees absolute names."""
 
-    def __init__(self, tree: ast.AST):
+    def __init__(self, tree: ast.AST, package: str = ""):
         self.names: dict[str, str] = {}
+        # full dotted names of IMPORTED MODULES (``import a.b`` depends
+        # on a.b even though it only binds ``a``) — the import-graph
+        # edges behind the --changed reverse closure
+        self.modules: set[str] = set()
+        self.package = package
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for alias in node.names:
+                    self.modules.add(alias.name)
                     if alias.asname:
                         self.names[alias.asname] = alias.name
                     else:
@@ -46,11 +102,18 @@ class ImportTable:
             elif isinstance(node, ast.ImportFrom):
                 mod = node.module or ""
                 prefix = "." * node.level + mod
+                if prefix.startswith(".") and package:
+                    prefix = _canonical_relative(prefix, package)
+                if prefix:
+                    self.modules.add(prefix)
                 for alias in node.names:
                     bound = alias.asname or alias.name
-                    self.names[bound] = (
-                        f"{prefix}.{alias.name}" if prefix else alias.name
-                    )
+                    if prefix:
+                        sep = "" if prefix.endswith(".") else "."
+                        value = f"{prefix}{sep}{alias.name}"
+                    else:
+                        value = alias.name
+                    self.names[bound] = value
 
     def qualify(self, node: ast.AST) -> str | None:
         """Dotted name of a Name/Attribute chain with the first segment
@@ -99,6 +162,25 @@ class AttrAccess:
         self.func = func
 
 
+class CallSite:
+    """One call expression inside a scope, with everything the
+    whole-program graph needs: the attr/bare callee name, the
+    import-qualified dotted name when the callee is a plain name chain,
+    whether the receiver is ``self`` (class-hierarchy dispatch), the
+    line, and the lexical lock depth at the call (the caller-holds-the-
+    lock contract rides this)."""
+
+    __slots__ = ("kind", "name", "q", "recv_self", "line", "lock_depth")
+
+    def __init__(self, kind, name, q, recv_self, line, lock_depth):
+        self.kind = kind  # "attr" | "name"
+        self.name = name
+        self.q = q  # canonical dotted name, or None
+        self.recv_self = recv_self
+        self.line = line
+        self.lock_depth = lock_depth
+
+
 class FunctionScope:
     """One function/method (or nested def): its accesses, the self-call
     and local-call edges out of it, and whether it is handed to a
@@ -111,6 +193,7 @@ class FunctionScope:
         self.accesses: list[AttrAccess] = []
         self.self_calls: set[str] = set()  # self.m() / obj.m() attr names
         self.name_calls: set[str] = set()  # bare f() names
+        self.calls: list[CallSite] = []  # every call, graph-resolution form
 
 
 class ClassScope:
@@ -132,6 +215,9 @@ class ModuleScopes:
         # names handed to Thread(target=)/Timer/submit anywhere in the
         # module — matched against method/function names
         self.spawned_names: set[str] = set()
+        # spawn targets that qualify to a dotted name (``wire.probe``):
+        # the program layer resolves these into OTHER modules
+        self.spawned_qualified: set[str] = set()
         self._walk_module(tree)
 
     # -- collection --
@@ -254,7 +340,13 @@ class ModuleScopes:
 
     def _record_call(self, node: ast.Call, scope, cls, depth) -> None:
         func = node.func
+        q = self.imports.qualify(func)
         if isinstance(func, ast.Attribute):
+            scope.calls.append(CallSite(
+                "attr", func.attr, q,
+                isinstance(func.value, ast.Name) and func.value.id == "self",
+                node.lineno, depth,
+            ))
             scope.self_calls.add(func.attr)
             # in-place mutation of a guarded attribute under the lock:
             # self.x.append(...) / backend.pool.checkin are reads of
@@ -267,6 +359,9 @@ class ModuleScopes:
             ):
                 cls.guarded.setdefault(func.value.attr, func.value.lineno)
         elif isinstance(func, ast.Name):
+            scope.calls.append(CallSite(
+                "name", func.id, q, False, node.lineno, depth,
+            ))
             scope.name_calls.add(func.id)
         self._scan_spawns(node)
 
@@ -299,6 +394,9 @@ class ModuleScopes:
                 self.spawned_names.add(target.attr)
             elif isinstance(target, ast.Name):
                 self.spawned_names.add(target.id)
+            tq = self.imports.qualify(target)
+            if tq is not None and "." in tq:
+                self.spawned_qualified.add(tq)
 
     # -- reachability --
 
@@ -361,3 +459,92 @@ class ModuleScopes:
         for cls in self.classes:
             yield from cls.functions.values()
         yield from self.module_functions.values()
+
+
+# calls whose function arguments run ON the event-loop thread:
+# callbacks are handed over BY REFERENCE (or as lambdas), so plain
+# call-edge reachability never sees them — loop_callback_refs collects
+# these references (and the call names inside lambda arguments) as
+# extra entry points.  Deliberately NOT here: ``submit`` (the ops
+# executor — its thunks block by design) and ``Thread`` (its own
+# thread).
+LOOP_SCHEDULING_NAMES = {
+    "call_later", "call_soon", "call_soon_threadsafe", "run_sync",
+    "register", "modify",
+    # loop-callback factories: their function args / on_* keywords fire
+    # on the loop
+    "connect_unix", "LineConn",
+}
+
+
+def loop_callback_refs(
+    tree, imports: ImportTable | None = None
+) -> tuple[set[str], set[str]]:
+    """Functions handed to the event loop by reference: args to the
+    scheduling verbs above, call targets inside lambda args to those
+    verbs, and values bound to ``on_*`` attributes (``conn.on_line =
+    self.handle_line``).  Returns ``(names, qualified)`` — bare/attr
+    names for intra-module matching plus import-qualified dotted names
+    the program layer resolves into other modules."""
+
+    def ref_name(expr) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    def note(expr) -> None:
+        name = ref_name(expr)
+        if name is not None:
+            refs.add(name)  # non-function names miss by_name: inert
+            if imports is not None:
+                q = imports.qualify(expr)
+                if q is not None and "." in q:
+                    qualified.add(q)
+
+    refs: set[str] = set()
+    qualified: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and target.attr.startswith("on_")
+                ):
+                    note(node.value)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fname = ref_name(node.func)
+        if fname not in LOOP_SCHEDULING_NAMES:
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in args:
+            if isinstance(arg, ast.Lambda):
+                for sub in ast.walk(arg.body):
+                    if isinstance(sub, ast.Call):
+                        note(sub.func)
+            else:
+                note(arg)
+    return refs, qualified
+
+
+def module_scopes(module) -> ModuleScopes:
+    """The shared one-pass visitor for a parsed ``core.Module``, cached
+    on the module object — every rule (and the program summarizer)
+    reads the same walk."""
+    cached = getattr(module, "_mod_scopes", None)
+    if cached is None:
+        imports = ImportTable(
+            module.tree, rel_to_package(getattr(module, "rel", ""))
+        )
+        cached = ModuleScopes(module.tree, imports)
+        module._mod_scopes = cached
+        module._imports = imports
+    return cached
+
+
+def module_imports(module) -> ImportTable:
+    module_scopes(module)
+    return module._imports

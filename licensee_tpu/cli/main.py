@@ -730,7 +730,10 @@ def cmd_batch_detect(args) -> int:
                 row = {"path": path, **result.as_dict()}
                 if content is None:
                     # same accounting as the --output pipeline: a read
-                    # failure is not a classification
+                    # failure is not a classification.  This is a BATCH
+                    # output row on stdout, not a serve wire response —
+                    # the wire-protocol checker has no business here.
+                    # analysis: disable=protocol-drift
                     row["error"] = "read_error"
                     project.stats.read_errors += 1
                 elif result.error:

@@ -1247,9 +1247,9 @@ class Router:
             try:
                 # a fleet scrape IS a synchronous fan-out by contract:
                 # it runs on the ops executor (front sessions) or the
-                # caller's thread (CLI), never on the event loop, and
-                # tolerates probe_timeout_s per worker
-                # analysis: disable=blocking-call
+                # caller's thread (CLI), never on the event loop — the
+                # cross-module walk proves no loop callback reaches
+                # here (ops-executor thunks are not loop edges)
                 row = oneshot(
                     backend.socket_path,
                     {"op": "stats", "format": "prometheus"},

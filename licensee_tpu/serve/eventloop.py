@@ -185,8 +185,8 @@ class EventLoop:
         return timer
 
     # the _locked suffix is the contract: the ONE caller (call_later)
-    # already holds self._lock across the call
-    # analysis: disable=lock-discipline
+    # already holds self._lock across the call — the analyzer proves
+    # it (caller-holds-the-lock), no pragma needed
     def _compact_timers_locked(self) -> None:
         """Drop cancelled entries and re-heapify.  At router saturation
         every request arms (and instantly cancels) a timeout timer, so

@@ -1,0 +1,24 @@
+"""OK (cross-module): the loop callback DEFERS the blocking helper to
+an executor thread — the loop never carries the socket wait."""
+
+import wire_helpers
+
+
+class FrontSession:
+    def __init__(self, loop, conn, ops_executor):
+        self.loop = loop
+        self.conn = conn
+        self.ops = ops_executor
+        conn.on_line = self._on_line
+
+    def _on_line(self, line: str) -> None:
+        # executor thunks block by design; the loop thread only
+        # schedules the completion callback
+        future = self.ops.submit(wire_helpers.fetch_status,
+                                 self.conn.backend_path)
+        future.add_done_callback(
+            lambda f: self.loop.call_soon_threadsafe(self._answer, f)
+        )
+
+    def _answer(self, future) -> None:
+        self.conn.write_line(future.result())

@@ -1,0 +1,13 @@
+"""The same blocking helper — unreachable from any loop callback in
+this program, so its socket waits are its callers' business."""
+
+import socket
+
+
+def fetch_status(path: str) -> str:
+    sock = socket.create_connection(path, 1.0)
+    try:
+        sock.sendall(b'{"op": "stats"}\n')
+        return sock.recv(65536).decode()
+    finally:
+        sock.close()

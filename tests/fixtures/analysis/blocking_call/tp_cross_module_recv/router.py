@@ -1,0 +1,17 @@
+"""TP (cross-module): an event-loop callback reaches a blocking helper
+DEFINED IN ANOTHER MODULE — per-file analysis would never see it."""
+
+import wire_helpers
+
+
+class FrontSession:
+    def __init__(self, loop, conn):
+        self.loop = loop
+        self.conn = conn
+        conn.on_line = self._on_line
+
+    def _on_line(self, line: str) -> None:
+        # the callback runs on the loop thread; the helper it calls
+        # parks that thread on a socket read
+        status = wire_helpers.fetch_status(self.conn.backend_path)
+        self.conn.write_line(status)

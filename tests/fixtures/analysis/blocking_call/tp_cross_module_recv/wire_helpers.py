@@ -1,0 +1,14 @@
+"""The blocking helper module: innocent on its own (probes may block
+on THEIR callers' threads), a loop-stall when a router callback can
+reach it."""
+
+import socket
+
+
+def fetch_status(path: str) -> str:
+    sock = socket.create_connection(path, 1.0)  # BAD
+    try:
+        sock.sendall(b'{"op": "stats"}\n')  # BAD
+        return sock.recv(65536).decode()  # BAD
+    finally:
+        sock.close()

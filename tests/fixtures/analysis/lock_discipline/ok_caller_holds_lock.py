@@ -1,0 +1,34 @@
+"""OK: a lock-held helper needs no pragma — every call site acquires
+the lock, and the analyzer propagates the caller-holds-the-lock
+contract through the call graph (transitively: _restart is only called
+by _reap, which is only called under the lock)."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.workers = {}
+        self.restarts = 0
+
+    def start(self):
+        threading.Thread(target=self._monitor, daemon=True).start()
+
+    def _monitor(self):
+        while True:
+            with self._lock:
+                self._reap()
+
+    def stop(self):
+        with self._lock:
+            self.workers = {}
+
+    def _reap(self):
+        for name, proc in list(self.workers.items()):
+            if proc.poll() is not None:
+                self._restart(name)
+
+    def _restart(self, name):
+        self.restarts += 1
+        self.workers[name] = None

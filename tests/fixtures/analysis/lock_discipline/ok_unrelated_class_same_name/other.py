@@ -1,0 +1,9 @@
+"""A different class defining (and self-calling) its OWN helper."""
+
+
+class T:
+    def helper(self):
+        return 1
+
+    def go(self):
+        self.helper()

@@ -1,0 +1,25 @@
+"""OK (cross-module): an unrelated class's own `self.helper()` is that
+class's method — it must NOT revoke our contract (the supervisor/
+stripes name-collision shape)."""
+
+import threading
+
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+    def _loop(self):
+        with self._lock:
+            self.helper()
+
+    def helper(self):
+        self.count += 1

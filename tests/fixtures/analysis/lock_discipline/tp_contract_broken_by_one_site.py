@@ -1,0 +1,34 @@
+"""TP: the caller-holds-the-lock contract is only as good as EVERY
+call site — one lock-free caller on the spawned path and the helper's
+accesses are races again."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.workers = {}
+        self.restarts = 0
+
+    def start(self):
+        threading.Thread(target=self._monitor, daemon=True).start()
+
+    def _monitor(self):
+        while True:
+            with self._lock:
+                self._reap()
+            self._reap()  # the second sweep forgot the lock
+
+    def stop(self):
+        with self._lock:
+            self.workers = {}
+            self.restarts = 0
+
+    def _reap(self):
+        for name in list(self.workers):  # BAD
+            self._restart(name)
+
+    def _restart(self, name):
+        self.restarts += 1  # BAD
+        self.workers[name] = None  # BAD

@@ -1,0 +1,5 @@
+"""The revoking module: pokes the helper with no lock."""
+
+
+def poke(s) -> None:
+    s.helper()

@@ -1,0 +1,25 @@
+"""TP (cross-module): the caller-holds-the-lock contract is revoked by
+an OUTSIDE call site on an unknown receiver — `s.helper()` in another
+module may be our instance, lock-free."""
+
+import threading
+
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+    def _loop(self):
+        with self._lock:
+            self.helper()
+
+    def helper(self):
+        self.count += 1  # BAD

@@ -1,0 +1,8 @@
+"""OK: every registered series documented, every documented series
+registered."""
+
+
+def register(registry) -> None:
+    registry.gauge("widget_depth", "Widgets waiting right now")
+    registry.counter("widget_spins_total", "Spins by kind", labels=("kind",))
+    registry.histogram("widget_latency_seconds", "End-to-end widget latency")

@@ -1,0 +1,9 @@
+"""OK: an f-string registration becomes a wildcard pattern; the
+documented per-lane names satisfy it (and it covers them)."""
+
+LANES = ("featurize", "device", "writer")
+
+
+def register(registry) -> None:
+    for name in LANES:
+        registry.gauge(f"lane_{name}_busy", f"Occupancy of the {name} lane")

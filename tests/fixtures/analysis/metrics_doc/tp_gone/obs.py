@@ -1,0 +1,6 @@
+"""TP: the README documents a series no registration produces — stale
+docs mislead dashboards."""
+
+
+def register(registry) -> None:
+    registry.gauge("widget_depth", "Widgets waiting right now")

@@ -1,0 +1,7 @@
+"""TP: a series registered but absent from the README metric table —
+the namespace grew undocumented."""
+
+
+def register(registry) -> None:
+    registry.gauge("widget_depth", "Widgets waiting right now")
+    registry.counter("widget_spins_total", "Spins by kind", labels=("kind",))  # BAD

@@ -1,0 +1,13 @@
+"""OK worker for the probe helper: a stats-only control surface."""
+
+import json
+
+
+def handle_line(stats_fn, line: str, write_line) -> None:
+    msg = json.loads(line)
+    op = msg.get("op")
+    if op == "stats":
+        write_line(json.dumps({"id": msg.get("id"), "stats": stats_fn()}))
+    else:
+        write_line(json.dumps({"id": msg.get("id"),
+                               "error": f"bad_request: unknown op {op!r}"}))

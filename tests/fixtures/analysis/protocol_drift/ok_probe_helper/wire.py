@@ -1,0 +1,16 @@
+"""OK probe helper: a one-shot stats round trip (the supervisor's
+health-probe shape) against the worker in this program."""
+
+import json
+import socket
+
+PROBE_LINE = '{"op": "stats"}'
+
+
+def probe(path: str, timeout: float) -> dict:
+    sock = socket.create_connection(path, timeout)
+    try:
+        sock.sendall(PROBE_LINE.encode() + b"\n")
+        return json.loads(sock.recv(65536).decode())
+    finally:
+        sock.close()

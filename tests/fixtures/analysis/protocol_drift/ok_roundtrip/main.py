@@ -1,0 +1,12 @@
+"""OK client: every op it sends is handled, every field it reads is
+produced."""
+
+import json
+import socket
+
+
+def ask(sock: socket.socket, blob: str) -> dict:
+    sock.sendall((json.dumps({"op": "stats"}) + "\n").encode())
+    sock.sendall((json.dumps({"id": 1, "content": blob}) + "\n").encode())
+    row = json.loads(sock.recv(65536).decode())
+    return {"verdict": row.get("key"), "stats": row.get("stats")}

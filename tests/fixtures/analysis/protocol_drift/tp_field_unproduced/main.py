@@ -1,0 +1,16 @@
+"""TP client: backs off on a ``retry_after`` hint that no producer in
+this program ever emits — the backoff branch is dead drift."""
+
+import json
+import socket
+import time
+
+
+def ask(sock: socket.socket, blob: str) -> dict:
+    sock.sendall((json.dumps({"op": "stats"}) + "\n").encode())
+    sock.sendall((json.dumps({"id": 7, "content": blob}) + "\n").encode())
+    row = json.loads(sock.recv(65536).decode())
+    hint = row.get("retry_after")  # BAD
+    if hint:
+        time.sleep(hint)
+    return row

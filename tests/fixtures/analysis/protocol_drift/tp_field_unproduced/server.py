@@ -1,0 +1,15 @@
+"""TP worker: answers content rows — but never a retry_after field."""
+
+import json
+
+
+def handle_line(batcher, line: str, write_line) -> None:
+    msg = json.loads(line)
+    op = msg.get("op")
+    if op == "stats":
+        write_line(json.dumps({"id": msg.get("id"), "stats": {}}))
+        return
+    row = batcher.classify(msg.get("content"))
+    write_line(json.dumps({"id": msg.get("id"), "key": row.key,
+                           "matcher": row.matcher,
+                           "confidence": row.confidence}))

@@ -1,0 +1,11 @@
+"""TP client: sends a schema-declared op that NO surface in this
+program handles — the request would answer bad_request everywhere."""
+
+import json
+import socket
+
+
+def scrape(sock: socket.socket) -> None:
+    sock.sendall((json.dumps({"op": "stats"}) + "\n").encode())
+    sock.sendall((json.dumps({"id": 1, "content": "hello"}) + "\n").encode())
+    sock.sendall((json.dumps({"op": "reload", "corpus": "a.npz"}) + "\n").encode())  # BAD

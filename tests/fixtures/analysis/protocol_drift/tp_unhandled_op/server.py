@@ -1,0 +1,17 @@
+"""TP worker: handles stats and content rows, but the reload verb the
+client sends is nowhere in this dispatch."""
+
+import json
+
+
+def handle_line(batcher, line: str, write_line) -> None:
+    msg = json.loads(line)
+    op = msg.get("op")
+    if op == "stats":
+        write_line(json.dumps({"id": msg.get("id"), "stats": batcher.stats()}))
+        return
+    content = msg.get("content")
+    row = batcher.classify(content)
+    write_line(json.dumps({"id": msg.get("id"), "key": row.key,
+                           "matcher": row.matcher,
+                           "confidence": row.confidence}))

@@ -1,0 +1,18 @@
+"""OK stub worker: the handled op set matches the real worker exactly
+— protocol-faithful as a checked property."""
+
+import json
+
+
+def stub_answer(state, msg: dict) -> dict:
+    op = msg.get("op")
+    if op == "stats":
+        return {"id": msg.get("id"), "stats": {"completed": state.completed}}
+    if op == "trace":
+        return {"id": msg.get("id"), "traces": list(state.traces)}
+    return {"id": msg.get("id"), "key": "stub-mit", "matcher": "stub",
+            "confidence": 99.0}
+
+
+def serve_line(state, line: str) -> str:
+    return json.dumps(stub_answer(state, json.loads(line)))

@@ -1,0 +1,9 @@
+"""Client keeping the drift rule quiet."""
+
+import json
+
+
+def drive(send) -> None:
+    send(json.dumps({"op": "stats"}))
+    send(json.dumps({"op": "trace", "n": 5}))
+    send(json.dumps({"id": 1, "content": "hello"}))

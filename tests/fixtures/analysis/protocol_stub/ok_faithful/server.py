@@ -1,0 +1,19 @@
+"""OK real worker: stats, trace, and content."""
+
+import json
+
+
+def handle_line(batcher, line: str, write_line) -> None:
+    msg = json.loads(line)
+    op = msg.get("op")
+    if op == "stats":
+        write_line(json.dumps({"id": msg.get("id"), "stats": batcher.stats()}))
+        return
+    if op == "trace":
+        write_line(json.dumps({"id": msg.get("id"),
+                               "traces": batcher.trace_tail(msg.get("n", 20))}))
+        return
+    row = batcher.classify(msg.get("content"))
+    write_line(json.dumps({"id": msg.get("id"), "key": row.key,
+                           "matcher": row.matcher,
+                           "confidence": row.confidence}))

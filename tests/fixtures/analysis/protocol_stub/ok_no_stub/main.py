@@ -1,0 +1,8 @@
+"""Client for the stub-less program."""
+
+import json
+
+
+def drive(send) -> None:
+    send(json.dumps({"op": "stats"}))
+    send(json.dumps({"id": 1, "content": "hello"}))

@@ -1,0 +1,17 @@
+"""TP stub worker: claims to be protocol-faithful, but the reload verb
+the real worker handles is silently missing — the rolling-upgrade
+drills would exercise a protocol production does not speak."""
+
+import json
+
+
+def stub_answer(state, msg: dict) -> dict:
+    op = msg.get("op")
+    if op == "stats":  # BAD
+        return {"id": msg.get("id"), "stats": {"completed": state.completed}}
+    return {"id": msg.get("id"), "key": "stub-mit", "matcher": "stub",
+            "confidence": 99.0}
+
+
+def serve_line(state, line: str) -> str:
+    return json.dumps(stub_answer(state, json.loads(line)))

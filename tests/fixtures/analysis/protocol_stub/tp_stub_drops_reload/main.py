@@ -1,0 +1,10 @@
+"""Client keeping the drift rule quiet: every handled op has a
+sender."""
+
+import json
+
+
+def drive(send) -> None:
+    send(json.dumps({"op": "stats"}))
+    send(json.dumps({"op": "reload", "corpus": "next.npz"}))
+    send(json.dumps({"id": 1, "content": "hello"}))

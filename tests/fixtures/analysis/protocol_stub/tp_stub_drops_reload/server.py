@@ -1,0 +1,19 @@
+"""TP real worker: handles stats, reload, and content rows."""
+
+import json
+
+
+def handle_line(batcher, line: str, write_line) -> None:
+    msg = json.loads(line)
+    op = msg.get("op")
+    if op == "stats":
+        write_line(json.dumps({"id": msg.get("id"), "stats": batcher.stats()}))
+        return
+    if op == "reload":
+        out = batcher.reload_corpus(msg.get("corpus"))
+        write_line(json.dumps({"id": msg.get("id"), "reload": out}))
+        return
+    row = batcher.classify(msg.get("content"))
+    write_line(json.dumps({"id": msg.get("id"), "key": row.key,
+                           "matcher": row.matcher,
+                           "confidence": row.confidence}))

@@ -1,0 +1,19 @@
+"""TP stub worker: answers a trace verb the REAL worker never
+implemented — drills passing against stub-only protocol prove
+nothing about production."""
+
+import json
+
+
+def stub_answer(state, msg: dict) -> dict:
+    op = msg.get("op")
+    if op == "stats":
+        return {"id": msg.get("id"), "stats": {"completed": state.completed}}
+    if op == "trace":  # BAD
+        return {"id": msg.get("id"), "traces": list(state.traces)}
+    return {"id": msg.get("id"), "key": "stub-mit", "matcher": "stub",
+            "confidence": 99.0}
+
+
+def serve_line(state, line: str) -> str:
+    return json.dumps(stub_answer(state, json.loads(line)))

@@ -1,0 +1,15 @@
+"""TP real worker: stats and content only."""
+
+import json
+
+
+def handle_line(batcher, line: str, write_line) -> None:
+    msg = json.loads(line)
+    op = msg.get("op")
+    if op == "stats":
+        write_line(json.dumps({"id": msg.get("id"), "stats": batcher.stats()}))
+        return
+    row = batcher.classify(msg.get("content"))
+    write_line(json.dumps({"id": msg.get("id"), "key": row.key,
+                           "matcher": row.matcher,
+                           "confidence": row.confidence}))

@@ -1,0 +1,5 @@
+"""The factory module: ownership moves to the caller."""
+
+
+def open_feed(path: str):
+    return open(path, "rb")

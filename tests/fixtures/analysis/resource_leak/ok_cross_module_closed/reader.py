@@ -1,0 +1,17 @@
+"""OK (cross-module): every caller of the returning factory releases
+the handle on all paths, or hands ownership onward."""
+
+import conn_util
+
+
+def head(path: str) -> bytes:
+    feed = conn_util.open_feed(path)
+    try:
+        return feed.read(16)
+    finally:
+        feed.close()
+
+
+def reopen(path: str):
+    feed = conn_util.open_feed(path)
+    return feed  # ownership handed to OUR caller in turn

@@ -1,0 +1,7 @@
+"""The factory module: returning the handle is a legitimate ownership
+hand-off HERE — the caller inherits the close obligation."""
+
+
+def open_feed(path: str):
+    f = open(path, "rb")
+    return f

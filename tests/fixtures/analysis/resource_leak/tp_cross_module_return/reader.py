@@ -1,0 +1,17 @@
+"""TP (cross-module): the caller takes ownership of the returned file
+handle and never closes it — a descriptor leak the per-file pass
+cannot see (the factory lives in another module)."""
+
+import conn_util
+
+
+def head(path: str) -> bytes:
+    feed = conn_util.open_feed(path)  # BAD
+    return feed.read(16)
+
+
+def skim(path: str) -> bytes:
+    feed = conn_util.open_feed(path)  # BAD
+    data = feed.read(16)
+    feed.close()  # happy path only: an exception above leaks the fd
+    return data

@@ -1,0 +1,10 @@
+"""OK: a def-scope pragma covering real findings in the body is used —
+not stale."""
+
+import time
+
+
+# user-facing timestamps by contract (fixture)
+# analysis: disable=wallclock-time
+def stamps() -> tuple:
+    return (time.time(), time.time())

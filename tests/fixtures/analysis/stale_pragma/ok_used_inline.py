@@ -1,0 +1,9 @@
+"""OK: an inline pragma that suppresses a real finding is earning its
+keep — not stale."""
+
+import time
+
+
+def stamp() -> float:
+    # a wall-clock stamp on purpose: this value is user-facing
+    return time.time()  # analysis: disable=wallclock-time

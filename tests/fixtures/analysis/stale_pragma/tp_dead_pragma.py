@@ -1,0 +1,10 @@
+"""TP: a pragma guarding a line that violates nothing is dead weight —
+the violation it once excused was fixed for real, and the escape hatch
+must shrink with it."""
+
+import time
+
+
+def elapsed(t0: float) -> float:
+    # analysis: disable=wallclock-time — nothing below violates it  # BAD
+    return time.perf_counter() - t0

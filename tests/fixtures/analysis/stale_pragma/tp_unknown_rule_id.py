@@ -1,0 +1,9 @@
+"""TP: a pragma naming a rule id that does not exist suppresses
+nothing by construction (the classic typo'd escape hatch) — the real
+finding still fires AND the pragma is reported stale."""
+
+import time
+
+
+def stamp() -> float:
+    return time.time()  # analysis: disable=wallclock-times  # BAD

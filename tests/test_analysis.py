@@ -1,19 +1,27 @@
-"""The AST analyzer (licensee_tpu/analysis/ + script/analyze).
+"""The whole-program analyzer (licensee_tpu/analysis/ + script/analyze).
 
-Three layers of coverage:
+Four layers of coverage:
 
 * **fixture corpus** — tests/fixtures/analysis/<rule>/ holds >=2
-  seeded true-positive (``tp_*.py``) and >=2 clean (``ok_*.py``)
-  snippets per rule.  Offending lines carry a ``# BAD`` marker; a TP
-  file's findings for its rule must hit EXACTLY the marked lines, and
-  an OK file must produce none — both directions of each rule are
-  pinned, not just "it fires".
+  seeded true-positive (``tp_*``) and >=2 clean (``ok_*``) cases per
+  rule.  A ``.py`` case is a one-file program (``analyze_source``); a
+  DIRECTORY case is a multi-file program analyzed as its own root
+  (``analyze_project``) — the cross-module rules' native habitat.
+  Offending lines carry a ``# BAD`` marker (``<!-- BAD -->`` in
+  markdown); a TP case's findings for its rule must hit EXACTLY the
+  marked (file, line) pairs, and an OK case must produce none — both
+  directions of each rule are pinned, not just "it fires".
 * **engine semantics** — pragma suppression (inline, above-line, and
-  def-scope), path-component dir gating (the ``stripes_util.py``
-  prefix bug), and aliased-import resolution.
+  def-scope), the stale-pragma ledger, path-component dir gating (the
+  ``stripes_util.py`` prefix bug), and aliased-import resolution.
+* **the protocol inventory** — the contract checker must enumerate
+  the real wire ops (reload/stats/trace/content/queue_full/
+  router_closed among >= 8) from the product tree, and a seeded
+  stub-divergence fixture must fail ``script/analyze``.
 * **the repo gate** — the real product tree analyzes clean, exactly
   what ``script/analyze`` asserts in script/cibuild (the analyzer's
-  own package is part of that tree: the self-check).
+  own package is part of that tree: the self-check), and the
+  incremental cache is finding-identical warm vs cold.
 """
 
 from __future__ import annotations
@@ -25,8 +33,10 @@ import sys
 import pytest
 
 from licensee_tpu.analysis import (
+    PROGRAM_RULES,
     RULES,
     analyze_paths,
+    analyze_project,
     analyze_source,
     iter_python_files,
 )
@@ -48,6 +58,10 @@ DIR_TO_RULE = {
     "wallclock_time": "wallclock-time",
     "no_print": "no-print",
     "per_blob_featurize": "per-blob-featurize",
+    "stale_pragma": "stale-pragma",
+    "protocol_drift": "protocol-drift",
+    "protocol_stub": "protocol-stub-divergence",
+    "metrics_doc": "metrics-doc",
 }
 
 
@@ -56,7 +70,9 @@ def _fixture_files():
     for dirname, rule_id in sorted(DIR_TO_RULE.items()):
         dirpath = os.path.join(CORPUS, dirname)
         for name in sorted(os.listdir(dirpath)):
-            if name.endswith(".py"):
+            if name.endswith(".py") or os.path.isdir(
+                os.path.join(dirpath, name)
+            ):
                 cases.append(
                     (rule_id, os.path.join(dirpath, name), name)
                 )
@@ -67,8 +83,20 @@ def _marked_lines(text: str) -> set[int]:
     return {
         i
         for i, line in enumerate(text.splitlines(), 1)
-        if line.rstrip().endswith("# BAD")
+        if line.rstrip().endswith(("# BAD", "<!-- BAD -->"))
     }
+
+
+def _marked_in_dir(dirpath: str) -> set[tuple[str, int]]:
+    marked = set()
+    for walk_dir, _dirs, names in os.walk(dirpath):
+        for name in sorted(names):
+            path = os.path.join(walk_dir, name)
+            rel = os.path.relpath(path, dirpath)
+            with open(path, encoding="utf-8") as f:
+                for line in _marked_lines(f.read()):
+                    marked.add((rel, line))
+    return marked
 
 
 @pytest.mark.parametrize(
@@ -79,27 +107,33 @@ def _marked_lines(text: str) -> set[int]:
     ],
 )
 def test_fixture_corpus(rule_id, path, name):
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    findings = analyze_source(text, rel=name, force_all=True)
-    hit_lines = {f.line for f in findings if f.rule == rule_id}
-    if name.startswith("tp_"):
+    if os.path.isdir(path):
+        findings, _checked = analyze_project(path)
+        hits = {(f.path, f.line) for f in findings if f.rule == rule_id}
+        marked = _marked_in_dir(path)
+    else:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        findings = analyze_source(text, rel=name, force_all=True)
+        hits = {f.line for f in findings if f.rule == rule_id}
         marked = _marked_lines(text)
+    if name.startswith("tp_"):
         assert marked, f"{name}: a TP fixture must mark its lines # BAD"
-        assert hit_lines == marked, (
-            f"{name}: {rule_id} flagged lines {sorted(hit_lines)}, "
+        assert hits == marked, (
+            f"{name}: {rule_id} flagged {sorted(hits)}, "
             f"fixture marks {sorted(marked)}; findings: "
             f"{[f.render() for f in findings]}"
         )
     else:
-        assert not hit_lines, (
+        assert not hits, (
             f"{name}: clean fixture tripped {rule_id}: "
             f"{[f.render() for f in findings if f.rule == rule_id]}"
         )
 
 
 def test_every_rule_has_tp_and_ok_fixtures():
-    """>=2 seeded true-positive and >=2 clean snippets per rule."""
+    """>=2 seeded true-positive and >=2 clean cases per rule (files or
+    multi-module program directories)."""
     for dirname in DIR_TO_RULE:
         names = os.listdir(os.path.join(CORPUS, dirname))
         tps = [n for n in names if n.startswith("tp_")]
@@ -109,9 +143,9 @@ def test_every_rule_has_tp_and_ok_fixtures():
 
 
 def test_rule_registry_complete():
-    assert set(DIR_TO_RULE.values()) <= set(RULES), (
-        "fixture corpus names a rule the registry does not define"
-    )
+    assert set(DIR_TO_RULE.values()) <= (
+        set(RULES) | set(PROGRAM_RULES)
+    ), "fixture corpus names a rule no registry defines"
 
 
 # -- pragmas ------------------------------------------------------------
@@ -139,9 +173,11 @@ def test_pragma_requires_matching_rule_id():
         "    return time.time()  # analysis: disable=no-print\n"
     )
     findings = analyze_source(src)
-    assert [f.rule for f in findings] == ["wallclock-time"], (
-        "a pragma for a DIFFERENT rule must not suppress this one"
-    )
+    # the mismatched pragma must not suppress the wallclock finding —
+    # and, suppressing nothing, it is itself reported stale
+    assert [f.rule for f in findings] == ["stale-pragma", "wallclock-time"], [
+        f.render() for f in findings
+    ]
 
 
 def test_pragma_above_decorated_def_covers_body():
@@ -304,6 +340,155 @@ def test_script_analyze_cli():
     assert listing.returncode == 0
     for rule_id in DIR_TO_RULE.values():
         assert rule_id in listing.stdout
+
+
+def test_protocol_inventory_enumerates_real_wire_ops():
+    """The contract checker must see the REAL protocol: >= 8 wire ops
+    extracted from product code, the load-bearing ones by name."""
+    from licensee_tpu.analysis.core import Module
+    from licensee_tpu.analysis.program import Program, summarize
+    from licensee_tpu.analysis.rules_protocol import protocol_inventory
+
+    summaries = []
+    for path in iter_python_files(REPO_ROOT):
+        rel = os.path.relpath(path, REPO_ROOT)
+        with open(path, encoding="utf-8") as f:
+            try:
+                summaries.append(summarize(Module(rel, f.read())))
+            except SyntaxError:  # pragma: no cover - tree is clean
+                pass
+    program = Program(summaries, root=REPO_ROOT, complete=True)
+    ops = protocol_inventory(program)
+    assert len(ops) >= 8, sorted(ops)
+    for required in (
+        "reload", "stats", "trace", "content",
+        "queue_full", "router_closed",
+    ):
+        assert required in ops, f"{required} missing from {sorted(ops)}"
+    # the verbs must have both directions of evidence in real code
+    for verb in ("reload", "stats", "trace", "content"):
+        assert ops[verb]["sent"], f"{verb}: no sender found"
+        assert ops[verb]["handled"], f"{verb}: no handler found"
+
+
+def test_stub_divergence_fixture_fails_script_analyze():
+    """The acceptance drill: an op handled by the real worker but
+    dropped from the stub fails script/analyze on that program dir."""
+    script = os.path.join(REPO_ROOT, "script", "analyze")
+    fixture = os.path.join(CORPUS, "protocol_stub", "tp_stub_drops_reload")
+    run = subprocess.run(
+        [sys.executable, script, fixture],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert run.returncode == 1, run.stdout + run.stderr
+    assert "protocol-stub-divergence" in run.stdout
+    assert "reload" in run.stdout
+
+
+def test_cross_module_blocking_fixture_fails_script_analyze():
+    script = os.path.join(REPO_ROOT, "script", "analyze")
+    fixture = os.path.join(CORPUS, "blocking_call", "tp_cross_module_recv")
+    run = subprocess.run(
+        [sys.executable, script, fixture],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert run.returncode == 1, run.stdout + run.stderr
+    assert "blocking-call" in run.stdout
+    assert "wire_helpers.py" in run.stdout
+
+
+# -- the incremental cache ----------------------------------------------
+
+
+def test_cache_warm_run_is_finding_identical_and_parse_free(tmp_path):
+    """Cold run fills the cache; the warm run must miss nothing and
+    reproduce the exact findings (the --cache-ab CI gate's substance,
+    minus the timing assertion)."""
+    from licensee_tpu.analysis.program import AnalysisCache, engine_salt
+
+    files = [
+        p
+        for p in iter_python_files(REPO_ROOT)
+        if os.sep + "analysis" + os.sep in p or p.endswith("wire.py")
+    ]
+    assert len(files) > 5
+    salt = engine_salt()
+    cache_path = str(tmp_path / "analyze.json")
+    cold_cache = AnalysisCache(cache_path, salt)
+    cold, n_cold = analyze_paths(
+        files, REPO_ROOT, complete=False, cache=cold_cache
+    )
+    assert cold_cache.misses == n_cold and cold_cache.hits == 0
+    cold_cache.save()
+    warm_cache = AnalysisCache(cache_path, salt)
+    warm, n_warm = analyze_paths(
+        files, REPO_ROOT, complete=False, cache=warm_cache
+    )
+    assert warm_cache.hits == n_warm and warm_cache.misses == 0
+    assert [f.render() for f in cold] == [f.render() for f in warm]
+
+
+def test_cache_invalidated_by_content_and_salt(tmp_path):
+    from licensee_tpu.analysis.program import AnalysisCache
+
+    src = tmp_path / "leaky.py"
+    src.write_text(
+        "def read(path):\n"
+        "    text = open(path).read()\n"
+        "    return text\n",
+        encoding="utf-8",
+    )
+    cache_path = str(tmp_path / "cache.json")
+    cache = AnalysisCache(cache_path, "salt-1")
+    first, _ = analyze_paths([str(src)], str(tmp_path), cache=cache)
+    assert [f.rule for f in first] == ["resource-leak"]
+    cache.save()
+    # same salt + same content: a hit
+    cache2 = AnalysisCache(cache_path, "salt-1")
+    again, _ = analyze_paths([str(src)], str(tmp_path), cache=cache2)
+    assert cache2.hits == 1 and [f.rule for f in again] == ["resource-leak"]
+    # the fix changes the content hash: the entry must not be reused
+    src.write_text(
+        "def read(path):\n"
+        "    with open(path) as f:\n"
+        "        return f.read()\n",
+        encoding="utf-8",
+    )
+    fixed, _ = analyze_paths([str(src)], str(tmp_path), cache=cache2)
+    assert fixed == []
+    # an engine edit (new salt) drops the whole cache
+    cache3 = AnalysisCache(cache_path, "salt-2")
+    assert cache3.get("leaky.py", "anything") is None
+
+
+def test_script_analyze_cache_ab_gate():
+    """The CI flag itself: cold vs warmed over a fresh cache must be
+    finding-identical and faster."""
+    import json as jsonlib
+
+    script = os.path.join(REPO_ROOT, "script", "analyze")
+    run = subprocess.run(
+        [sys.executable, script, "--cache-ab"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert run.returncode == 0, run.stdout + run.stderr
+    out = jsonlib.loads(run.stdout)
+    assert out["cache_ab"] == "ok"
+    assert out["finding_identical"] is True
+    assert out["warm_s"] < out["cold_s"]
+    assert out["warm_cache_misses"] == 0
+
+
+def test_script_analyze_stats_flag():
+    script = os.path.join(REPO_ROOT, "script", "analyze")
+    run = subprocess.run(
+        [sys.executable, script, "--stats", "--no-cache"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "analyze --stats:" in run.stderr
+    for rule_id in ("blocking-call", "protocol-drift", "resource-leak"):
+        assert rule_id in run.stderr, run.stderr
 
 
 def test_script_analyze_flags_a_violation(tmp_path):

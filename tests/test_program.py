@@ -1,0 +1,298 @@
+"""The whole-program layer (licensee_tpu/analysis/program.py): alias
+resolution across modules — the seams the cross-module rules depend
+on — plus the call-graph walk, class hierarchies, and the
+reverse-dependency closure behind ``script/analyze --changed``.
+
+The alias cases are the satellite contract: ``import x as y``,
+``from m import f as g``, re-exported names through ``__init__.py``,
+and method references passed as callbacks must all resolve to the
+defining scope.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from licensee_tpu.analysis.core import Module
+from licensee_tpu.analysis.program import Program, summarize
+from licensee_tpu.analysis.scopes import (
+    ImportTable,
+    rel_to_modname,
+    rel_to_package,
+)
+
+
+def build_program(files: dict[str, str], **kwargs) -> Program:
+    return Program(
+        [summarize(Module(rel, src)) for rel, src in files.items()],
+        **kwargs,
+    )
+
+
+def scope_names(program, targets):
+    out = set()
+    for rel, sid in targets:
+        sc = program.by_rel[rel].scopes[sid]
+        out.add((rel, sc.owner, sc.name))
+    return out
+
+
+# -- module naming -------------------------------------------------------
+
+
+def test_rel_to_modname_and_package():
+    assert rel_to_modname("pkg/sub/mod.py") == "pkg.sub.mod"
+    assert rel_to_modname("pkg/sub/__init__.py") == "pkg.sub"
+    assert rel_to_package("pkg/sub/mod.py") == "pkg.sub"
+    assert rel_to_package("pkg/sub/__init__.py") == "pkg.sub"
+    assert rel_to_package("mod.py") == ""
+
+
+# -- import-alias resolution --------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "importer_src,callee",
+    [
+        # import x as y
+        ("import pkg.wire as w\n\ndef go():\n    w.probe()\n", "probe"),
+        # from m import f as g
+        (
+            "from pkg.wire import probe as check\n\n"
+            "def go():\n    check()\n",
+            "probe",
+        ),
+        # plain dotted use
+        ("import pkg.wire\n\ndef go():\n    pkg.wire.probe()\n", "probe"),
+    ],
+    ids=["import-as", "from-import-as", "dotted"],
+)
+def test_alias_forms_resolve_to_defining_scope(importer_src, callee):
+    program = build_program({
+        "pkg/__init__.py": "",
+        "pkg/wire.py": "def probe():\n    return 1\n",
+        "pkg/app.py": importer_src,
+    })
+    app = program.by_rel["pkg/app.py"]
+    go = next(sc for sc in app.scopes if sc.name == "go")
+    (call,) = go.calls
+    targets = program.call_targets("pkg/app.py", go, call)
+    assert ("pkg/wire.py", None, callee) in scope_names(program, targets)
+
+
+def test_reexport_through_init_resolves():
+    """``from pkg import probe`` where pkg/__init__.py re-exports it
+    from pkg.wire — one from-import hop at a time."""
+    program = build_program({
+        "pkg/__init__.py": "from pkg.wire import probe\n",
+        "pkg/wire.py": "def probe():\n    return 1\n",
+        "app.py": (
+            "from pkg import probe\n\n"
+            "def go():\n    probe()\n"
+        ),
+    })
+    app = program.by_rel["app.py"]
+    go = next(sc for sc in app.scopes if sc.name == "go")
+    (call,) = go.calls
+    targets = program.call_targets("app.py", go, call)
+    assert ("pkg/wire.py", None, "probe") in scope_names(program, targets)
+
+
+def test_relative_import_canonicalizes():
+    """``from .wire import probe`` inside pkg/app.py resolves against
+    the enclosing package."""
+    program = build_program({
+        "pkg/__init__.py": "",
+        "pkg/wire.py": "def probe():\n    return 1\n",
+        "pkg/app.py": (
+            "from .wire import probe as p\n\n"
+            "def go():\n    p()\n"
+        ),
+    })
+    app = program.by_rel["pkg/app.py"]
+    assert app.imports["p"] == "pkg.wire.probe"
+    go = next(sc for sc in app.scopes if sc.name == "go")
+    (call,) = go.calls
+    targets = program.call_targets("pkg/app.py", go, call)
+    assert ("pkg/wire.py", None, "probe") in scope_names(program, targets)
+
+
+def test_class_instantiation_resolves_to_init():
+    program = build_program({
+        "pkg/__init__.py": "",
+        "pkg/conn.py": (
+            "class Conn:\n"
+            "    def __init__(self, path):\n"
+            "        self.path = path\n"
+        ),
+        "pkg/app.py": (
+            "from pkg.conn import Conn\n\n"
+            "def go():\n    return Conn('x')\n"
+        ),
+    })
+    app = program.by_rel["pkg/app.py"]
+    go = next(sc for sc in app.scopes if sc.name == "go")
+    (call,) = go.calls
+    targets = program.call_targets("pkg/app.py", go, call)
+    assert ("pkg/conn.py", "Conn", "__init__") in scope_names(
+        program, targets
+    )
+
+
+def test_method_reference_passed_as_callback_is_spawned():
+    """``Thread(target=wire.worker_loop)`` marks the referenced module
+    function as a spawn target across the module boundary."""
+    src = (
+        "import threading\n"
+        "import pkg.wire as wire\n\n"
+        "def boot():\n"
+        "    threading.Thread(target=wire.worker_loop).start()\n"
+    )
+    program = build_program({
+        "pkg/__init__.py": "",
+        "pkg/wire.py": "def worker_loop():\n    return 1\n",
+        "app.py": src,
+    })
+    app = program.by_rel["app.py"]
+    assert "pkg.wire.worker_loop" in app.spawned_qualified
+    assert scope_names(
+        program, program.resolve("pkg.wire.worker_loop")
+    ) == {("pkg/wire.py", None, "worker_loop")}
+
+
+def test_self_call_dispatches_through_hierarchy():
+    """A ``self.handle()`` in the base class reaches the subclass
+    override in ANOTHER module — the LoopJsonlServer/JsonlUnixServer
+    shape."""
+    program = build_program({
+        "pkg/__init__.py": "",
+        "pkg/base.py": (
+            "class Server:\n"
+            "    def accept(self):\n"
+            "        self.handle()\n"
+            "    def handle(self):\n"
+            "        raise NotImplementedError\n"
+        ),
+        "pkg/impl.py": (
+            "from pkg.base import Server\n\n"
+            "class Worker(Server):\n"
+            "    def handle(self):\n"
+            "        return 42\n"
+        ),
+    })
+    base = program.by_rel["pkg/base.py"]
+    accept = next(sc for sc in base.scopes if sc.name == "accept")
+    (call,) = accept.calls
+    names = scope_names(
+        program, program.call_targets("pkg/base.py", accept, call)
+    )
+    assert ("pkg/base.py", "Server", "handle") in names
+    assert ("pkg/impl.py", "Worker", "handle") in names
+
+
+# -- the reachability walk ----------------------------------------------
+
+
+def test_reachable_crosses_modules_and_skip_edge_vetoes():
+    program = build_program({
+        "pkg/__init__.py": "",
+        "pkg/helper.py": (
+            "def inner():\n    return 1\n\n"
+            "def outer():\n    return inner()\n"
+        ),
+        "app.py": (
+            "import pkg.helper as helper\n\n"
+            "def entry():\n    helper.outer()\n"
+        ),
+    })
+    app = program.by_rel["app.py"]
+    entry = next(sc for sc in app.scopes if sc.name == "entry")
+    reached = program.reachable([("app.py", entry.sid, "test")])
+    names = {
+        (rel, program.by_rel[rel].scopes[sid].name)
+        for (rel, sid) in reached
+    }
+    assert ("pkg/helper.py", "outer") in names
+    assert ("pkg/helper.py", "inner") in names
+    # vetoing the app->outer edge keeps the whole subtree out
+    reached = program.reachable(
+        [("app.py", entry.sid, "test")],
+        skip_edge=lambda s, sc, call: call[1] == "outer",
+    )
+    names = {
+        (rel, program.by_rel[rel].scopes[sid].name)
+        for (rel, sid) in reached
+    }
+    assert ("pkg/helper.py", "outer") not in names
+
+
+# -- the import graph (--changed closure) --------------------------------
+
+
+def test_reverse_closure_follows_importers():
+    program = build_program({
+        "pkg/__init__.py": "",
+        "pkg/wire.py": "def probe():\n    return 1\n",
+        "pkg/router.py": "from pkg.wire import probe\n",
+        "pkg/cli.py": "import pkg.router\n",
+        "pkg/other.py": "X = 1\n",
+    })
+    closure = program.reverse_closure({"pkg/wire.py"})
+    assert closure == {"pkg/wire.py", "pkg/router.py", "pkg/cli.py"}
+    assert program.reverse_closure({"pkg/other.py"}) == {"pkg/other.py"}
+
+
+def test_circular_reexport_resolves_to_none_not_recursion():
+    """Two packages re-exporting each other's name must resolve to
+    nothing (and never recurse) — both for callables and for base
+    classes."""
+    program = build_program({
+        "a/__init__.py": "from b import Thing\n",
+        "b/__init__.py": "from a import Thing\n",
+        "app.py": (
+            "from a import Thing\n\n"
+            "class Sub(Thing):\n"
+            "    pass\n\n"
+            "def go():\n    Thing()\n"
+        ),
+    })
+    assert program.resolve("a.Thing") == []
+    app = program.by_rel["app.py"]
+    go = next(sc for sc in app.scopes if sc.name == "go")
+    (call,) = go.calls
+    assert program.call_targets("app.py", go, call) == []
+
+
+def test_changed_closure_keeps_program_rule_findings(tmp_path):
+    """--changed narrows per-file reporting but must never drop a
+    whole-program finding (a stale pragma in an unchanged file still
+    fails — --changed can never pass what the full scan fails)."""
+    from licensee_tpu.analysis import analyze_paths
+
+    stale = tmp_path / "stale.py"
+    stale.write_text(
+        "def f():\n"
+        "    return 1  # analysis: disable=wallclock-time\n",
+        encoding="utf-8",
+    )
+    other = tmp_path / "other.py"
+    other.write_text("X = 1\n", encoding="utf-8")
+    findings, _ = analyze_paths(
+        [str(stale), str(other)], str(tmp_path), complete=True,
+        changed_rels={"other.py"},
+    )
+    assert [f.rule for f in findings] == ["stale-pragma"], [
+        f.render() for f in findings
+    ]
+
+
+def test_import_table_canonicalizes_relative_levels():
+    import ast
+
+    tree = ast.parse(
+        "from . import sibling\n"
+        "from ..top import thing\n"
+    )
+    table = ImportTable(tree, package="pkg.sub")
+    assert table.names["sibling"] == "pkg.sub.sibling"
+    assert table.names["thing"] == "pkg.top.thing"
