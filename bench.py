@@ -16,6 +16,7 @@ separate host stage, pipelined in production via BatchProject).
 from __future__ import annotations
 
 import json
+import os
 import re
 import sys
 import time
@@ -1216,9 +1217,23 @@ def bench_serve_path(n_requests: int = 2048) -> dict:
         # exposition size + grammar, trace retention, and the device
         # compile-vs-execute split (details.obs; a scalar summary rides
         # the headline)
-        from licensee_tpu.obs import check_exposition
+        from licensee_tpu.obs import assemble_rows, check_exposition
 
         exposition = batcher.prometheus()
+        # the telemetry plane's own health on the same traffic: the
+        # SLO verdict (multi-window burn over the run's counters) and
+        # the trace assembler run over this process's retained tail
+        # (single-proc trees; critical-path self-times must account
+        # the recorded e2e within 5% on every tree)
+        trees = assemble_rows(
+            batcher.trace_tail(200),
+            root_proc=batcher.obs.tracer.proc,
+        )
+        within = sum(
+            1 for t in trees
+            if t["e2e_ms"]
+            and abs(t["critical_ms"] - t["e2e_ms"]) <= 0.05 * t["e2e_ms"]
+        )
         obs = {
             "prometheus_lines": len(exposition.splitlines()),
             "prometheus_grammar_errors": len(check_exposition(exposition)),
@@ -1226,6 +1241,11 @@ def bench_serve_path(n_requests: int = 2048) -> dict:
             "tracing": batcher.obs.tracer.stats(),
             "device_dispatch": stats.get("device"),
             "uptime_s": stats.get("uptime_s"),
+            "slo": stats.get("slo"),
+            "traces_assembled": {
+                "trees": len(trees),
+                "critical_within_5pct": within,
+            },
         }
     total = stats["latency_ms"]["total"]
     return {
@@ -1608,7 +1628,68 @@ def bench_router_saturation(
 # recorded no numbers at all.  The final printed line is therefore
 # byte-budgeted: bounded scalar summaries only, with the open-ended
 # per-row blobs written to BENCH_DETAILS.json instead.
-HEADLINE_BYTE_BUDGET = 1500
+# raised 1500 -> 1700 for the r6 obs.slo/traces scalars: the driver
+# tail captures ~2000 chars, and 1700 + a TPU-plugin warning line
+# still fits (tests/test_bench_contract.py pins this against a
+# worst-case details dict) — and BENCH_r06.json now carries the same
+# headline as a FILE, so the stdout window is no longer load-bearing
+HEADLINE_BYTE_BUDGET = 1700
+
+# the driver-facing headline artifact, written UNCONDITIONALLY by
+# main() (fast mode included) so a skipped or truncated stdout capture
+# can never leave the round record empty again
+HEADLINE_FILE = "BENCH_r06.json"
+
+
+def _obs_headline(obs_row) -> dict:
+    """The compact obs scalars riding the headline (full snapshot:
+    details.serve_path.obs)."""
+    obs_row = obs_row or {}
+    slo = obs_row.get("slo") or {}
+    objectives = slo.get("objectives") or {}
+    assembled = obs_row.get("traces_assembled") or {}
+    return {
+        "prom_lines": obs_row.get("prometheus_lines"),
+        "grammar_errors": obs_row.get("prometheus_grammar_errors"),
+        "traces": (obs_row.get("tracing") or {}).get("retained"),
+        # the SLO engine's verdict over the bench run's own traffic
+        "slo": {
+            "ok": slo.get("ok"),
+            "availability_burn": (
+                objectives.get("availability") or {}
+            ).get("max_burn"),
+            "latency_burn": (
+                objectives.get("latency_p99") or {}
+            ).get("max_burn"),
+        },
+        # the assembler's audit: trees built, trees whose critical-
+        # path self-times sum within 5% of the recorded e2e
+        "traces_assembled": assembled.get("trees"),
+        "traces_critical_within_5pct": assembled.get(
+            "critical_within_5pct"
+        ),
+    }
+
+
+def write_headline_artifacts(
+    headline: dict, details: dict, out_dir: str | None = None
+) -> str:
+    """Write BENCH_DETAILS.json (full blob) and the compact
+    HEADLINE_FILE next to bench.py (or ``out_dir``); returns the
+    headline artifact path.  Runs in EVERY mode — the driver view must
+    never be empty just because the slow suites were skipped."""
+    out_dir = out_dir or os.path.dirname(os.path.abspath(__file__))
+    details_path = os.path.join(out_dir, "BENCH_DETAILS.json")
+    with open(details_path, "w", encoding="utf-8") as f:
+        json.dump({"headline": headline, "details": details}, f, indent=1)
+        f.write("\n")
+    headline_path = os.path.join(out_dir, HEADLINE_FILE)
+    tmp = f"{headline_path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(headline, f, separators=(",", ":"))
+        f.write("\n")
+    os.replace(tmp, headline_path)
+    return headline_path
 
 
 def make_headline(
@@ -1700,18 +1781,10 @@ def make_headline(
                 "sat_x": sat.get("x_vs_pr4_closed_loop"),
             },
             # the observability layer's own health on real serve
-            # traffic (full snapshot under details.serve_path.obs)
-            "obs": {
-                "prom_lines": (serve.get("obs") or {}).get(
-                    "prometheus_lines"
-                ),
-                "grammar_errors": (serve.get("obs") or {}).get(
-                    "prometheus_grammar_errors"
-                ),
-                "traces": ((serve.get("obs") or {}).get("tracing") or {}).get(
-                    "retained"
-                ),
-            },
+            # traffic (full snapshot under details.serve_path.obs):
+            # exposition size/grammar, trace retention, the SLO burn
+            # verdict, and the trace assembler's critical-path audit
+            "obs": _obs_headline(serve.get("obs")),
             # the host-featurize trajectory: crossing us/blob, the
             # per-stripe serial cost, and the single-process Amdahl
             # ceiling they imply
@@ -1761,9 +1834,16 @@ def main() -> None:
     # corpus/spdx_synth.py + corpus/spdx.py; extend_templates() bitset
     # rows remain only as the emergency fallback).
     # '1m' anywhere in argv (or LICENSEE_TPU_BENCH_1M=1) opts into the
-    # >=1M-file end-to-end row; numeric args keep their positions
-    argv = [a for a in sys.argv[1:] if a != "1m"]
-    n_blobs = int(argv[0]) if argv else 262144
+    # >=1M-file end-to-end row; 'fast' (or LICENSEE_TPU_BENCH_FAST=1)
+    # SKIPS the slow suites but still measures the device headline +
+    # the serve/obs row and ALWAYS writes the BENCH_r06.json headline
+    # artifact — the driver view must never be empty; numeric args
+    # keep their positions
+    fast = "fast" in sys.argv[1:] or bool(
+        os.environ.get("LICENSEE_TPU_BENCH_FAST")
+    )
+    argv = [a for a in sys.argv[1:] if a not in ("1m", "fast")]
+    n_blobs = int(argv[0]) if argv else (16384 if fast else 262144)
     n_templates = int(argv[1]) if len(argv) > 1 else 608
     from licensee_tpu.corpus.compiler import default_corpus
     from licensee_tpu.kernels.dice_xla import CorpusArrays
@@ -1845,54 +1925,63 @@ def main() -> None:
             print(f"bench[{label}] failed: {exc}", file=sys.stderr)
             return None
 
-    end_to_end = run_safe("end_to_end", bench_end_to_end, unique=True)
-    end_to_end_dup = run_safe(
+    def run_slow(label, fn, *args, **kwargs):
+        # a slow suite: skipped entirely in fast mode (its headline
+        # fields degrade to None — make_headline tolerates every row
+        # being absent, and BENCH_r06.json is written regardless)
+        if fast:
+            print(f"bench[{label}] skipped (fast mode)", file=sys.stderr)
+            return None
+        return run_safe(label, fn, *args, **kwargs)
+
+    end_to_end = run_slow("end_to_end", bench_end_to_end, unique=True)
+    end_to_end_dup = run_slow(
         "end_to_end_dup", bench_end_to_end, unique=False
     )
-    end_to_end_readme = run_safe(
+    end_to_end_readme = run_slow(
         "end_to_end_readme", bench_end_to_end, n_files=16384, mode="readme"
     )
-    end_to_end_package = run_safe(
+    end_to_end_package = run_slow(
         "end_to_end_package", bench_end_to_end, n_files=16384, mode="package"
     )
-    end_to_end_auto = run_safe(
+    end_to_end_auto = run_slow(
         "end_to_end_auto", bench_end_to_end, n_files=32768, mode="auto"
     )
-    serve_path = run_safe("serve_path", bench_serve_path)
-    reload_row = run_safe("reload", bench_reload)
-    fleet = run_safe("fleet", bench_fleet)
-    host_model = run_safe("host_model", bench_host_model, e2e=end_to_end)
-    overlap = run_safe("overlap", bench_overlap)
+    serve_path = run_safe(
+        "serve_path", bench_serve_path, 512 if fast else 2048
+    )
+    reload_row = run_slow("reload", bench_reload)
+    fleet = run_slow("fleet", bench_fleet)
+    host_model = run_slow("host_model", bench_host_model, e2e=end_to_end)
+    overlap = run_slow("overlap", bench_overlap)
     if host_model is not None and overlap is not None:
         # the overlap row rides host_model: it is the same lane story
         # (rate = 1/max(featurize_lane, writer_lane), device invisible)
         host_model["overlap"] = overlap
-    method_crossover = run_safe(
+    method_crossover = run_slow(
         "method_crossover", bench_method_crossover
     )
-    stripes = run_safe(
+    stripes = run_slow(
         "stripes", bench_stripes, host_model=host_model
     )
-    reference_fallback = run_safe(
+    reference_fallback = run_slow(
         "reference_fallback", bench_reference_fallback
     )
-    tp_width = run_safe(
+    tp_width = run_slow(
         "tp_width", bench_tp_width, arrays_full, features_full, rates_full
     )
-    agreement = run_safe("agreement", bench_agreement)
+    agreement = run_slow("agreement", bench_agreement)
 
     # at-scale rows run in the DEFAULT bench at 200k entries (~5-10 s
     # each at the measured rates) so the driver artifact carries them;
     # '1m' / LICENSEE_TPU_BENCH_1M=1 upgrades them to the full >=1M shape
-    import os as _os
-
     at_scale_n = 200_000
-    if _os.environ.get("LICENSEE_TPU_BENCH_1M") or "1m" in sys.argv[1:]:
+    if os.environ.get("LICENSEE_TPU_BENCH_1M") or "1m" in sys.argv[1:]:
         at_scale_n = 1_000_000
-    end_to_end_1m = run_safe(
+    end_to_end_1m = run_slow(
         "end_to_end_1m", bench_end_to_end_1m, at_scale_n
     )
-    end_to_end_1m_auto = run_safe(
+    end_to_end_1m_auto = run_slow(
         "end_to_end_1m_auto", bench_end_to_end_1m_auto, at_scale_n
     )
 
@@ -1929,14 +2018,10 @@ def main() -> None:
     headline = make_headline(
         metric, device_rate, device_rate / scalar_rate, details
     )
-    details_path = _os.path.join(
-        _os.path.dirname(_os.path.abspath(__file__)), "BENCH_DETAILS.json"
-    )
-    with open(details_path, "w", encoding="utf-8") as f:
-        json.dump(
-            {"headline": headline, "details": details}, f, indent=1
-        )
-        f.write("\n")
+    # BENCH_DETAILS.json + the compact BENCH_r06.json headline are
+    # written in EVERY mode — skipping the slow suites (fast mode, or
+    # per-suite failures) degrades fields to None, never the artifact
+    write_headline_artifacts(headline, details)
     line = json.dumps(headline, separators=(",", ":"))
     if len(line.encode()) > HEADLINE_BYTE_BUDGET:
         # never abort after a multi-minute run: an over-budget line

@@ -74,6 +74,7 @@ from licensee_tpu.analysis.core import (  # noqa: F401
 # importing the rule modules registers their rules
 from licensee_tpu.analysis import (  # noqa: F401  (registration imports)
     rules_concurrency,
+    rules_events,
     rules_house,
     rules_metrics,
     rules_protocol,

@@ -27,6 +27,11 @@ REQUEST_OPS: dict[str, tuple[str, ...]] = {
     ),
     "stats": ("id", "format"),
     "trace": ("id", "n"),
+    # the telemetry plane's assembled-tree verb: FRONT-socket only
+    # (the router's collector joins every worker's "trace" tail) — a
+    # plain worker answers bad_request, so the stub parity check
+    # (worker vs stub) is untouched
+    "traces": ("id", "n", "trace_id"),
     "reload": ("id", "corpus"),
 }
 

@@ -1,5 +1,5 @@
 """Command-line interface: detect / diff / license-path / version /
-batch-detect / serve / stats / fleet / corpus-build.
+batch-detect / serve / stats / traces / slo / fleet / corpus-build.
 
 Parity target: `bin/licensee` + `lib/licensee/commands/*.rb` (Thor CLI).
 `batch-detect` is new: the TPU batch path over a manifest of files.
@@ -7,6 +7,11 @@ Parity target: `bin/licensee` + `lib/licensee/commands/*.rb` (Thor CLI).
 stdio or a Unix socket, serve/).
 `stats` scrapes one worker (JSON/Prometheus/traces) or a whole fleet
 (merged table with --watch, merged exposition).
+`traces` renders ASSEMBLED cross-process trace trees from a fleet
+front socket (router + worker tails joined by trace ID with critical-
+path self-times, obs/collect.py).
+`slo` evaluates the multi-window SLO burn verdict from a stats scrape
+(obs/slo.py; exit 1 when burning).
 `fleet` supervises N serve workers behind one health-checked, load-
 balanced, hedging front socket (fleet/).
 `corpus-build` compiles any corpus source into a versioned, content-
@@ -862,6 +867,24 @@ def cmd_serve(args) -> int:
 
     from licensee_tpu.serve.scheduler import MicroBatcher
 
+    # socket workers get a fleet identity (the basename the supervisor
+    # names them by) and a flight recorder on the black-box convention
+    # the supervisor harvests (obs/flight.py) — a stdio session keeps
+    # the in-process defaults
+    flight = None
+    proc_name = "serve"
+    if args.socket:
+        from licensee_tpu.obs.flight import (
+            FlightRecorder,
+            flight_path_for_socket,
+        )
+
+        proc_name = os.path.basename(args.socket)
+        if proc_name.endswith(".sock"):
+            proc_name = proc_name[: -len(".sock")]
+        flight = FlightRecorder(
+            flight_path_for_socket(args.socket), proc=proc_name
+        )
     try:
         batcher = MicroBatcher(
             method=args.method,
@@ -883,12 +906,18 @@ def cmd_serve(args) -> int:
             trace_sample=args.trace_sample,
             trace_slow_ms=args.trace_slow_ms,
             trace_log=args.trace_log,
+            trace_proc=proc_name,
+            flight=flight,
             corpus_source=args.corpus,
             **kwargs,
         )
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
+    if flight is not None:
+        flight.register_metrics(batcher.obs.registry)
+        flight.start()
+        flight.record("boot", socket=args.socket)
     try:
         if args.socket:
             print(f"serving on {args.socket}", file=sys.stderr)
@@ -899,6 +928,10 @@ def cmd_serve(args) -> int:
         pass
     finally:
         batcher.close()
+        if flight is not None:
+            # the SIGTERM/clean-shutdown black box: final dump to disk
+            flight.record("shutdown")
+            flight.stop()
         if args.stats:
             print(json.dumps(batcher.stats()), file=sys.stderr)
     return 0
@@ -1121,6 +1154,95 @@ def cmd_stats(args) -> int:
     return 1
 
 
+def cmd_traces(args) -> int:
+    """The telemetry-plane viewer: ask a fleet front socket for
+    ASSEMBLED cross-process trace trees (`{"op": "traces"}` — router
+    spans + every worker's serving spans joined by 16-hex trace ID,
+    with critical-path self-times) and render them.  "Where did the
+    p99 go" is one command: `licensee-tpu traces --socket front.sock
+    --slowest 1`."""
+    from licensee_tpu.obs.collect import render_tree
+
+    payload: dict = {"op": "traces", "n": args.slowest or args.n}
+    if args.id:
+        payload["trace_id"] = args.id
+    try:
+        row = _scrape_row(args.socket, payload, args.timeout)
+    except (OSError, ValueError) as exc:
+        print(
+            f"error: cannot scrape {args.socket!r}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    trees = row.get("traces")
+    if not isinstance(trees, list):
+        print(
+            f"error: unexpected response: {row} (is {args.socket!r} a "
+            "fleet front socket? workers answer {'op': 'trace'} only)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.slowest:
+        trees = trees[: args.slowest]
+    if not trees:
+        print("no assembled traces retained", file=sys.stderr)
+        return 1
+    for i, tree in enumerate(trees):
+        if args.json:
+            print(json.dumps(tree))
+        else:
+            if i:
+                print()
+            print(render_tree(tree))
+    return 0
+
+
+def cmd_slo(args) -> int:
+    """The SLO verdict: scrape a worker (or fleet front) socket's
+    stats and render the multi-window burn-rate table (obs/slo.py).
+    Exit 0 when every objective is inside its burn thresholds, 1 when
+    any fast/slow burn alert fires."""
+    try:
+        row = _scrape_row(args.socket, {"op": "stats"}, args.timeout)
+    except (OSError, ValueError) as exc:
+        print(
+            f"error: cannot scrape {args.socket!r}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    slo = (row.get("stats") or {}).get("slo")
+    if not isinstance(slo, dict):
+        print(
+            f"error: no slo block in stats from {args.socket!r}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(slo))
+        return 0 if slo.get("ok") else 1
+    from licensee_tpu.obs.slo import WINDOWS
+
+    window_names = [w for w, _secs in WINDOWS]
+    rows = [["OBJECTIVE", "TARGET", *[f"BURN_{w}" for w in window_names],
+             "VERDICT"]]
+    for name, obj in sorted((slo.get("objectives") or {}).items()):
+        windows = obj.get("windows") or {}
+        verdict = "ok"
+        if obj.get("fast_burn_alert"):
+            verdict = "PAGE (fast burn)"
+        elif obj.get("slow_burn_alert"):
+            verdict = "TICKET (slow burn)"
+        rows.append([
+            name,
+            f"{obj.get('target', 0) * 100:g}%",
+            *[str(windows.get(w, "-")) for w in window_names],
+            verdict,
+        ])
+    _render_table(rows, sys.stdout)
+    print(f"slo: {'ok' if slo.get('ok') else 'BURNING'}")
+    return 0 if slo.get("ok") else 1
+
+
 def cmd_fleet(args) -> int:
     """The fleet tier: supervise N serve worker processes (restart on
     crash/wedge with backoff, drain on rolling restart) behind one
@@ -1264,6 +1386,8 @@ COMMANDS = (
     ("batch-detect", "Classify a manifest of files on the TPU batch path"),
     ("serve", "Run the online micro-batching classification worker"),
     ("stats", "Scrape serve workers' metrics/traces (obs exporters)"),
+    ("traces", "Render assembled cross-process trace trees (fleet)"),
+    ("slo", "Evaluate SLO burn rates from a worker/fleet scrape"),
     ("fleet", "Supervise N serve workers behind one routed socket"),
     ("corpus-build", "Compile a corpus into a fingerprinted artifact"),
 )
@@ -1747,6 +1871,55 @@ def build_parser() -> argparse.ArgumentParser:
     )
     stats.set_defaults(func=cmd_stats)
 
+    traces = sub.add_parser("traces", help=_COMMAND_HELP["traces"])
+    traces.add_argument(
+        "--socket", required=True, metavar="PATH",
+        help=(
+            "A fleet FRONT socket (licensee-tpu fleet --socket PATH): "
+            "the router's collector pulls every worker tail and "
+            "answers {'op': 'traces'} with assembled trees"
+        ),
+    )
+    traces.add_argument(
+        "--id", default=None, metavar="HEX",
+        help="Only traces whose 16-hex ID starts with this prefix",
+    )
+    traces.add_argument(
+        "--slowest", type=bounded(int, 1), default=None, metavar="N",
+        help="The N slowest assembled traces (default: 20 slowest)",
+    )
+    traces.add_argument(
+        "--n", type=bounded(int, 1), default=20, metavar="N",
+        help="How many trees to fetch without --slowest (default 20)",
+    )
+    traces.add_argument(
+        "--json", action="store_true",
+        help="One JSON tree per line instead of the rendered view",
+    )
+    traces.add_argument(
+        "--timeout", type=nonneg(float), default=10.0, metavar="SECS",
+        help="Socket connect/read timeout (default 10)",
+    )
+    traces.set_defaults(func=cmd_traces)
+
+    slo = sub.add_parser("slo", help=_COMMAND_HELP["slo"])
+    slo.add_argument(
+        "--socket", required=True, metavar="PATH",
+        help=(
+            "A serve worker's socket (its own objectives) or a fleet "
+            "front socket (the router's fleet-level objectives)"
+        ),
+    )
+    slo.add_argument(
+        "--json", action="store_true",
+        help="Print the raw slo stats block instead of the table",
+    )
+    slo.add_argument(
+        "--timeout", type=nonneg(float), default=10.0, metavar="SECS",
+        help="Socket connect/read timeout (default 10)",
+    )
+    slo.set_defaults(func=cmd_slo)
+
     fleet = sub.add_parser("fleet", help=_COMMAND_HELP["fleet"])
     fleet.add_argument(
         "--socket", default=None, metavar="PATH",
@@ -1922,7 +2095,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "fleet", "corpus-build", "-h", "--help"}
+    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "traces", "slo", "fleet", "corpus-build", "-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

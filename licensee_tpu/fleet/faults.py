@@ -38,6 +38,11 @@ import threading
 import time
 from collections import deque
 
+from licensee_tpu.obs.flight import (
+    FlightRecorder,
+    flight_path_for_socket,
+)
+
 
 def kill(pid: int) -> None:
     """The crash fault: SIGKILL, no cleanup, no goodbye — the worker's
@@ -325,8 +330,20 @@ class _StubState:
         self.lock = threading.Lock()
         self.completed = 0
         self.in_flight = 0
-        self.traces: deque = deque(maxlen=64)
+        # 256 deep: the SIGKILL drill assembles a failover trace from
+        # this tail AFTER the remaining stream drained onto the
+        # surviving worker — 64 evicted the evidence
+        self.traces: deque = deque(maxlen=256)
         self.hang_forever = threading.Event()
+        # the stub keeps a real flight recorder (obs/flight.py) on the
+        # same black-box convention as a serve worker, so the fleet
+        # drills exercise the supervisor's SIGKILL harvest in
+        # milliseconds without a JAX boot
+        self.flight = FlightRecorder(
+            flight_path_for_socket(args.socket),
+            proc=args.name,
+            flush_interval_s=0.05,
+        ).start()
         # the corpus-lifecycle twin: a fingerprint/source pair the
         # reload verb swaps, echoed on stats and content rows exactly
         # like a real serve worker — the fleet reload drills ride this
@@ -375,6 +392,9 @@ def _stub_reload(state: _StubState, msg: dict) -> dict | None:
             state.fingerprint = corpus
             state.corpus_source = corpus
             state.reloads += 1
+        state.flight.record(
+            "reload_swap", fingerprint=corpus, previous=previous
+        )
         return {
             "id": rid,
             "reload": {
@@ -434,7 +454,9 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
         return {"id": rid, "error": f"bad_request: unknown op {op!r}"}
     # a content row
     if args.queue_full:
+        state.flight.record("error", what="queue_full", id=rid)
         return {"id": rid, "error": "queue_full", "retry_after": 0.05}
+    state.flight.record("admission", id=rid, trace=msg.get("trace"))
     with state.lock:
         state.in_flight += 1
     try:
@@ -445,8 +467,13 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
             n = state.completed
             trace_id = msg.get("trace")
             if trace_id:
+                # the same tail-row shape a real worker's tracer
+                # serves: kind/proc tags + a dur so the fleet
+                # collector joins and attributes without heuristics
                 state.traces.append({
-                    "trace": trace_id, "id": rid, "status": "ok",
+                    "trace": trace_id, "id": rid, "kind": "trace",
+                    "proc": state.name, "status": "ok",
+                    "dur_ms": float(args.service_ms),
                     "spans": [{"name": "stub_serve", "t_ms": 0.0,
                                "dur_ms": float(args.service_ms)}],
                 })
@@ -576,6 +603,7 @@ def stub_main(argv=None) -> int:
         pass
     finally:
         server.server_close()
+        server.state.flight.stop()  # the clean-shutdown black box
         try:
             os.unlink(args.socket)
         except OSError:

@@ -67,7 +67,13 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 from licensee_tpu.fleet.wire import WireError, oneshot
-from licensee_tpu.obs import Observability, merge_expositions
+from licensee_tpu.obs import (
+    Observability,
+    SLOEngine,
+    TraceCollector,
+    merge_expositions,
+    router_objectives,
+)
 from licensee_tpu.serve.eventloop import (
     EventLoop,
     LineConn,
@@ -491,6 +497,7 @@ class Router:
             tracing=tracing,
             trace_sample=trace_sample,
             trace_slow_ms=trace_slow_ms,
+            trace_proc="router",
         )
         # the mint-only fast path: with head sampling off the router
         # still needs a wire trace ID per request (pipelining
@@ -509,6 +516,26 @@ class Router:
             max_workers=4, thread_name_prefix="fleet-ops"
         )
         self._register_metrics()
+        # the fleet SLO engine (obs/slo.py): availability + p99 over
+        # the router counters, attached AFTER _register_metrics so the
+        # collector pass syncs counters before each evaluation
+        self.slo = SLOEngine(
+            self.obs.registry, router_objectives()
+        ).attach()
+        # the telemetry-plane collector (obs/collect.py): the router's
+        # own tail plus a {"op":"trace"} pull per worker, joined by
+        # trace ID into assembled trees.  Pulls are BLOCKING fan-outs —
+        # assembled_traces runs on the ops executor / caller threads,
+        # never on the event loop (same contract as prometheus()).
+        self.collector = TraceCollector(root_proc="router")
+        self.collector.add_source(
+            "router", lambda: self.obs.tracer.tail(200)
+        )
+        for name, backend in self.backends.items():
+            self.collector.add_source(
+                name,
+                lambda b=backend: self._pull_worker_tail(b),
+            )
 
     # -- metrics --
 
@@ -615,6 +642,7 @@ class Router:
             pass
         self.loop.stop()
         self._ops.shutdown(wait=False)
+        self.collector.close()
 
     def _shutdown_on_loop(self) -> None:
         self._closing = True
@@ -1236,6 +1264,10 @@ class Router:
             },
             "backends": backends,
             "tracing": self.obs.tracer.stats(),
+            # the fleet SLO verdict (multi-window burn over the router
+            # counters) + the trace collector's accounting
+            "slo": self.slo.snapshot(),
+            "collector": self.collector.stats(),
         }
 
     def prometheus(self) -> str:
@@ -1264,6 +1296,30 @@ class Router:
 
     def trace_tail(self, n: int = 20) -> list[dict]:
         return self.obs.tracer.tail(n)
+
+    def _pull_worker_tail(self, backend: Backend) -> list[dict]:
+        """One worker's retained-trace tail for the collector; a dead
+        or restarting worker contributes nothing this pull."""
+        try:
+            row = oneshot(
+                backend.socket_path, {"op": "trace", "n": 200},
+                self.probe_timeout_s,
+            )
+        except WireError:
+            return []
+        tail = row.get("traces")
+        return tail if isinstance(tail, list) else []
+
+    def assembled_traces(
+        self, n: int = 20, *, trace_id: str | None = None
+    ) -> list[dict]:
+        """The cross-process telemetry view: pull every tail, join by
+        trace ID, return assembled trees (slowest first) with
+        critical-path self-times (the ``{"op": "traces"}`` front verb
+        and the ``licensee-tpu traces`` CLI).  Blocking fan-out — ops
+        executor or a caller thread, never the event loop."""
+        self.collector.pull()
+        return self.collector.assembled(n, trace_id=trace_id)
 
     def reload_fleet(self, corpus: str) -> dict:
         """The front-door rolling corpus reload: delegates to the
@@ -1417,6 +1473,24 @@ class _FrontSession:
                 })
             else:
                 self._push("trace", (rid, n))
+        elif op == "traces":
+            # the telemetry plane: assembled cross-process trace trees
+            # (router tail + every worker tail joined by trace ID)
+            n = msg.get("n", 20)
+            tid = msg.get("trace_id")
+            if isinstance(n, bool) or not isinstance(n, int) or n < 0:
+                self._push("raw", row={
+                    "id": rid,
+                    "error": "bad_request: n must be a non-negative int",
+                })
+            elif tid is not None and not isinstance(tid, str):
+                self._push("raw", row={
+                    "id": rid,
+                    "error": "bad_request: trace_id must be a hex "
+                    "string prefix",
+                })
+            else:
+                self._push("traces", (rid, n, tid))
         elif op == "reload":
             corpus = msg.get("corpus")
             if not isinstance(corpus, str) or not corpus:
@@ -1456,6 +1530,16 @@ class _FrontSession:
             slot["row"] = {
                 "id": rid, "traces": self.router.trace_tail(n)
             }
+        elif kind == "traces":
+            # the assembled-tree verb pulls every worker tail — a
+            # blocking fan-out, ops executor only (like the scrape)
+            rid, n, tid = slot["payload"]
+            self._defer(slot, lambda: {
+                "id": rid,
+                "traces": self.router.assembled_traces(
+                    n, trace_id=tid
+                ),
+            })
         elif kind == "prometheus":
             rid = slot["payload"]
             self._defer(slot, lambda: {

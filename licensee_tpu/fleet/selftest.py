@@ -316,6 +316,17 @@ def selftest(
             exposition
         ):
             problems.append("merged exposition missing router series")
+        # -- flight-recorder harvest: the SIGKILL's black box rode the
+        #    restart log (exit signal + dump path + last events) --
+        _check_flight_harvest(supervisor, problems)
+        # -- availability SLO intact across the whole drill: the
+        #    SIGKILL cost retries, never error budget --
+        _check_slo(router, problems)
+        # -- cross-process trace assembly: the failed-over request's
+        #    tree joins the router's failover spans with the surviving
+        #    worker's serving spans under ONE trace ID, critical-path
+        #    self-times within 5% of the recorded e2e --
+        _check_assembled_traces(router, front_path, problems)
         # -- graceful drain completes in-flight and stops the worker --
         drained_clean = supervisor.drain(
             "w1", timeout_s=30.0, restart=False
@@ -351,6 +362,149 @@ def selftest(
 
 class _Abort(Exception):
     """Internal early-exit: boot failed, nothing further to assert."""
+
+
+def _check_flight_harvest(
+    supervisor: Supervisor, problems: list[str]
+) -> None:
+    """The flight-recorder drill gate: the supervisor's restart-log
+    entry for the SIGKILLed worker must carry the kill signal, the
+    black-box dump path, and a NON-EMPTY harvested event tail — a
+    SIGKILL post-mortem starts from recorded evidence (obs/flight.py,
+    supervisor._harvest_flight)."""
+    from licensee_tpu.obs.flight import flight_path_for_socket
+
+    handle = supervisor.workers["w0"]
+    log = handle.restart_log
+    if not log:
+        problems.append(
+            "no restart-log entry for the SIGKILLed worker"
+        )
+        return
+    entry = log[0]
+    if entry.get("reason") != "crash" or entry.get("signal") != 9:
+        problems.append(
+            f"restart log missed the kill (want crash/signal 9): "
+            f"{ {k: entry.get(k) for k in ('reason', 'exit_code', 'signal')} }"
+        )
+    want_dump = flight_path_for_socket(handle.socket_path)
+    if entry.get("flight_dump") != want_dump:
+        problems.append(
+            f"restart log names the wrong black-box path: "
+            f"{entry.get('flight_dump')!r} != {want_dump!r}"
+        )
+    if not entry.get("flight_harvested") or not entry.get(
+        "flight_events"
+    ):
+        problems.append(
+            "supervisor failed to harvest a non-empty flight dump: "
+            f"harvested={entry.get('flight_harvested')} "
+            f"events={len(entry.get('flight_events') or [])}"
+        )
+
+
+def _check_slo(router: Router, problems: list[str]) -> None:
+    """The SLO gate: the availability objective must end the drill
+    with burn rate < 1.0 on every window — zero client-visible errors
+    means zero budget spent, SIGKILL included."""
+    slo = router.stats().get("slo") or {}
+    avail = (slo.get("objectives") or {}).get("availability") or {}
+    if not avail:
+        problems.append(f"router stats carries no availability SLO: {slo}")
+        return
+    if not (avail.get("good") or 0) > 0:
+        problems.append(f"availability SLO saw no traffic: {avail}")
+    max_burn = avail.get("max_burn")
+    if max_burn is None or not (max_burn < 1.0):
+        problems.append(
+            f"availability SLO burned through the drill: "
+            f"max_burn={max_burn} windows={avail.get('windows')}"
+        )
+
+
+def _check_assembled_traces(
+    router: Router, front_path: str, problems: list[str]
+) -> None:
+    """The telemetry-plane gate, both layers: (1) the collector joins
+    the failed-over request's router spans with the surviving worker's
+    serving spans under one trace ID, with critical-path self-times
+    summing to within 5% of the recorded end-to-end latency; (2) the
+    ``licensee-tpu traces --slowest 1`` CLI prints one assembled tree
+    against the live front socket."""
+    import contextlib
+    import io
+
+    trees = router.assembled_traces(200)
+    if not trees:
+        problems.append("collector assembled no traces after the drill")
+        return
+    joined = None
+    worker_procs = set(router.backends)
+    for tree in trees:
+        root = tree.get("root") or {}
+        names = {
+            c.get("name") for c in root.get("children") or []
+        }
+        if "failover" not in names:
+            continue
+        if set(tree.get("procs") or []) & worker_procs:
+            joined = tree
+            break
+    if joined is None:
+        problems.append(
+            "no assembled tree joins a router failover with a "
+            f"surviving worker's spans ({len(trees)} trees, procs "
+            f"{sorted({p for t in trees for p in t.get('procs') or []})})"
+        )
+    else:
+        e2e = joined.get("e2e_ms") or 0.0
+        crit = joined.get("critical_ms") or 0.0
+        if e2e <= 0.0 or abs(crit - e2e) > 0.05 * e2e:
+            problems.append(
+                f"critical-path self-times {crit}ms not within 5% of "
+                f"the recorded e2e {e2e}ms (trace {joined.get('trace')})"
+            )
+    # every tree must account its time, failover or not
+    bad_sums = [
+        t["trace"] for t in trees
+        if (t.get("e2e_ms") or 0.0) > 0.0
+        and abs(t["critical_ms"] - t["e2e_ms"]) > 0.05 * t["e2e_ms"]
+    ]
+    if bad_sums:
+        problems.append(
+            f"{len(bad_sums)} assembled trees double- or under-count "
+            f"critical-path time, e.g. {bad_sums[:3]}"
+        )
+    # the one-command view against the live fleet: --slowest 1 prints
+    # one assembled tree; pinned by --id to the joined drill trace so
+    # the gate is deterministic under concurrent burst traffic
+    from licensee_tpu.cli.main import main as cli_main
+
+    for extra in (
+        [],
+        ["--id", joined["trace"]] if joined is not None else None,
+    ):
+        if extra is None:
+            continue
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli_main([
+                "traces", "--socket", front_path, "--slowest", "1",
+                *extra,
+            ])
+        text = out.getvalue()
+        if rc != 0 or "critical path" not in text:
+            problems.append(
+                f"`licensee-tpu traces --slowest 1` against the live "
+                f"fleet failed (rc={rc}): {text[:300]!r}"
+            )
+        elif extra and ("failover" not in text or not any(
+            f"[{p}]" in text for p in worker_procs
+        )):
+            problems.append(
+                "the rendered drill tree misses the failover spans or "
+                f"the surviving worker's spans: {text[:400]!r}"
+            )
 
 
 class _ReloadTraffic:

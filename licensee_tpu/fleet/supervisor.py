@@ -44,6 +44,11 @@ import threading
 import time
 
 from licensee_tpu.fleet.wire import ConnectionPool, WireError, oneshot
+from licensee_tpu.obs.flight import (
+    HARVEST_TAIL,
+    flight_path_for_socket,
+    load_flight_dump,
+)
 from licensee_tpu.parallel.distributed import (
     apply_visible_chips,
     chips_for_worker,
@@ -155,6 +160,11 @@ class WorkerHandle:
         self.next_spawn_at: float = 0.0
         self.last_stats: dict = {}
         self.exit_codes: list[int] = []  # recent exits, newest last
+        # one entry per scheduled restart: how the worker died (exit
+        # code / signal), the backoff armed, and the harvested flight-
+        # recorder black box (dump path + last events) — the post-
+        # mortem record `fleet --selftest` gates on
+        self.restart_log: list[dict] = []
 
     @property
     def pid(self) -> int | None:
@@ -173,6 +183,7 @@ class WorkerHandle:
             "in_flight": sched.get("in_flight"),
             "completed": sched.get("completed"),
             "exit_codes": self.exit_codes[-5:],
+            "restart_log": self.restart_log[-3:],
         }
 
 
@@ -318,7 +329,17 @@ class Supervisor:
     # across the call; the analyzer now PROVES that contract through
     # the call graph (caller-holds-the-lock), so no pragma is needed
     def _spawn(self, handle: WorkerHandle) -> None:
-        """Start (or restart) one worker process.  Lock held."""
+        """Start (or restart) one worker process.  Lock held.
+
+        The predecessor's flight-recorder box is cleared first: a
+        drained worker's clean-shutdown dump (or any leftover) must
+        never be harvested as THIS incarnation's crash evidence if it
+        dies before writing its own (crash-path harvests already
+        consumed their box in _schedule_restart)."""
+        try:
+            os.unlink(flight_path_for_socket(handle.socket_path))
+        except OSError:
+            pass
         handle.proc = subprocess.Popen(
             handle.argv,
             env=handle.env,
@@ -339,13 +360,68 @@ class Supervisor:
     # called only from poll_once with self._lock held; the restart
     # bookkeeping rides the caller's critical section (proven by the
     # analyzer's caller-holds-the-lock contract)
-    def _schedule_restart(self, handle: WorkerHandle) -> None:
-        """Record the death and arm the backoff timer.  Lock held."""
+    def _schedule_restart(
+        self,
+        handle: WorkerHandle,
+        reason: str = "crash",
+        returncode: int | None = None,
+    ) -> None:
+        """Record the death (exit code/signal + the harvested flight-
+        recorder black box) and arm the backoff timer.  Lock held."""
         delay = self.backoff.delay_s(handle.restarts)
         handle.restarts += 1
         handle.next_spawn_at = time.perf_counter() + delay
         handle.state = DOWN
         handle.proc = None
+        entry = {
+            "reason": reason,
+            "exit_code": (
+                returncode if returncode is None or returncode >= 0
+                else None
+            ),
+            # a negative Popen returncode IS the killing signal
+            "signal": (
+                -returncode
+                if returncode is not None and returncode < 0
+                else None
+            ),
+            "backoff_s": round(delay, 3),
+            "restarts": handle.restarts,
+        }
+        # harvest the black box NOW, before the respawned incarnation
+        # overwrites it: the dump on disk is at most one flush interval
+        # older than the death (obs/flight.py's spill contract)
+        entry.update(self._harvest_flight(handle))
+        handle.restart_log.append(entry)
+        del handle.restart_log[:-20]
+
+    @staticmethod
+    def _harvest_flight(handle: WorkerHandle) -> dict:
+        """Read a dead worker's flight-recorder dump; the last events
+        ride the restart-log entry so a SIGKILL post-mortem starts from
+        recorded evidence.  The dump is CONSUMED (unlinked) once
+        harvested: a crash-looping respawn that dies before its first
+        flush must read as "no box" — honest — never replay the
+        previous incarnation's events as fresh evidence."""
+        path = flight_path_for_socket(handle.socket_path)
+        box = load_flight_dump(path)
+        if box is None:
+            return {
+                "flight_dump": path, "flight_harvested": False,
+                "flight_events": [],
+            }
+        try:
+            os.unlink(path)
+        except OSError:
+            pass  # harvested either way; the entry holds the evidence
+        events = box.get("events") or []
+        return {
+            "flight_dump": path,
+            "flight_harvested": True,
+            "flight_proc": box.get("proc"),
+            "flight_recorded": box.get("recorded"),
+            "flight_events": events[-HARVEST_TAIL:],
+        }
 
     def backoff_delay_s(self, restarts: int) -> float:
         """The delay before restart number ``restarts + 1`` — exposed
@@ -371,7 +447,9 @@ class Supervisor:
                 proc = handle.proc
                 if proc is not None and proc.poll() is not None:
                     handle.exit_codes.append(proc.returncode)
-                    self._schedule_restart(handle)
+                    self._schedule_restart(
+                        handle, "crash", proc.returncode
+                    )
                     continue
                 if proc is None:
                     if now >= handle.next_spawn_at:
@@ -413,9 +491,11 @@ class Supervisor:
                             proc.wait(timeout=5.0)
                         except (OSError, subprocess.TimeoutExpired):
                             pass
+                    returncode = None
                     if proc is not None and proc.poll() is not None:
-                        handle.exit_codes.append(proc.returncode)
-                    self._schedule_restart(handle)
+                        returncode = proc.returncode
+                        handle.exit_codes.append(returncode)
+                    self._schedule_restart(handle, "wedge", returncode)
                 else:
                     handle.state = UNHEALTHY
 

@@ -26,7 +26,23 @@ from licensee_tpu.obs.registry import (
     MetricsRegistry,
     get_registry,
 )
+from licensee_tpu.obs.collect import (
+    TraceCollector,
+    assemble_rows,
+    assemble_trace,
+    render_tree,
+)
+from licensee_tpu.obs.flight import (
+    FlightRecorder,
+    flight_path_for_socket,
+    load_flight_dump,
+)
 from licensee_tpu.obs.pipeline import PipelineLanes
+from licensee_tpu.obs.slo import (
+    SLOEngine,
+    router_objectives,
+    serve_objectives,
+)
 from licensee_tpu.obs.tracing import (
     NullTracer,
     Trace,
@@ -39,6 +55,9 @@ __all__ = [
     "Trace", "Tracer", "NullTracer", "get_tracer",
     "render_prometheus", "check_exposition", "merge_expositions",
     "NativeProfileSource", "PipelineLanes",
+    "TraceCollector", "assemble_rows", "assemble_trace", "render_tree",
+    "FlightRecorder", "flight_path_for_socket", "load_flight_dump",
+    "SLOEngine", "serve_objectives", "router_objectives",
     "DEFAULT_LATENCY_BUCKETS", "Observability",
 ]
 
@@ -58,6 +77,7 @@ class Observability:
         trace_slow_ms: float = 250.0,
         trace_log: str | None = None,
         trace_capacity: int = 256,
+        trace_proc: str = "local",
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.tracer = (
@@ -66,6 +86,7 @@ class Observability:
                 slow_ms=trace_slow_ms,
                 capacity=trace_capacity,
                 log_path=trace_log,
+                proc=trace_proc,
             )
             if tracing
             else NullTracer()

@@ -53,7 +53,7 @@ class Trace:
 
     __slots__ = (
         "trace_id", "request_id", "t_start", "sampled", "spans",
-        "status", "dur_s",
+        "status", "dur_s", "kind",
     )
 
     def __init__(self, trace_id, request_id, t_start, sampled):
@@ -64,6 +64,11 @@ class Trace:
         self.spans: list[tuple] = []  # (name, offset_s, dur_s, note)
         self.status = "ok"
         self.dur_s = None
+        # "trace" = a full span-carrying trace; "slow" = a span-less
+        # slow exemplar handed in via note_slow (the mint-only path) —
+        # tagged so the fleet collector (obs/collect.py) joins tails
+        # without heuristics
+        self.kind = "trace"
 
     def add_span(
         self,
@@ -85,6 +90,7 @@ class Trace:
         row = {
             "trace": self.trace_id,
             "id": self.request_id,
+            "kind": self.kind,
             "status": self.status,
             "dur_ms": (
                 round(self.dur_s * 1000.0, 3)
@@ -115,6 +121,7 @@ class Tracer:
         capacity: int = 256,
         log_path: str | None = None,
         log_max_bytes: int = 4 << 20,
+        proc: str = "local",
     ):
         if not (0.0 <= sample_rate <= 1.0):
             raise ValueError(
@@ -130,6 +137,10 @@ class Tracer:
         self.slow_ms = float(slow_ms)
         self.log_path = log_path
         self.log_max_bytes = int(log_max_bytes)
+        # the tail tag that names this process's role in a fleet
+        # ("router" / worker name): the collector joins tails by trace
+        # ID and attributes rows by proc, no heuristics
+        self.proc = proc
         self._ring: deque[Trace] = deque(maxlen=max(1, int(capacity)))
         self._lock = threading.Lock()
         # the exemplar log gets its OWN lock: disk I/O (rotation +
@@ -189,6 +200,7 @@ class Tracer:
         trace = Trace(trace_id, request_id, t_start, False)
         trace.status = status
         trace.dur_s = dur_s
+        trace.kind = "slow"  # span-less exemplar, not a full trace
         with self._lock:
             self.retained += 1
             self.slow += 1
@@ -236,10 +248,14 @@ class Tracer:
                 pass  # a full disk must never take the serving path down
 
     def tail(self, n: int = 20) -> list[dict]:
-        """The most recent retained traces, oldest first."""
+        """The most recent retained traces, oldest first.  Every row
+        carries ``"kind"`` ("trace" = full spans, "slow" = span-less
+        note_slow exemplar) and ``"proc"`` (this process's fleet role)
+        so the cross-process collector joins without heuristics; the
+        pre-existing key set is unchanged otherwise."""
         with self._lock:
             traces = list(self._ring)[-max(0, int(n)):]
-        return [t.as_dict() for t in traces]
+        return [{**t.as_dict(), "proc": self.proc} for t in traces]
 
     def stats(self) -> dict:
         with self._lock:
@@ -262,6 +278,7 @@ class NullTracer:
     sample_rate = 0.0
     slow_ms = float("inf")
     log_path = None
+    proc = "local"
     mint_only = False  # no IDs at all: wire lines go out un-spliced
 
     def start(self, request_id=None, trace_id=None):

@@ -65,6 +65,8 @@ from licensee_tpu.obs import (
     NativeProfileSource,
     Observability,
     PipelineLanes,
+    SLOEngine,
+    serve_objectives,
 )
 from licensee_tpu.serve.cache import ResultCache
 from licensee_tpu.serve.featurize import (
@@ -170,6 +172,8 @@ class MicroBatcher:
         trace_sample: float = 0.01,
         trace_slow_ms: float = 250.0,
         trace_log: str | None = None,
+        trace_proc: str = "local",
+        flight=None,
         corpus_source: str | None = None,
     ):
         if max_batch < 1:
@@ -234,7 +238,12 @@ class MicroBatcher:
             trace_sample=trace_sample,
             trace_slow_ms=trace_slow_ms,
             trace_log=trace_log,
+            trace_proc=trace_proc,
         )
+        # the worker flight recorder (obs/flight.py): event hooks below
+        # append to its lock-free ring; None keeps every hook a single
+        # attribute read + is-None branch
+        self.flight = flight
         stage_hist = self.obs.registry.histogram(
             "serve_stage_seconds",
             "Serve-path per-stage latency (one fixed-bound histogram "
@@ -302,6 +311,12 @@ class MicroBatcher:
         self._lanes = PipelineLanes().register(self.obs.registry)
         self._warm_start = bool(warm_start)
         self._register_metrics()
+        # the SLO engine rides the registry's collector pass; attached
+        # AFTER _register_metrics so every evaluation sees counters the
+        # scheduler collector just synced (obs/slo.py)
+        self.slo = SLOEngine(
+            self.obs.registry, serve_objectives()
+        ).attach()
         if self._warm_start:
             # cold-start fix: compile every bucket shape NOW, not on
             # the first live request that happens to flush at it (the
@@ -627,6 +642,10 @@ class MicroBatcher:
                 self._seen_fps.add(new_fp)
                 self._corpus_source = source
                 self._counters["reloads"] += 1
+            if self.flight is not None:
+                self.flight.record(
+                    "reload_swap", fingerprint=new_fp, previous=old_fp,
+                )
             return {
                 "ok": True,
                 "fingerprint": new_fp,
@@ -692,6 +711,12 @@ class MicroBatcher:
             req.deadline = t0 + ms / 1000.0
         with self._lock:
             self._counters["submitted"] += 1
+        flight = self.flight
+        if flight is not None:
+            flight.record(
+                "admission", id=request_id, route=route,
+                trace=req.trace_id,
+            )
         if route is None:
             # auto mode, a filename no score table claims: answered
             # without reading a byte, same as the offline path
@@ -763,6 +788,10 @@ class MicroBatcher:
                 if len(self._queue) >= self.queue_depth:
                     self._counters["rejected"] += 1
                     self.obs.tracer.finish(trace, "queue_full")
+                    if self.flight is not None:
+                        self.flight.record(
+                            "error", what="queue_full", id=request_id
+                        )
                     raise QueueFullError(
                         self._estimate_retry_after(), req.trace_id
                     )
@@ -893,6 +922,11 @@ class MicroBatcher:
                 pends.append(self._submit_group(grp, t0))
             with self._lock:
                 self._flush_reasons[reason] += 1
+            if self.flight is not None:
+                self.flight.record(
+                    "flush", reason=reason, rows=len(live),
+                    groups=len(pends),
+                )
         # rows every member of which already expired: answered now,
         # without ever occupying a device slot
         live_ids = {id(r) for r in live}
@@ -936,6 +970,11 @@ class MicroBatcher:
         except Exception as exc:  # noqa: BLE001 — device failure containment
             err = exc
             future = None
+        if self.flight is not None:
+            self.flight.record(
+                "device_dispatch", rows=n, bucket=bucket,
+                error=str(err)[:200] if err is not None else None,
+            )
         return {
             "live": live,
             "merged": merged,
@@ -1011,6 +1050,15 @@ class MicroBatcher:
             with self._lock:
                 self._counters["fallbacks"] += len(live)
         dt_device = pend["submit_s"] + (time.perf_counter() - t_begin)
+        if self.flight is not None:
+            self.flight.record(
+                "device_await", rows=n, bucket=bucket,
+                dur_ms=round(dt_device * 1000.0, 3),
+                error=(
+                    str(device_err)[:200]
+                    if device_err is not None else None
+                ),
+            )
         self.stats_stages.record("device", dt_device)
         with self._lock:
             self._batch_ewma = (
@@ -1204,6 +1252,13 @@ class MicroBatcher:
             # writer lane = response finishing) + in-flight chunks
             "pipeline": self._lanes.occupancy(),
             "tracing": self.obs.tracer.stats(),
+            # the SLO verdict (multi-window burn rates over the counters
+            # above) and the flight recorder's ring accounting — the
+            # telemetry-plane stats surface (obs/slo.py, obs/flight.py)
+            "slo": self.slo.snapshot(),
+            "flight": (
+                self.flight.stats() if self.flight is not None else None
+            ),
             "config": {
                 "mode": self.mode,
                 "max_batch": self.max_batch,

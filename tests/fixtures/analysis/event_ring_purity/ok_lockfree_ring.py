@@ -1,0 +1,23 @@
+"""Clean: a lock-free bounded ring whose append is one slot store;
+the dump (file I/O) lives OUTSIDE the hot path."""
+
+import time
+
+
+class RingFlightRecorder:
+    def __init__(self, capacity=64):
+        self._slots = [None] * capacity
+        self._capacity = capacity
+        self._seq = 0
+
+    def record(self, kind, **fields):
+        seq = self._seq
+        self._slots[seq % self._capacity] = (
+            seq, time.perf_counter(), kind, fields
+        )
+        self._seq = seq + 1
+
+    def dump(self, path):
+        events = [e for e in list(self._slots) if e is not None]
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(repr(sorted(events)))

@@ -1,0 +1,19 @@
+"""Clean: the hot path may format (str.join, dict reads, sorted) —
+the rule flags I/O and locks, never pure CPU work."""
+
+
+class FormattingRecorder:
+    def __init__(self):
+        self._ring = [None] * 8
+        self._seq = 0
+
+    def record(self, kind, **fields):
+        label = self._label(kind, fields)
+        self._ring[self._seq % 8] = (self._seq, label)
+        self._seq = self._seq + 1
+
+    def _label(self, kind, fields):
+        parts = [kind]
+        for key in sorted(fields):
+            parts.append(f"{key}={fields[key]}")
+        return " ".join(parts)

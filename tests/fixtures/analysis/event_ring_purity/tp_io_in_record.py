@@ -1,0 +1,17 @@
+"""Seeded TP: the hot append path does file I/O per event — every
+request now pays a syscall (and a full disk blocks serving)."""
+
+import os
+import time
+
+
+class BadFlightRecorder:
+    def __init__(self, path):
+        self.path = path
+        self._events = []
+
+    def record(self, kind, **fields):
+        self._events.append((time.perf_counter(), kind, fields))
+        with open(self.path, "a", encoding="utf-8") as f:  # BAD
+            f.write(repr(fields) + "\n")  # BAD
+        os.replace(self.path, self.path)  # BAD

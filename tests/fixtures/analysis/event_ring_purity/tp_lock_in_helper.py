@@ -1,0 +1,22 @@
+"""Seeded TP: the append takes a lock, and a helper reached from the
+hot path sleeps — a stalled flusher holding the lock (or the sleep)
+would block every event append."""
+
+import threading
+import time
+
+
+class LockedEventRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = [None] * 16
+        self._seq = 0
+
+    def record(self, kind, **fields):
+        with self._lock:  # BAD
+            self._ring[self._seq % 16] = (kind, fields)
+            self._seq += 1
+        self._settle()
+
+    def _settle(self):
+        time.sleep(0.001)  # BAD

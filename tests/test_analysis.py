@@ -53,6 +53,7 @@ DIR_TO_RULE = {
     "lock_discipline": "lock-discipline",
     "blocking_call": "blocking-call",
     "blocking_device_call": "blocking-device-call",
+    "event_ring_purity": "event-ring-purity",
     "resource_leak": "resource-leak",
     "tracer_purity": "tracer-purity",
     "wallclock_time": "wallclock-time",

@@ -1,0 +1,638 @@
+"""The fleet telemetry plane (PR 12): cross-process trace assembly
+(obs/collect.py), the worker flight recorder (obs/flight.py), the SLO
+burn-rate engine (obs/slo.py), the supervisor's black-box harvest, and
+the traces/slo CLI surfaces.
+
+The assembly tests pin the edge cases the collector must survive
+deterministically: orphan worker spans (router restarted mid-request),
+duplicate span arrival from a hedged twin, and tail truncation (a ring
+that wrapped between pulls) — and in every case the critical-path
+self-times must account the recorded end-to-end latency without double
+counting."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+import pytest
+
+from licensee_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    SLOEngine,
+    TraceCollector,
+    Tracer,
+    assemble_rows,
+    assemble_trace,
+    flight_path_for_socket,
+    load_flight_dump,
+    render_tree,
+    serve_objectives,
+)
+from licensee_tpu.obs.slo import (
+    AvailabilityObjective,
+    LatencyObjective,
+)
+
+TID = "ab" * 8
+
+
+def _router_row(trace=TID, dur_ms=40.0, spans=None, status="ok"):
+    return {
+        "trace": trace, "id": 1, "kind": "trace", "proc": "router",
+        "status": status, "dur_ms": dur_ms,
+        "spans": spans if spans is not None else [
+            {"name": "route", "t_ms": 0.0, "dur_ms": 0.0, "note": "to=w0"},
+            {"name": "failover", "t_ms": 9.8, "dur_ms": 0.0,
+             "note": "w0: connection lost"},
+            {"name": "route", "t_ms": 10.0, "dur_ms": 0.0, "note": "to=w1"},
+        ],
+    }
+
+
+def _worker_row(proc="w1", trace=TID, dur_ms=12.0, spans=None,
+                status="ok"):
+    return {
+        "trace": trace, "id": 1, "kind": "trace", "proc": proc,
+        "status": status, "dur_ms": dur_ms,
+        "spans": spans if spans is not None else [
+            {"name": "queue_wait", "t_ms": 0.0, "dur_ms": 2.0},
+            {"name": "featurize", "t_ms": 2.0, "dur_ms": 1.0},
+            {"name": "device", "t_ms": 3.0, "dur_ms": 8.0},
+        ],
+    }
+
+
+def _critical_ok(tree, tol=0.05):
+    e2e = tree["e2e_ms"]
+    return e2e > 0 and abs(tree["critical_ms"] - e2e) <= tol * e2e
+
+
+# -- trace assembly ------------------------------------------------------
+
+
+def test_failover_tree_joins_router_and_surviving_worker():
+    tree = assemble_trace([_router_row(), _worker_row()])
+    assert tree["procs"] == ["router", "w1"]
+    assert not tree["orphan"]
+    assert tree["e2e_ms"] == 40.0
+    root_span_names = [c["name"] for c in tree["root"]["children"]]
+    assert "failover" in root_span_names
+    assert _critical_ok(tree)
+    # the worker's stages carry their own self-time, the router the rest
+    path = {(c["proc"], c["name"]): c["self_ms"]
+            for c in tree["critical_path"]}
+    assert path[("w1", "queue_wait")] == 2.0
+    assert path[("w1", "device")] == 8.0
+    assert path[("router", "request")] == pytest.approx(28.0)
+
+
+def test_orphan_worker_rows_root_their_own_tree():
+    """Router restarted mid-request: the worker row must still
+    assemble — flagged orphan, critical path over its own stages."""
+    tree = assemble_trace([_worker_row(proc="w0")])
+    assert tree["orphan"] is True
+    assert tree["procs"] == ["w0"]
+    assert tree["e2e_ms"] == 12.0
+    assert _critical_ok(tree)
+    names = {c["name"] for c in tree["critical_path"]}
+    assert {"queue_wait", "featurize", "device"} <= names
+
+
+def test_hedged_twin_duplicate_never_double_counts():
+    """A hedge sends the same request to two workers; the loser's row
+    arrives too (and the winner's row arrives TWICE across pulls).
+    Exactly one attempt may contribute critical-path time."""
+    winner = _worker_row(proc="w1", dur_ms=12.0)
+    loser = _worker_row(proc="w2", dur_ms=11.0, status="late")
+    rows = [_router_row(), winner, loser, dict(winner)]
+    tree = assemble_trace(rows)
+    assert tree["attempts"] == 2
+    assert tree["duplicates_dropped"] == 1
+    assert _critical_ok(tree)
+    procs_on_path = {c["proc"] for c in tree["critical_path"]}
+    assert procs_on_path == {"router", "w1"}, (
+        "the losing twin leaked onto the critical path"
+    )
+
+
+def test_tail_truncation_keeps_assembly_deterministic():
+    """Ring wrapped between pulls: the worker tail lost its early
+    spans.  Assembly must stay deterministic under any arrival order
+    and still account e2e time without double counting."""
+    truncated = _worker_row(spans=[
+        {"name": "device", "t_ms": 3.0, "dur_ms": 8.0},
+    ])
+    rows = [_router_row(), truncated]
+    base = assemble_trace(rows)
+    assert _critical_ok(base)
+    for seed in range(8):
+        shuffled = list(rows) + [dict(truncated)]
+        random.Random(seed).shuffle(shuffled)
+        again = assemble_trace(shuffled)
+        assert json.dumps(again, sort_keys=True) == json.dumps(
+            base if again["duplicates_dropped"] == 0 else {
+                **base, "duplicates_dropped": 1,
+            },
+            sort_keys=True,
+        )
+
+
+def test_attempt_claiming_more_than_e2e_is_clamped():
+    """Clock skew / truncation can make a worker claim more time than
+    the router recorded end to end — the path must clamp, not mint."""
+    tree = assemble_trace([
+        _router_row(dur_ms=10.0),
+        _worker_row(dur_ms=25.0, spans=[
+            {"name": "queue_wait", "t_ms": 0.0, "dur_ms": 20.0},
+            {"name": "device", "t_ms": 20.0, "dur_ms": 5.0},
+        ]),
+    ])
+    assert tree["e2e_ms"] == 10.0
+    assert tree["critical_ms"] == pytest.approx(10.0)
+
+
+def test_slow_exemplar_rows_join_without_spans():
+    """A mint-only router retains span-less `kind: "slow"` exemplars;
+    a full worker row under the same ID still assembles, and the full
+    row wins the root when the slow row is all the router has."""
+    slow = {"trace": TID, "id": 1, "kind": "slow", "proc": "router",
+            "status": "ok", "dur_ms": 300.0, "spans": []}
+    tree = assemble_trace([slow, _worker_row()])
+    assert tree["e2e_ms"] == 300.0
+    assert not tree["orphan"]
+    assert _critical_ok(tree)
+
+
+def test_assemble_rows_sorts_slowest_first():
+    rows = [
+        _router_row(trace="11" * 8, dur_ms=5.0),
+        _router_row(trace="22" * 8, dur_ms=50.0),
+        _router_row(trace="33" * 8, dur_ms=20.0),
+    ]
+    trees = assemble_rows(rows)
+    assert [t["trace"] for t in trees] == ["22" * 8, "33" * 8, "11" * 8]
+
+
+def test_render_tree_carries_self_times_and_critical_path():
+    text = render_tree(assemble_trace([_router_row(), _worker_row()]))
+    assert "critical path" in text
+    assert "[w1] device" in text
+    assert "failover" in text
+
+
+# -- the collector -------------------------------------------------------
+
+
+def test_collector_tags_untagged_rows_with_source_and_dedupes():
+    stub_tail = [{"trace": TID, "id": 1, "status": "ok",
+                  "spans": [{"name": "stub_serve", "t_ms": 0.0,
+                             "dur_ms": 4.0}]}]
+    col = TraceCollector({
+        "router": lambda: [_router_row()],
+        "w1": lambda: list(stub_tail),
+    })
+    assert col.pull() == 2
+    assert col.pull() == 0  # idempotent re-pull
+    (tree,) = col.assembled(10)
+    assert tree["procs"] == ["router", "w1"]
+    assert _critical_ok(tree)
+
+
+def test_collector_survives_a_dead_source_and_evicts_lru():
+    def dead():
+        raise OSError("worker gone")
+
+    col = TraceCollector({"router": dead}, capacity=2)
+    for i in range(4):
+        tid = f"{i:02d}" * 8
+        col.add_source(f"s{i}", lambda t=tid: [_router_row(trace=t)])
+    col.pull()
+    assert col.stats()["traces"] == 2  # bounded, oldest evicted
+    assert len(col.assembled(10)) == 2
+
+
+def test_collector_union_survives_ring_wrap_between_pulls():
+    """First pull sees the worker row, the ring then wraps and the
+    second pull sees only the router row — the stored union still
+    assembles the joined tree."""
+    tails = [[_worker_row()], [_router_row()]]
+    col = TraceCollector({"fleet": lambda: tails.pop(0)})
+    col.pull()
+    col.pull()
+    (tree,) = col.assembled(10)
+    assert tree["procs"] == ["router", "w1"]
+    assert not tree["orphan"]
+
+
+# -- tracer tail tagging -------------------------------------------------
+
+
+def test_tracer_tail_rows_carry_kind_and_proc():
+    tracer = Tracer(sample_rate=1.0, slow_ms=1000.0, proc="w7")
+    trace = tracer.start(request_id=1)
+    trace.add_span("featurize", 0.001)
+    tracer.finish(trace, "ok")
+    tracer.note_slow("ff" * 8, 2, time.perf_counter(), 5.0)
+    rows = tracer.tail(10)
+    assert [r["kind"] for r in rows] == ["trace", "slow"]
+    assert all(r["proc"] == "w7" for r in rows)
+    # the pre-existing key set is intact
+    assert {"trace", "id", "status", "dur_ms", "spans"} <= set(rows[0])
+
+
+# -- the SLO engine ------------------------------------------------------
+
+
+def _engine():
+    reg = MetricsRegistry()
+    events = reg.counter("serve_requests_total", labels=("event",))
+    hist = reg.histogram("serve_stage_seconds", labels=("stage",))
+    eng = SLOEngine(reg, serve_objectives()).attach()
+    return reg, events, hist, eng
+
+
+def test_slo_burn_zero_on_clean_traffic_and_gauges_exported():
+    reg, events, hist, eng = _engine()
+    events.labels(event="completed").inc(1000)
+    hist.labels(stage="total").observe(0.01)
+    out = eng.snapshot()
+    avail = out["objectives"]["availability"]
+    assert avail["max_burn"] == 0.0 and avail["ok"]
+    assert out["ok"] is True
+    snap = reg.snapshot()["slo_burn_rate"]["samples"]
+    assert {s["labels"]["window"] for s in snap} == {
+        "5m", "30m", "1h", "6h"
+    }
+
+
+def test_slo_burn_reflects_windowed_error_deltas():
+    reg, events, hist, eng = _engine()
+    t0 = 1000.0
+    events.labels(event="completed").inc(1000)
+    eng.evaluate(now=t0)
+    # the next "minute": 1000 more good, 10 bad.  The 5m window still
+    # reaches past the whole recorded history, so the delta runs from
+    # the CONSTRUCTION baseline (0, 0): (10/2010)/0.001 ≈ 4.98
+    events.labels(event="completed").inc(1000)
+    events.labels(event="rejected").inc(10)
+    out = eng.evaluate(now=t0 + 60.0)
+    avail = out["objectives"]["availability"]
+    assert avail["windows"]["5m"] == pytest.approx(4.98, abs=0.1)
+    assert avail["max_burn"] >= avail["windows"]["6h"]
+    # burn >= 1: the budget is being spent too fast, but one window
+    # alone never pages — both fast windows must agree
+    assert isinstance(avail["fast_burn_alert"], bool)
+
+
+def test_slo_fast_pair_pages_only_when_both_windows_burn():
+    reg, events, hist, eng = _engine()
+    t0 = 0.0
+    eng.evaluate(now=t0)
+    events.labels(event="completed").inc(10)
+    events.labels(event="rejected").inc(90)  # 90% errors
+    out = eng.evaluate(now=t0 + 10.0)
+    avail = out["objectives"]["availability"]
+    # all history is inside every window here -> both pairs agree
+    assert avail["fast_burn_alert"] is True
+    assert out["ok"] is False
+
+
+def test_latency_objective_reads_histogram_buckets():
+    reg = MetricsRegistry()
+    hist = reg.histogram("serve_stage_seconds", labels=("stage",))
+    obj = LatencyObjective(
+        "latency_p99", family="serve_stage_seconds",
+        labels={"stage": "total"}, threshold_s=0.25, target=0.5,
+    )
+    for _ in range(8):
+        hist.labels(stage="total").observe(0.01)  # good
+    for _ in range(2):
+        hist.labels(stage="total").observe(2.0)  # bad
+    good, bad = obj.totals(reg)
+    assert (good, bad) == (8.0, 2.0)
+
+
+def test_availability_objective_ignores_bookkeeping_events():
+    reg = MetricsRegistry()
+    events = reg.counter("serve_requests_total", labels=("event",))
+    obj = AvailabilityObjective(
+        "availability", family="serve_requests_total",
+        good_events=("completed",), bad_events=("rejected",),
+        target=0.999,
+    )
+    events.labels(event="completed").inc(5)
+    events.labels(event="cache_hits").inc(50)  # neither good nor bad
+    events.labels(event="rejected").inc(1)
+    assert obj.totals(reg) == (5.0, 1.0)
+
+
+def test_slo_first_scrape_sees_errors_since_boot():
+    """Errors accumulated BEFORE the first-ever scrape must burn: the
+    window differences against the construction baseline, never
+    vacuously against the first sample itself."""
+    reg, events, hist, eng = _engine()
+    events.labels(event="completed").inc(500)
+    events.labels(event="rejected").inc(500)  # 50% errors, never scraped
+    out = eng.evaluate()  # the FIRST evaluation ever
+    avail = out["objectives"]["availability"]
+    assert avail["max_burn"] > 14.4
+    assert out["ok"] is False
+
+
+def test_slo_window_excludes_history_older_than_the_window():
+    """Once the ring holds a sample older than the cutoff, the window
+    differences against it — old errors age out of the fast windows."""
+    reg, events, hist, eng = _engine()
+    t0 = 1000.0
+    events.labels(event="rejected").inc(100)  # ancient errors
+    eng.evaluate(now=t0)
+    events.labels(event="completed").inc(1000)
+    out = eng.evaluate(now=t0 + 400.0)  # 5m cutoff lands AFTER t0
+    avail = out["objectives"]["availability"]
+    assert avail["windows"]["5m"] == 0.0  # the old errors aged out
+    assert avail["windows"]["6h"] > 0.0  # but still burn the slow window
+
+
+def test_slo_ancient_errors_age_out_of_the_longest_window():
+    """Errors from hour 1 of a day-plus process must eventually leave
+    even the 6h window: pruning keeps one sample at or before the
+    horizon as the 6h base, so the delta stops reaching the ancient
+    burst (the gauge decays to 0 instead of paging forever)."""
+    reg, events, hist, eng = _engine()
+    events.labels(event="rejected").inc(100)
+    eng.evaluate(now=0.0)
+    events.labels(event="completed").inc(10_000)
+    eng.evaluate(now=3600.0)
+    out = eng.evaluate(now=30_000.0)  # ~8.3h: the burst is > 6h old
+    avail = out["objectives"]["availability"]
+    assert avail["windows"]["6h"] == 0.0, avail["windows"]
+
+
+def test_hedge_winner_is_the_fastest_ok_attempt():
+    """Both hedge twins record status ok (the loser never learns it
+    lost); the critical path must follow the FASTEST ok attempt — the
+    answer the client actually got — not the slow loser."""
+    fast = _worker_row(proc="w1", dur_ms=12.0)
+    slow_loser = _worker_row(proc="w2", dur_ms=30.0, spans=[
+        {"name": "queue_wait", "t_ms": 0.0, "dur_ms": 28.0},
+        {"name": "device", "t_ms": 28.0, "dur_ms": 2.0},
+    ])
+    tree = assemble_trace([
+        _router_row(dur_ms=13.0), fast, slow_loser,
+    ])
+    procs_on_path = {c["proc"] for c in tree["critical_path"]}
+    assert procs_on_path == {"router", "w1"}, tree["critical_path"]
+    assert _critical_ok(tree)
+
+
+def test_slo_sample_cap_decimates_instead_of_shrinking_horizon(
+    monkeypatch,
+):
+    """A fast scrape cadence overflowing the sample cap must coarsen
+    resolution, never shrink the covered horizon: the 6h base sample
+    survives, so ancient errors still age out of the longest window."""
+    import licensee_tpu.obs.slo as slo_mod
+
+    monkeypatch.setattr(slo_mod, "_MAX_SAMPLES", 8)
+    reg, events, hist, eng = _engine()
+    events.labels(event="rejected").inc(100)
+    eng.evaluate(now=0.0)
+    events.labels(event="completed").inc(10_000)
+    for i in range(1, 60):  # every 10 min for ~10h: cap overflows
+        eng.evaluate(now=i * 600.0)
+    out = eng.evaluate(now=36_000.0)  # the burst is > 6h old
+    avail = out["objectives"]["availability"]
+    assert avail["windows"]["6h"] == 0.0, avail["windows"]
+    assert len(eng._samples) <= 9  # decimated, not unbounded
+
+
+def test_slo_no_traffic_burns_nothing():
+    _reg, _events, _hist, eng = _engine()
+    out = eng.evaluate()
+    assert out["ok"] is True
+    assert out["objectives"]["availability"]["max_burn"] == 0.0
+
+
+# -- the flight recorder -------------------------------------------------
+
+
+def test_flight_ring_wraps_and_snapshot_orders_by_seq():
+    fr = FlightRecorder(capacity=4, proc="w0")
+    for i in range(11):
+        fr.record("admission", id=i)
+    events = fr.snapshot()
+    assert [e["id"] for e in events] == [7, 8, 9, 10]
+    assert [e["seq"] for e in events] == [7, 8, 9, 10]
+    assert fr.stats()["dropped"] == 7
+
+
+def test_flight_dump_roundtrip_and_stop_writes_final_box(tmp_path):
+    path = str(tmp_path / "w0.sock.flight")
+    fr = FlightRecorder(path, capacity=8, proc="w0",
+                        flush_interval_s=0.02)
+    fr.start()
+    fr.record("boot")
+    fr.record("admission", id=1, trace="aa" * 8)
+    deadline = time.perf_counter() + 5.0
+    while time.perf_counter() < deadline:
+        if load_flight_dump(path):
+            break
+        time.sleep(0.01)
+    box = load_flight_dump(path)
+    assert box and box["proc"] == "w0"
+    fr.record("shutdown")
+    fr.stop()
+    box = load_flight_dump(path)
+    assert [e["kind"] for e in box["events"]] == [
+        "boot", "admission", "shutdown",
+    ]
+    assert box["events"][1]["trace"] == "aa" * 8
+
+
+def test_flight_record_is_safe_under_concurrent_appenders():
+    fr = FlightRecorder(capacity=128, proc="w0")
+
+    def hammer(k):
+        for i in range(500):
+            fr.record("admission", worker=k, i=i)
+
+    threads = [threading.Thread(target=hammer, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = fr.snapshot()
+    assert 0 < len(events) <= 128
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+
+
+def test_flight_path_convention_matches_supervisor():
+    assert flight_path_for_socket("/run/w0.sock") == "/run/w0.sock.flight"
+
+
+def test_flight_missing_dump_reads_none(tmp_path):
+    assert load_flight_dump(str(tmp_path / "absent.flight")) is None
+    torn = tmp_path / "torn.flight"
+    torn.write_text("{not json", encoding="utf-8")
+    assert load_flight_dump(str(torn)) is None
+
+
+# -- supervisor harvest (real stub process, real SIGKILL) ---------------
+
+
+def test_supervisor_harvests_flight_dump_on_sigkill(tmp_path):
+    from licensee_tpu.fleet import faults
+    from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+    from licensee_tpu.fleet.wire import oneshot
+
+    sock = str(tmp_path / "w0.sock")
+
+    def argv(name, path):
+        return [sys.executable, "-m", "licensee_tpu.fleet.faults",
+                "--socket", path, "--name", name]
+
+    supervisor = Supervisor(
+        {"w0": sock}, argv_for=argv,
+        env_for=lambda n, c: worker_env(None, None),
+        probe_interval_s=0.1, backoff_base_s=0.1, backoff_max_s=1.0,
+        startup_grace_s=30.0,
+    )
+    try:
+        supervisor.start()
+        assert supervisor.wait_healthy(30.0)
+        for i in range(5):
+            oneshot(sock, {"id": i, "content": f"blob {i}",
+                           "trace": f"{i:016x}"}, 5.0)
+        # give the stub's 50 ms flusher a beat to spill the events
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            box = load_flight_dump(flight_path_for_socket(sock))
+            if box and any(
+                e["kind"] == "admission" for e in box["events"]
+            ):
+                break
+            time.sleep(0.02)
+        handle = supervisor.workers["w0"]
+        faults.kill(handle.pid)
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            if handle.restart_log:
+                break
+            time.sleep(0.05)
+        assert handle.restart_log, "supervisor never logged the crash"
+        entry = handle.restart_log[0]
+        assert entry["reason"] == "crash"
+        assert entry["signal"] == 9 and entry["exit_code"] is None
+        assert entry["backoff_s"] >= 0.1
+        assert entry["flight_dump"] == flight_path_for_socket(sock)
+        assert entry["flight_harvested"] is True
+        kinds = {e["kind"] for e in entry["flight_events"]}
+        assert "admission" in kinds
+        assert entry["flight_proc"] == "w0"
+        # the status surface carries the harvest for operators
+        assert supervisor.status()["w0"]["restart_log"][0][
+            "flight_harvested"
+        ] is True
+        # the dump was CONSUMED: a crash-looping respawn that dies
+        # before writing its own box must not replay this one (the
+        # fresh idle incarnation writes nothing until its first event)
+        assert not os.path.exists(flight_path_for_socket(sock))
+    finally:
+        supervisor.stop()
+
+
+# -- the traces / slo CLI -----------------------------------------------
+
+
+def test_traces_cli_renders_assembled_trees(monkeypatch, capsys):
+    import importlib
+
+    cli = importlib.import_module("licensee_tpu.cli.main")
+
+    def fake_scrape(_sock, payload, _timeout):
+        assert payload["op"] == "traces"
+        return {"id": None, "traces": [
+            assemble_trace([_router_row(), _worker_row()]),
+            assemble_trace([_router_row(trace="cd" * 8, dur_ms=5.0)]),
+        ]}
+
+    monkeypatch.setattr(cli, "_scrape_row", fake_scrape)
+    rc = cli.main(["traces", "--socket", "front.sock", "--slowest", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count("critical path") == 1  # --slowest 1: one tree
+    assert "failover" in out and "[w1] device" in out
+
+    rc = cli.main(["traces", "--socket", "front.sock", "--json"])
+    out = capsys.readouterr().out
+    trees = [json.loads(line) for line in out.splitlines()]
+    assert rc == 0 and len(trees) == 2
+    assert trees[0]["e2e_ms"] >= trees[1]["e2e_ms"]
+
+
+def test_traces_cli_reports_worker_socket_mistake(monkeypatch, capsys):
+    import importlib
+
+    cli = importlib.import_module("licensee_tpu.cli.main")
+
+    monkeypatch.setattr(
+        cli, "_scrape_row",
+        lambda *_a: {"id": None,
+                     "error": "bad_request: unknown op 'traces'"},
+    )
+    rc = cli.main(["traces", "--socket", "w0.sock"])
+    assert rc == 1
+    assert "front socket" in capsys.readouterr().err
+
+
+def test_slo_cli_verdict_and_exit_code(monkeypatch, capsys):
+    import importlib
+
+    cli = importlib.import_module("licensee_tpu.cli.main")
+
+    reg = MetricsRegistry()
+    events = reg.counter("serve_requests_total", labels=("event",))
+    reg.histogram("serve_stage_seconds", labels=("stage",))
+    eng = SLOEngine(reg, serve_objectives()).attach()
+    events.labels(event="completed").inc(100)
+    block = eng.snapshot()
+    monkeypatch.setattr(
+        cli, "_scrape_row",
+        lambda *_a: {"id": None, "stats": {"slo": block}},
+    )
+    rc = cli.main(["slo", "--socket", "w0.sock"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "availability" in out and "slo: ok" in out
+
+    events.labels(event="rejected").inc(1000)
+    burning = eng.evaluate()
+    monkeypatch.setattr(
+        cli, "_scrape_row",
+        lambda *_a: {"id": None, "stats": {"slo": burning}},
+    )
+    rc = cli.main(["slo", "--socket", "w0.sock", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert json.loads(out)["ok"] is False
+
+
+def test_slo_cli_without_slo_block_errors(monkeypatch, capsys):
+    import importlib
+
+    cli = importlib.import_module("licensee_tpu.cli.main")
+
+    monkeypatch.setattr(
+        cli, "_scrape_row", lambda *_a: {"id": None, "stats": {}},
+    )
+    rc = cli.main(["slo", "--socket", "w0.sock"])
+    assert rc == 1
+    assert "no slo block" in capsys.readouterr().err
