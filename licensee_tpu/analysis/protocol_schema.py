@@ -86,11 +86,37 @@ WATCHED_KEYS: frozenset[str] = frozenset(
     | set(RESPONSE_FIELDS)
 )
 
-# role detection, by path basename: the real worker transport and the
-# stub that must stay protocol-faithful to it.  Basenames (not full
-# paths) so fixture programs can cast their own players.
+# -- the HTTP edge surface (fleet/http_edge.py) ------------------------
+#
+# The network edge speaks HTTP/1.1 OUTSIDE and the JSONL protocol
+# above INSIDE (a /classify body IS a content row, so the worker/stub
+# parity checks cover the edge's inner face for free).  Its outer face
+# is protocol too: the routes it serves and the status codes it may
+# mint are declared here and diffed against the edge module's own
+# ROUTES/STATUS_TEXT tables plus every request-line constant a client
+# harness sends (rules_protocol.check_http_drift).
+
+# (method, path) -> wire-level meaning
+HTTP_ROUTES: dict[tuple[str, str], str] = {
+    ("POST", "/classify"): "content",
+    ("GET", "/healthz"): "health",
+    ("GET", "/metrics"): "prometheus",
+}
+
+# every status code the edge may mint.  The backpressure contract maps
+# here: queue_full -> 429 (+ Retry-After), router shutdown / a fleet
+# with no dispatchable backend -> 503.
+HTTP_STATUS_CODES: tuple[int, ...] = (
+    200, 400, 401, 404, 405, 413, 429, 500, 503,
+)
+
+# role detection, by path basename: the real worker transport, the
+# stub that must stay protocol-faithful to it, and the HTTP edge.
+# Basenames (not full paths) so fixture programs can cast their own
+# players.
 WORKER_BASENAMES: tuple[str, ...] = ("server.py",)
 STUB_BASENAMES: tuple[str, ...] = ("faults.py",)
+EDGE_BASENAMES: tuple[str, ...] = ("http_edge.py",)
 
 # modules that legitimately speak the wire protocol; facts found in
 # other modules are ignored (a random dict with an "op" key in a
@@ -98,5 +124,5 @@ STUB_BASENAMES: tuple[str, ...] = ("faults.py",)
 SURFACE_BASENAMES: tuple[str, ...] = (
     "router.py", "server.py", "faults.py", "wire.py", "supervisor.py",
     "selftest.py", "main.py", "bench.py", "batch.py", "scheduler.py",
-    "eventloop.py",
+    "eventloop.py", "http_edge.py",
 )

@@ -251,7 +251,9 @@ LOOP_TIMER_ENTRY_NAMES = {
 # the modules whose scopes may BE loop entries (basename match, so a
 # fixture program can cast its own router.py); blocking SITES are
 # flagged wherever the walk reaches, any module
-LOOP_MODULE_BASENAMES = ("router.py", "server.py", "eventloop.py")
+LOOP_MODULE_BASENAMES = (
+    "router.py", "server.py", "eventloop.py", "http_edge.py",
+)
 
 # fully-qualified calls that block the carrying thread
 BLOCKING_QUALIFIED = {

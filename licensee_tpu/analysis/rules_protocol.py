@@ -20,6 +20,13 @@ record READS.  The program rules then check, over the whole tree:
 * **protocol-stub-divergence** — the stub worker (fleet/faults.py)
   must handle exactly the op set the real worker (serve/server.py)
   handles: "protocol-faithful" is a checked property, not a docstring.
+* **protocol-http-drift** — the network edge's OUTER face: request
+  lines sent by any harness vs the edge's ROUTES table vs
+  protocol_schema.HTTP_ROUTES, the STATUS_TEXT table vs
+  HTTP_STATUS_CODES (both directions), and literal ``_respond`` status
+  mints vs the declared set.  (The edge's INNER face is a JSONL
+  content row, so the worker/stub parity checks above cover it
+  unchanged.)
 """
 
 from __future__ import annotations
@@ -107,11 +114,59 @@ def _classify_dict(keys: dict, line: int, facts: dict) -> None:
             facts["err_emit"].append([code, line])
 
 
+# a request line a client harness writes ("POST /classify HTTP/1.1"),
+# inside a string or bytes constant (f-string heads included)
+_HTTP_SEND_RE = re.compile(
+    r"\b(GET|POST|PUT|DELETE|HEAD|PATCH)\s+(/\S*)\s+HTTP/1\.[01]\b"
+)
+
+
+def _scan_http_sends(text: str, line: int, facts: dict) -> None:
+    for m in _HTTP_SEND_RE.finditer(text):
+        facts["http_sends"].append([m.group(1), m.group(2), line])
+
+
+def _classify_http_tables(node: ast.Dict, facts: dict) -> None:
+    """The edge's declared tables: a dict whose keys are all 2-tuples
+    of string constants is a ROUTES table; one whose keys are all int
+    constants with string values is a STATUS_TEXT table."""
+    if not node.keys or any(k is None for k in node.keys):
+        return
+    routes = []
+    for k in node.keys:
+        if not (
+            isinstance(k, ast.Tuple)
+            and len(k.elts) == 2
+            and all(_const_str(el) is not None for el in k.elts)
+        ):
+            routes = None
+            break
+        routes.append([_const_str(k.elts[0]), _const_str(k.elts[1])])
+    if routes:
+        for method, path in routes:
+            facts["http_handles"].append([method, path, node.lineno])
+        return
+    statuses = []
+    for k, v in zip(node.keys, node.values):
+        if not (
+            isinstance(k, ast.Constant)
+            and type(k.value) is int
+            and _const_str(v) is not None
+        ):
+            return
+        statuses.append(k.value)
+    if len(statuses) >= 2:
+        for code in statuses:
+            facts["http_status"].append([code, node.lineno])
+
+
 def extract_protocol_facts(tree) -> dict:
     """One module's wire-protocol evidence, serializable."""
     facts: dict = {
         "sends": [], "handles": [], "err_emit": [], "err_read": [],
         "emits": [], "reads": [], "req_fields": [],
+        "http_sends": [], "http_handles": [], "http_status": [],
+        "http_minted": [],
     }
     for node in ast.walk(tree):
         if isinstance(node, ast.Dict):
@@ -122,10 +177,22 @@ def extract_protocol_facts(tree) -> dict:
                     keys[ks] = v
             if keys:
                 _classify_dict(keys, node.lineno, facts)
+            else:
+                _classify_http_tables(node, facts)
         elif isinstance(node, ast.Constant):
+            # request-line heads live in str, bytes, and f-string
+            # constants (ast.walk reaches an f-string's Constant
+            # pieces on its own)
+            if isinstance(node.value, bytes):
+                _scan_http_sends(
+                    node.value.decode("utf-8", "replace"),
+                    node.lineno, facts,
+                )
             # raw JSON request lines ('{"op": "stats"}' written straight
             # onto a LineConn) carry protocol too
             s = node.value if isinstance(node.value, str) else None
+            if s:
+                _scan_http_sends(s, node.lineno, facts)
             if (
                 s
                 and s.lstrip().startswith("{")
@@ -160,6 +227,25 @@ def extract_protocol_facts(tree) -> dict:
             key = _get_key(node)
             if key in schema.WATCHED_KEYS:
                 facts["reads"].append([key, node.lineno])
+            # status mints: any *respond*(...) call whose positional
+            # args carry a literal HTTP status (the edge's one answer
+            # primitive — _EdgeSession._respond)
+            fn = node.func
+            fn_name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if "respond" in fn_name:
+                for arg in node.args:
+                    if (
+                        isinstance(arg, ast.Constant)
+                        and type(arg.value) is int
+                        and 100 <= arg.value <= 599
+                    ):
+                        facts["http_minted"].append(
+                            [arg.value, node.lineno]
+                        )
+                        break
         elif isinstance(node, ast.Subscript) and isinstance(
             node.ctx, ast.Load
         ):
@@ -467,5 +553,124 @@ def check_stub_divergence(program):
                 stub.rel, stub_ops[op], "protocol-stub-divergence",
                 f"this stub handles op {op!r} which the real worker "
                 "does not — stub-only protocol is untested fiction",
+            ))
+    return findings
+
+
+@program_rule(
+    "protocol-http-drift",
+    doc=(
+        "The HTTP edge surface drifted: a request line sent that no "
+        "edge route serves, an edge ROUTES/STATUS_TEXT entry absent "
+        "from protocol_schema.HTTP_ROUTES/HTTP_STATUS_CODES (or the "
+        "reverse — a declared route/status the edge no longer "
+        "carries), or a minted status code outside the declared set"
+    ),
+)
+def check_http_drift(program):
+    if not program.complete:
+        return []
+    surfaces = _surfaces(program)
+    edges = [
+        s for s in surfaces
+        if _basename(s.rel) in schema.EDGE_BASENAMES
+    ]
+    findings: list[Finding] = []
+
+    handled: dict[tuple[str, str], tuple] = {}
+    statuses: dict[int, tuple] = {}
+    minted: dict[int, tuple] = {}
+    for s in edges:
+        for method, path, line in s.protocol.get("http_handles", ()):
+            handled.setdefault((method, path), (s, line))
+        for code, line in s.protocol.get("http_status", ()):
+            statuses.setdefault(code, (s, line))
+        for code, line in s.protocol.get("http_minted", ()):
+            minted.setdefault(code, (s, line))
+
+    # client-side request lines, anywhere on the surface list
+    sent: dict[tuple[str, str], list] = {}
+    for s in surfaces:
+        for method, path, line in s.protocol.get("http_sends", ()):
+            sent.setdefault((method, path), []).append((s, line))
+
+    if not edges and not sent:
+        return []  # no HTTP surface in this program
+
+    def per_module_first(sites):
+        seen_mod: dict[str, tuple] = {}
+        for s, line in sites:
+            prev = seen_mod.get(s.rel)
+            if prev is None or line < prev[1]:
+                seen_mod[s.rel] = (s, line)
+        return [seen_mod[rel] for rel in sorted(seen_mod)]
+
+    for route, sites in sorted(sent.items()):
+        method, path = route
+        if route not in schema.HTTP_ROUTES:
+            for s, line in per_module_first(sites):
+                findings.append(Finding(
+                    s.rel, line, "protocol-http-drift",
+                    f"request line {method} {path} is sent here but "
+                    "not declared in protocol_schema.HTTP_ROUTES — "
+                    "edge drift is a two-place change",
+                ))
+        elif edges and route not in handled:
+            s, line = per_module_first(sites)[0]
+            findings.append(Finding(
+                s.rel, line, "protocol-http-drift",
+                f"request line {method} {path} is sent here but the "
+                "edge's ROUTES table does not serve it — the request "
+                "would answer 404 everywhere",
+            ))
+
+    for route, (s, line) in sorted(handled.items()):
+        if route not in schema.HTTP_ROUTES:
+            method, path = route
+            findings.append(Finding(
+                s.rel, line, "protocol-http-drift",
+                f"edge route {method} {path} is served here but not "
+                "declared in protocol_schema.HTTP_ROUTES",
+            ))
+    if handled:
+        anchor_s, anchor_line = next(iter(handled.values()))
+        for route in schema.HTTP_ROUTES:
+            if route not in handled:
+                method, path = route
+                findings.append(Finding(
+                    anchor_s.rel, anchor_line, "protocol-http-drift",
+                    f"protocol_schema.HTTP_ROUTES declares "
+                    f"{method} {path} but this edge's ROUTES table "
+                    "does not serve it",
+                ))
+
+    for code, (s, line) in sorted(statuses.items()):
+        if code not in schema.HTTP_STATUS_CODES:
+            findings.append(Finding(
+                s.rel, line, "protocol-http-drift",
+                f"status {code} is declared in the edge's STATUS "
+                "table but not in protocol_schema.HTTP_STATUS_CODES",
+            ))
+    if statuses:
+        anchor_s, anchor_line = next(iter(statuses.values()))
+        for code in schema.HTTP_STATUS_CODES:
+            if code not in statuses:
+                findings.append(Finding(
+                    anchor_s.rel, anchor_line, "protocol-http-drift",
+                    f"protocol_schema.HTTP_STATUS_CODES declares "
+                    f"{code} but the edge's STATUS table dropped it",
+                ))
+    # mint sites are the safety net UNDER the table equivalence: a
+    # literal ``_respond(..., code, ...)`` outside the declared set is
+    # drift even if someone also forgot to add it to STATUS_TEXT (the
+    # declared-but-dead direction is the table check above — codes
+    # minted through the routing verdict indirection still appear in
+    # the table, which runtime lookup enforces)
+    for code, (s, line) in sorted(minted.items()):
+        if code not in schema.HTTP_STATUS_CODES:
+            findings.append(Finding(
+                s.rel, line, "protocol-http-drift",
+                f"status {code} is minted here but not declared in "
+                "protocol_schema.HTTP_STATUS_CODES",
             ))
     return findings

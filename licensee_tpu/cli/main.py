@@ -1247,7 +1247,9 @@ def cmd_fleet(args) -> int:
     """The fleet tier: supervise N serve worker processes (restart on
     crash/wedge with backoff, drain on rolling restart) behind one
     health-checked, load-balanced, hedging front socket
-    (fleet/supervisor.py + fleet/router.py)."""
+    (fleet/supervisor.py + fleet/router.py) — optionally fronted by
+    the HTTP/1.1 keep-alive edge (--http) and federated across hosts
+    over TCP (--federate)."""
     if args.selftest:
         from licensee_tpu.fleet.selftest import selftest
 
@@ -1256,9 +1258,14 @@ def cmd_fleet(args) -> int:
         from licensee_tpu.fleet.selftest import selftest_reload
 
         return selftest_reload(stub=args.stub)
-    if not args.socket:
-        print("error: need --socket PATH (the client-facing front "
-              "socket) or --selftest", file=sys.stderr)
+    if args.selftest_tcp:
+        from licensee_tpu.fleet.selftest import selftest_tcp
+
+        return selftest_tcp(stub=args.stub)
+    if not args.socket and not args.http:
+        print("error: need --socket PATH|HOST:PORT (the client-facing "
+              "front door) and/or --http HOST:PORT, or --selftest",
+              file=sys.stderr)
         return 1
     hedge_ms = args.hedge_ms
     if hedge_ms not in (None, "off", "auto"):
@@ -1278,64 +1285,120 @@ def cmd_fleet(args) -> int:
     from licensee_tpu.fleet.router import FrontServer, Router
     from licensee_tpu.fleet.supervisor import Supervisor
 
-    socket_dir = args.socket_dir or tempfile.mkdtemp(
-        prefix="licensee-fleet-"
-    )
-    os.makedirs(socket_dir, exist_ok=True)
-    workers = {
-        f"w{i}": os.path.join(socket_dir, f"w{i}.sock")
-        for i in range(args.workers)
-    }
-    serve_args: list[str] = []
-    for flag, value in (
-        ("--mode", args.mode),
-        ("--corpus", args.corpus),
-        ("--method", args.method),
-        ("--max-batch", args.max_batch),
-        ("--max-delay-ms", args.max_delay_ms),
-        ("--queue-depth", args.queue_depth),
-        ("--cache-entries", args.cache_entries),
-        ("--cache-bytes", args.cache_bytes),
-        ("--trace-sample", args.trace_sample),
-    ):
-        if value is not None:
-            serve_args += [flag, str(value)]
-    supervisor = Supervisor(
-        workers,
-        chips_per_worker=args.chips_per_worker,
-        serve_args=tuple(serve_args),
-        backoff_base_s=args.restart_backoff_ms / 1000.0,
-        probe_interval_s=args.probe_interval_ms / 1000.0,
-    )
-    router = Router(
-        workers,
-        supervisor=supervisor,
-        hedge_ms=None if hedge_ms == "off" else hedge_ms,
-        probe_interval_s=args.probe_interval_ms / 1000.0,
-        pool_per_worker=args.pool_per_worker,
-    )
-    from licensee_tpu.serve.server import SocketInUseError
-
-    print(
-        f"fleet: {args.workers} workers under {socket_dir}, "
-        f"front socket {args.socket}",
-        file=sys.stderr,
-    )
-    supervisor.start()
-    if not supervisor.wait_healthy(args.boot_timeout):
+    supervisor = None
+    if args.federate:
+        # the cross-host FRONT tier: every backend is another fleet's
+        # front door (usually host:port); no local workers to spawn
+        hosts = {
+            f"host{i}": target.strip()
+            for i, target in enumerate(args.federate.split(","))
+            if target.strip()
+        }
+        if not hosts:
+            print("error: --federate needs at least one target",
+                  file=sys.stderr)
+            return 1
+        router = Router(
+            hosts,
+            hedge_ms=None if hedge_ms == "off" else hedge_ms,
+            probe_interval_s=args.probe_interval_ms / 1000.0,
+            pool_per_worker=args.pool_per_worker,
+            merge_label="host",
+        )
         print(
-            f"error: workers failed to boot: {supervisor.status()}",
+            f"fleet: federating {len(hosts)} host(s): "
+            f"{', '.join(hosts.values())}",
             file=sys.stderr,
         )
-        supervisor.stop()
-        return 1
+    else:
+        socket_dir = args.socket_dir or tempfile.mkdtemp(
+            prefix="licensee-fleet-"
+        )
+        os.makedirs(socket_dir, exist_ok=True)
+        workers = {
+            f"w{i}": os.path.join(socket_dir, f"w{i}.sock")
+            for i in range(args.workers)
+        }
+        serve_args: list[str] = []
+        for flag, value in (
+            ("--mode", args.mode),
+            ("--corpus", args.corpus),
+            ("--method", args.method),
+            ("--max-batch", args.max_batch),
+            ("--max-delay-ms", args.max_delay_ms),
+            ("--queue-depth", args.queue_depth),
+            ("--cache-entries", args.cache_entries),
+            ("--cache-bytes", args.cache_bytes),
+            ("--trace-sample", args.trace_sample),
+        ):
+            if value is not None:
+                serve_args += [flag, str(value)]
+        supervisor = Supervisor(
+            workers,
+            chips_per_worker=args.chips_per_worker,
+            serve_args=tuple(serve_args),
+            backoff_base_s=args.restart_backoff_ms / 1000.0,
+            probe_interval_s=args.probe_interval_ms / 1000.0,
+        )
+        router = Router(
+            workers,
+            supervisor=supervisor,
+            hedge_ms=None if hedge_ms == "off" else hedge_ms,
+            probe_interval_s=args.probe_interval_ms / 1000.0,
+            pool_per_worker=args.pool_per_worker,
+        )
+        print(
+            f"fleet: {args.workers} workers under {socket_dir}, "
+            f"front door {args.socket or args.http}",
+            file=sys.stderr,
+        )
+    from licensee_tpu.serve.server import SocketInUseError
+
+    if supervisor is not None:
+        supervisor.start()
+        if not supervisor.wait_healthy(args.boot_timeout):
+            print(
+                f"error: workers failed to boot: {supervisor.status()}",
+                file=sys.stderr,
+            )
+            supervisor.stop()
+            return 1
     router.start()
+    edge_tokens = None
+    if args.edge_token:
+        edge_tokens = {}
+        for spec in args.edge_token:
+            name, sep, tok = spec.partition("=")
+            if sep and name and tok:
+                edge_tokens[tok] = name
+            else:
+                edge_tokens[spec] = spec
+    server = edge = None
     try:
-        server = FrontServer(args.socket, router)
-    except SocketInUseError as exc:
+        if args.socket:
+            server = FrontServer(args.socket, router)
+        if args.http:
+            from licensee_tpu.fleet.http_edge import HttpEdgeServer
+
+            edge = HttpEdgeServer(
+                args.http, router,
+                tokens=edge_tokens,
+                rate_per_client=args.edge_rate,
+                burst=args.edge_burst,
+            )
+            print(
+                f"fleet: HTTP edge on {args.http}"
+                f"{' (port ' + str(edge.bound_port) + ')' if edge.bound_port else ''}",
+                file=sys.stderr,
+            )
+    except (SocketInUseError, OSError) as exc:
         print(f"error: {exc}", file=sys.stderr)
+        for srv in (server, edge):
+            if srv is not None:
+                srv.server_close()
         router.close()
-        supervisor.stop()
+        if supervisor is not None:
+            supervisor.stop()
         return 1
     # long-lived serving process: the boot-time heap (imports, corpus,
     # supervisor state) never becomes garbage, but untuned gen2 GC
@@ -1350,25 +1413,46 @@ def cmd_fleet(args) -> int:
     import signal as signallib
     import threading
 
+    primary = server if server is not None else edge
+    secondary = edge if server is not None else None
+
     def _term(*_):
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        for srv in (primary, secondary):
+            if srv is not None:
+                threading.Thread(target=srv.shutdown, daemon=True).start()
 
     try:
         signallib.signal(signallib.SIGTERM, _term)
     except ValueError:
         pass
+    secondary_thread = None
+    if secondary is not None:
+        # both doors share the router's ONE event loop; each facade
+        # just parks a waiter thread until shutdown
+        secondary_thread = threading.Thread(
+            target=secondary.serve_forever,
+            kwargs={"poll_interval": 0.2}, daemon=True,
+        )
+        secondary_thread.start()
     try:
-        server.serve_forever(poll_interval=0.2)
+        primary.serve_forever(poll_interval=0.2)
     except KeyboardInterrupt:
         pass
     finally:
-        server.server_close()
-        try:
-            os.unlink(args.socket)
-        except OSError:
-            pass
+        if secondary is not None:
+            secondary.shutdown()
+            secondary.server_close()
+        if secondary_thread is not None:
+            secondary_thread.join(timeout=5.0)
+        primary.server_close()
+        if args.socket and server is not None and server.kind == "unix":
+            try:
+                os.unlink(args.socket)
+            except OSError:
+                pass
         router.close()
-        supervisor.stop()
+        if supervisor is not None:
+            supervisor.stop()
         if args.stats:
             print(json.dumps(router.stats()), file=sys.stderr)
     return 0
@@ -1665,10 +1749,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     serve = sub.add_parser("serve", help=_COMMAND_HELP["serve"])
     serve.add_argument(
-        "--socket", default=None, metavar="PATH",
+        "--socket", default=None, metavar="PATH|HOST:PORT",
         help=(
-            "Serve on a Unix domain socket (one JSONL session per "
-            "connection, shared cache); default is one session on "
+            "Serve on a Unix domain socket — or, as host:port, on TCP "
+            "(the cross-host federation tier's worker transport; "
+            "TCP_NODELAY on every connection) — one JSONL session per "
+            "connection, shared cache; default is one session on "
             "stdin/stdout"
         ),
     )
@@ -1824,12 +1910,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     stats = sub.add_parser("stats", help=_COMMAND_HELP["stats"])
     stats.add_argument(
-        "--socket", action="append", default=None, metavar="PATH",
+        "--socket", action="append", default=None,
+        metavar="PATH|HOST:PORT",
         help=(
-            "A serve worker's Unix socket to scrape; repeat the flag "
-            "for a fleet — several sockets print ONE merged table "
-            "(json) or one worker-labeled merged exposition "
-            "(prometheus)"
+            "A serve worker's Unix socket — or host:port for a TCP "
+            "worker/front — to scrape; repeat the flag for a fleet — "
+            "several targets print ONE merged table (json) or one "
+            "worker-labeled merged exposition (prometheus)"
         ),
     )
     stats.add_argument(
@@ -1873,11 +1960,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     traces = sub.add_parser("traces", help=_COMMAND_HELP["traces"])
     traces.add_argument(
-        "--socket", required=True, metavar="PATH",
+        "--socket", required=True, metavar="PATH|HOST:PORT",
         help=(
-            "A fleet FRONT socket (licensee-tpu fleet --socket PATH): "
-            "the router's collector pulls every worker tail and "
-            "answers {'op': 'traces'} with assembled trees"
+            "A fleet FRONT door (licensee-tpu fleet --socket TARGET; "
+            "host:port for a TCP front): the router's collector pulls "
+            "every worker tail and answers {'op': 'traces'} with "
+            "assembled trees"
         ),
     )
     traces.add_argument(
@@ -1904,10 +1992,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     slo = sub.add_parser("slo", help=_COMMAND_HELP["slo"])
     slo.add_argument(
-        "--socket", required=True, metavar="PATH",
+        "--socket", required=True, metavar="PATH|HOST:PORT",
         help=(
             "A serve worker's socket (its own objectives) or a fleet "
-            "front socket (the router's fleet-level objectives)"
+            "front door — host:port for TCP — (the router's "
+            "fleet-level objectives)"
         ),
     )
     slo.add_argument(
@@ -1922,9 +2011,50 @@ def build_parser() -> argparse.ArgumentParser:
 
     fleet = sub.add_parser("fleet", help=_COMMAND_HELP["fleet"])
     fleet.add_argument(
-        "--socket", default=None, metavar="PATH",
+        "--socket", default=None, metavar="PATH|HOST:PORT",
         help="The client-facing front socket (JSONL, same protocol "
-             "as one worker — clients cannot tell the difference)",
+             "as one worker — clients cannot tell the difference).  "
+             "host:port binds the front door on TCP",
+    )
+    fleet.add_argument(
+        "--http", default=None, metavar="HOST:PORT",
+        help=(
+            "Also serve the HTTP/1.1 keep-alive edge on this TCP "
+            "address (POST /classify with a JSON content-row body, "
+            "GET /healthz, GET /metrics), on the router's own event "
+            "loop — queue_full maps to 429 + Retry-After, router "
+            "shutdown to 503"
+        ),
+    )
+    fleet.add_argument(
+        "--edge-token", action="append", default=None,
+        metavar="NAME=TOKEN",
+        help=(
+            "HTTP edge bearer token (repeatable): requests must carry "
+            "'Authorization: Bearer TOKEN' and are rate-limited and "
+            "fair-queued per NAME.  A bare TOKEN names itself.  "
+            "Default: auth off (clients keyed by peer address)"
+        ),
+    )
+    fleet.add_argument(
+        "--edge-rate", type=bounded(float, 0.001), default=1000.0,
+        metavar="RPS",
+        help="HTTP edge per-client token-bucket rate (default 1000/s)",
+    )
+    fleet.add_argument(
+        "--edge-burst", type=bounded(float, 1), default=None,
+        metavar="N",
+        help="HTTP edge per-client burst depth (default: the rate)",
+    )
+    fleet.add_argument(
+        "--federate", default=None, metavar="TARGET,TARGET,...",
+        help=(
+            "Run as the cross-host FRONT tier: no local workers — "
+            "each comma-separated target (host:port or socket path) "
+            "is another fleet's front door, dispatched least-loaded "
+            "with failover/hedging across hosts and scraped into a "
+            "host-labeled merged exposition"
+        ),
     )
     fleet.add_argument(
         "--workers", type=bounded(int, 1), default=2, metavar="N",
@@ -2044,11 +2174,23 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     fleet.add_argument(
+        "--selftest-tcp", action="store_true",
+        help=(
+            "Run the cross-host federation selftest: 2 supervisor "
+            "domains over loopback TCP behind one federated front "
+            "router + the HTTP edge — an open-loop HTTP burst, then "
+            "SIGKILL of one host's worker mid-stream with zero "
+            "client-visible errors (cross-host failover), auth/"
+            "slowloris drills, and a host+worker-labeled merged "
+            "exposition; exit 0/1"
+        ),
+    )
+    fleet.add_argument(
         "--stub", action="store_true",
         help=(
-            "With --selftest/--selftest-reload: use protocol-faithful "
-            "stub workers (no device path) — seconds instead of a JAX "
-            "boot per worker"
+            "With --selftest/--selftest-reload/--selftest-tcp: use "
+            "protocol-faithful stub workers (no device path) — "
+            "seconds instead of a JAX boot per worker"
         ),
     )
     fleet.set_defaults(func=cmd_fleet)

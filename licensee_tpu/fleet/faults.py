@@ -31,7 +31,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
+import socket
 import socketserver
 import sys
 import threading
@@ -42,6 +44,7 @@ from licensee_tpu.obs.flight import (
     FlightRecorder,
     flight_path_for_socket,
 )
+from licensee_tpu.serve.eventloop import parse_target
 
 
 def kill(pid: int) -> None:
@@ -58,6 +61,28 @@ def hang(pid: int) -> None:
 
 def resume(pid: int) -> None:
     os.kill(pid, signal.SIGCONT)
+
+
+def _dial_stream(
+    target: str, timeout_s: float | None = None
+) -> socket.socket:
+    """Blocking harness-side dial of a parse_target target: the right
+    address family, TCP_NODELAY on AF_INET, connected (or OSError).
+    The load generators and fault clients all go through here so every
+    drill runs unchanged against Unix sockets and TCP endpoints."""
+    kind, addr = parse_target(target)
+    family = socket.AF_INET if kind == "tcp" else socket.AF_UNIX
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    try:
+        if timeout_s is not None:
+            sock.settimeout(timeout_s)
+        if kind == "tcp":
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.connect(addr if kind == "tcp" else target)
+    except OSError:
+        sock.close()
+        raise
+    return sock
 
 
 class SlowWalker:
@@ -121,29 +146,36 @@ class Slowloris:
     ``run()`` blocks until the server closes the connection or
     ``give_up_s`` passes, and returns ``{"reaped", "elapsed_s",
     "sent_bytes"}`` — the selftest's gate is ``reaped=True`` while
-    normal traffic on OTHER connections kept answering."""
+    normal traffic on OTHER connections kept answering.
+
+    ``path`` is a parse_target target (Unix path or ``host:port``) and
+    ``payload`` the never-finished request — the default is a JSONL
+    content row; the HTTP edge drill dribbles a header block instead
+    (same sweep, same reap)."""
 
     def __init__(self, path: str, *, mode: str = "dribble",
-                 byte_interval_s: float = 0.2, give_up_s: float = 30.0):
+                 byte_interval_s: float = 0.2, give_up_s: float = 30.0,
+                 payload: bytes | None = None):
         if mode not in ("dribble", "half_close"):
             raise ValueError(f"unknown slowloris mode {mode!r}")
         self.path = path
         self.mode = mode
         self.byte_interval_s = float(byte_interval_s)
         self.give_up_s = float(give_up_s)
+        self.payload = (
+            payload if payload is not None
+            else b'{"content": "never finished'
+        )
 
     def run(self) -> dict:
         import socket as socketlib
 
-        payload = b'{"content": "never finished'
+        payload = self.payload
         sent = 0
         t0 = time.perf_counter()
-        sock = socketlib.socket(
-            socketlib.AF_UNIX, socketlib.SOCK_STREAM
-        )
+        sock = None
         try:
-            sock.settimeout(self.give_up_s)
-            sock.connect(self.path)
+            sock = _dial_stream(self.path, timeout_s=self.give_up_s)
             if self.mode == "half_close":
                 sock.sendall(payload)
                 sent = len(payload)
@@ -177,7 +209,8 @@ class Slowloris:
         except OSError:
             return self._result(False, t0, sent)
         finally:
-            sock.close()
+            if sock is not None:
+                sock.close()
 
     def _result(self, reaped: bool, t0: float, sent: int) -> dict:
         return {
@@ -209,8 +242,6 @@ def open_loop_client(
     window: ``sent / send_elapsed_s`` is the OFFERED arrival rate,
     while ``elapsed_s`` additionally spans the queue drain after the
     last send."""
-    import socket as socketlib
-
     line = (json.dumps({"content": "saturation probe"}) + "\n").encode(
         "utf-8"
     )
@@ -219,11 +250,10 @@ def open_loop_client(
     state = {"sent": 0, "answered": 0, "stalled": False}
     final: dict = {"n": None}
     t0 = time.perf_counter()
-    sock = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    sock = None
     try:
         try:
-            sock.connect(path)
-            sock.settimeout(timeout_s)
+            sock = _dial_stream(path, timeout_s=timeout_s)
         except OSError:
             state["stalled"] = True
             return {**state, "elapsed_s": 0.0, "lats_ms": []}
@@ -292,7 +322,128 @@ def open_loop_client(
         }
     finally:
         try:
-            sock.close()
+            if sock is not None:
+                sock.close()
+        except OSError:
+            pass
+
+
+# an HTTP/1.1 status line's head: how responses are counted without a
+# full parse (response bodies are JSON rows — the marker cannot appear
+# inside one)
+_HTTP_STATUS_RE = re.compile(rb"HTTP/1\.[01] (\d{3})")
+
+
+def open_loop_http_client(
+    path: str,
+    rate: float,
+    duration_s: float,
+    token: str | None = None,
+    timeout_s: float = 30.0,
+) -> dict:
+    """The HTTP twin of :func:`open_loop_client` for the edge
+    saturation bench: pipelined keep-alive ``POST /classify`` requests
+    at a fixed TARGET RATE on one TCP connection, responses counted
+    (and latency-stamped, matched by order — HTTP/1.1 answers in
+    request order) from status lines in raw chunks.  Also a
+    SUBPROCESS, for the same GIL-isolation reason.  Returns the
+    open_loop_client dict plus ``non_200`` (any non-200 status fails
+    the rung — the edge contract under saturation is 200 or a paced
+    429, and the bench offers under the admission cap)."""
+    body = json.dumps({"content": "saturation probe"}).encode("utf-8")
+    auth = f"Authorization: Bearer {token}\r\n" if token else ""
+    line = (
+        f"POST /classify HTTP/1.1\r\n"
+        f"Host: edge\r\n{auth}"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode("utf-8") + body
+    stamps: deque = deque()
+    lats: list[float] = []
+    state = {"sent": 0, "answered": 0, "non_200": 0, "stalled": False}
+    final: dict = {"n": None}
+    t0 = time.perf_counter()
+    sock = None
+    try:
+        try:
+            sock = _dial_stream(path, timeout_s=timeout_s)
+        except OSError:
+            state["stalled"] = True
+            return {**state, "elapsed_s": 0.0, "lats_ms": []}
+
+        def read_loop() -> None:
+            tail = b""
+            while True:
+                if (
+                    final["n"] is not None
+                    and state["answered"] >= final["n"]
+                ):
+                    return
+                try:
+                    chunk = sock.recv(1 << 16)
+                except OSError:  # timeout: a stalled client
+                    state["stalled"] = True
+                    return
+                if not chunk:
+                    state["stalled"] = True
+                    return
+                buf = tail + chunk
+                k = 0
+                last_end = 0
+                for m in _HTTP_STATUS_RE.finditer(buf):
+                    k += 1
+                    last_end = m.end()
+                    if m.group(1) != b"200":
+                        state["non_200"] += 1
+                # keep only unmatched trailing bytes (a status line
+                # split across chunks) — never bytes of a counted match
+                tail = buf[max(last_end, len(buf) - 11):]
+                if k:
+                    now = time.perf_counter()
+                    for _ in range(min(k, len(stamps))):
+                        lats.append((now - stamps.popleft()) * 1000.0)
+                    state["answered"] += k
+
+        reader = threading.Thread(target=read_loop, daemon=True)
+        reader.start()
+        tick_s = 0.01
+        per_tick = rate * tick_s
+        credit = 0.0
+        next_tick = t0
+        try:
+            while time.perf_counter() - t0 < duration_s:
+                credit += per_tick
+                n = int(credit)
+                credit -= n
+                if n:
+                    now = time.perf_counter()
+                    stamps.extend([now] * n)
+                    state["sent"] += n
+                    sock.sendall(line * n)
+                next_tick += tick_s
+                delay = next_tick - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            stamps.append(time.perf_counter())
+            sock.sendall(line)  # the drain sentinel
+            state["sent"] += 1
+        except OSError:
+            state["stalled"] = True
+        send_elapsed = time.perf_counter() - t0
+        final["n"] = state["sent"]
+        reader.join(timeout=timeout_s + 5.0)
+        if reader.is_alive() or state["answered"] < state["sent"]:
+            state["stalled"] = True
+        return {
+            **state,
+            "elapsed_s": round(time.perf_counter() - t0, 4),
+            "send_elapsed_s": round(send_elapsed, 4),
+            "lats_ms": [round(x, 2) for x in lats],
+        }
+    finally:
+        try:
+            if sock is not None:
+                sock.close()
         except OSError:
             pass
 
@@ -302,16 +453,23 @@ def _client_main(argv) -> int:
         prog="licensee-tpu-open-loop-client",
         description="Open-loop saturation client (bench harness)",
     )
-    parser.add_argument("--open-loop-client", required=True,
-                        metavar="SOCKET")
+    parser.add_argument("--open-loop-client", metavar="TARGET")
+    parser.add_argument("--open-loop-http", metavar="TARGET")
     parser.add_argument("--rate", type=float, required=True)
     parser.add_argument("--duration-s", type=float, required=True)
     parser.add_argument("--timeout-s", type=float, default=30.0)
+    parser.add_argument("--token", default=None)
     args = parser.parse_args(argv)
-    out = open_loop_client(
-        args.open_loop_client, args.rate, args.duration_s,
-        timeout_s=args.timeout_s,
-    )
+    if args.open_loop_http:
+        out = open_loop_http_client(
+            args.open_loop_http, args.rate, args.duration_s,
+            token=args.token, timeout_s=args.timeout_s,
+        )
+    else:
+        out = open_loop_client(
+            args.open_loop_client, args.rate, args.duration_s,
+            timeout_s=args.timeout_s,
+        )
     sys.stdout.write(json.dumps(out) + "\n")
     return 0
 
@@ -504,6 +662,16 @@ class _StubServer(socketserver.ThreadingMixIn,
     allow_reuse_address = True
 
 
+class _StubTcpServer(socketserver.ThreadingMixIn,
+                     socketserver.TCPServer):
+    """The stub worker on an AF_INET listener (``--socket host:port``)
+    — the TCP federation drills supervise stubs over loopback TCP with
+    the exact machinery the Unix-socket drills use."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+
 class _StubHandler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         # responses are coalesced per read-batch — one sendall carries
@@ -513,6 +681,13 @@ class _StubHandler(socketserver.StreamRequestHandler):
         # the syscall bottleneck of the router saturation bench.
         state: _StubState = self.server.state
         sock = self.connection
+        if sock.family == socket.AF_INET:
+            try:
+                # coalesced batch responses must not sit out a Nagle
+                # delay against the router's pipelined reads
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
         buf = bytearray()
         while True:
             try:
@@ -589,10 +764,14 @@ def stub_main(argv=None) -> int:
         "PREFIX (the per-worker validation-failure script)",
     )
     args = parser.parse_args(argv)
+    kind, addr = parse_target(args.socket)
     try:
-        if os.path.exists(args.socket):
-            os.unlink(args.socket)
-        server = _StubServer(args.socket, _StubHandler)
+        if kind == "tcp":
+            server = _StubTcpServer(addr, _StubHandler)
+        else:
+            if os.path.exists(args.socket):
+                os.unlink(args.socket)
+            server = _StubServer(args.socket, _StubHandler)
     except OSError as exc:
         sys.stderr.write(f"stub worker: cannot bind: {exc}\n")
         return 1
@@ -604,14 +783,15 @@ def stub_main(argv=None) -> int:
     finally:
         server.server_close()
         server.state.flight.stop()  # the clean-shutdown black box
-        try:
-            os.unlink(args.socket)
-        except OSError:
-            pass
+        if kind == "unix":
+            try:
+                os.unlink(args.socket)
+            except OSError:
+                pass
     return 0
 
 
 if __name__ == "__main__":
-    if "--open-loop-client" in sys.argv:
+    if "--open-loop-client" in sys.argv or "--open-loop-http" in sys.argv:
         sys.exit(_client_main(sys.argv[1:]))
     sys.exit(stub_main())

@@ -66,6 +66,8 @@ import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
+import re
+
 from licensee_tpu.fleet.wire import WireError, oneshot
 from licensee_tpu.obs import (
     Observability,
@@ -79,7 +81,7 @@ from licensee_tpu.serve.eventloop import (
     LineConn,
     LoopClosedError,
     LoopJsonlServer,
-    connect_unix,
+    connect_target,
     drop_close,
     drop_line,
 )
@@ -93,6 +95,20 @@ _REPICK_DELAY_S = 0.05
 # wire trace IDs are 64-bit, rendered 16-hex — same space the tracer
 # mints from (obs/tracing.py); the mint-only fast path masks into it
 _WIRE_MASK = 0xFFFFFFFFFFFFFFFF
+
+# error codes a FEDERATED backend (a per-host router fronting its own
+# worker domain) answers when ITS domain momentarily has no capacity —
+# to the tier above they mean "this host cannot serve this request
+# right now", i.e. attempt failure with failover to another host, never
+# a client-visible row.  A plain worker mints none of these, so the
+# single-host path is untouched.
+_FEDERATED_FAILOVER_CODES = frozenset(
+    ("no_backend_available", "router_closed", "router_not_started")
+)
+
+# an upstream hop's trace ID: 16 lowercase hex (the worker's adoption
+# grammar, serve/server.py TRACE_ID_RE)
+_TRACE_ID_RE = re.compile(r"\A[0-9a-f]{16}\Z")
 
 
 class _Attempt:
@@ -175,7 +191,7 @@ class _BackendConn:
         self.fifo: deque[_Attempt] = deque()
         self.line_conn: LineConn | None = None
         self._pending_lines: list[str] = []
-        self._abort_connect = connect_unix(
+        self._abort_connect = connect_target(
             router.loop, backend.socket_path, router.probe_timeout_s,
             self._on_connected, self._on_connect_error,
         )
@@ -274,9 +290,22 @@ class _BackendConn:
                 )
                 self.close(f"bad response line: {exc}")
                 return
-            outcome = (
-                "queue_full" if row.get("error") == "queue_full" else "ok"
-            )
+            err = row.get("error")
+            if isinstance(err, str) and (
+                err.split(":", 1)[0] in _FEDERATED_FAILOVER_CODES
+            ):
+                # cross-host federation: a per-host router reporting
+                # "my domain has no backend" (worker mid-restart,
+                # domain draining) is a failed ATTEMPT at this tier —
+                # fail over to another host instead of relaying the
+                # error to the client
+                self.router._attempt_resolved(
+                    attempt, "fail",
+                    f"{self.backend.name}: federated backend answered "
+                    f"{err}",
+                )
+                return
+            outcome = "queue_full" if err == "queue_full" else "ok"
             self.router._attempt_resolved(attempt, outcome, row, text)
             return
         self.router._attempt_resolved(attempt, "ok", None, text)
@@ -436,6 +465,7 @@ class Router:
         tracing: bool = True,
         trace_sample: float = 0.01,
         trace_slow_ms: float = 250.0,
+        merge_label: str = "worker",
     ):
         if not backends:
             raise ValueError("need at least one backend")
@@ -461,6 +491,12 @@ class Router:
         self.dispatch_wait_s = float(dispatch_wait_s)
         self.max_concurrency = int(max_concurrency)
         self.pool_per_worker = int(pool_per_worker)
+        # the label prometheus() tags each scraped backend's exposition
+        # with: "worker" for a single-host fleet, "host" for the
+        # federation tier (each backend is then a per-host router whose
+        # exposition is already worker-labeled — the merge nests host
+        # OUTSIDE worker, obs/export.merge_expositions)
+        self.merge_label = str(merge_label)
         self.backends: dict[str, Backend] = {
             name: Backend(name, path)
             for name, path in backends.items()
@@ -701,7 +737,7 @@ class Router:
         )
         if backend.probe_conn is None:
             if backend.probe_abort is None:
-                backend.probe_abort = connect_unix(
+                backend.probe_abort = connect_target(
                     self.loop, backend.socket_path, self.probe_timeout_s,
                     lambda sock, b=backend: self._probe_connected(b, sock),
                     lambda exc, b=backend: self._probe_conn_failed(b),
@@ -829,15 +865,42 @@ class Router:
         None (the front session's no-parse fast path); the request id
         is then recovered lazily, only on paths that need it."""
         self._counters["requests"] += 1
+        # cross-tier trace ADOPTION: a line that already carries a
+        # valid 16-hex trace (a FRONT router federating this one, or
+        # any upstream hop) keeps it — this router re-minting would
+        # break the upstream tier's pipelining cross-check AND split
+        # the assembled telemetry tree at the host boundary.  Same
+        # adoption grammar the worker applies (serve/server.py).
+        adopted = None
+        if '"trace"' in raw_line:
+            if msg is None:
+                # a line carrying "trace" anywhere must be PARSED: the
+                # worker adopts the TOP-LEVEL field, and a textual scan
+                # (json_str_field) cannot tell a nested occurrence
+                # apart — adopting a value the worker will not echo
+                # would burn the pipelined connection on every
+                # cross-check.  Only trace-carrying lines pay this
+                # parse; plain content rows keep the no-parse path.
+                try:
+                    parsed = json.loads(raw_line)
+                    msg = parsed if isinstance(parsed, dict) else {}
+                except ValueError:
+                    msg = {}
+            tid = msg.get("trace")
+            if isinstance(tid, str) and _TRACE_ID_RE.match(tid):
+                adopted = tid
         if self._mint_only:
             # head sampling is off: no Trace object can ever be
             # retained at start, so mint the wire-correlation ID from
             # the loop-owned counter and skip the tracer entirely
             trace = None
-            self._wire_seq += 1
-            wire_trace = (
-                f"{(self._wire_base + self._wire_seq) & _WIRE_MASK:016x}"
-            )
+            if adopted is not None:
+                wire_trace = adopted
+            else:
+                self._wire_seq += 1
+                wire_trace = (
+                    f"{(self._wire_base + self._wire_seq) & _WIRE_MASK:016x}"
+                )
         else:
             if msg is None:
                 try:
@@ -845,9 +908,11 @@ class Router:
                     msg = parsed if isinstance(parsed, dict) else {}
                 except ValueError:
                     msg = {}
-            trace = self.obs.tracer.start(msg.get("id"))
+            trace = self.obs.tracer.start(msg.get("id"), trace_id=adopted)
             wire_trace = trace.trace_id if trace is not None else None
-        if wire_trace is None:
+        if wire_trace is None or adopted is not None:
+            # adopted: the line already carries this exact trace —
+            # splicing would only duplicate it
             wire_line = raw_line
         else:
             # splice the minted trace into the raw line instead of
@@ -1246,12 +1311,30 @@ class Router:
         except (LoopClosedError, TimeoutError):
             snap = _snapshot()
         backends = snap["backends"]
+        host_health = None
         if self.supervisor is not None:
             sup = self.supervisor.status()
             for name, row in backends.items():
                 row["supervisor"] = sup.get(name)
+            host_health = self.supervisor.host_health()
+        # domain load in WORKER-stats shape: a front router federating
+        # this router over TCP probes it with the exact depth math it
+        # uses on a worker (fleet/router._probe_line reads
+        # stats.scheduler.queue_depth + in_flight) — queue_depth is the
+        # admission backlog plus every probed/outstanding request in
+        # the domain, in_flight is the router's active count
+        domain_depth = snap["admission_queued"] + sum(
+            (row["probed_load"] + row["outstanding"])
+            for row in backends.values()
+        )
         return {
             "uptime_s": self.obs.uptime_s(),
+            "scheduler": {
+                "queue_depth": domain_depth,
+                "in_flight": snap["active"],
+                "completed": snap["counters"]["ok"],
+            },
+            "host": host_health,
             "router": {
                 **snap["counters"],
                 "latency_ms": self._latency.snapshot(),
@@ -1272,8 +1355,11 @@ class Router:
 
     def prometheus(self) -> str:
         """The FLEET exposition: the router's own registry plus a live
-        scrape of every healthy worker's exposition, merged with a
-        ``worker`` label per source (obs/export.py)."""
+        scrape of every backend's exposition, merged with one label per
+        source (obs/export.py) — ``worker`` on a single-host fleet,
+        ``host`` on the federation tier, where each backend's scrape is
+        already worker-labeled and the merge nests host outside
+        worker."""
         per_source = {"router": self.obs.prometheus()}
         for name, backend in self.backends.items():
             try:
@@ -1292,7 +1378,7 @@ class Router:
             text = row.get("prometheus")
             if isinstance(text, str):
                 per_source[name] = text
-        return merge_expositions(per_source)
+        return merge_expositions(per_source, label=self.merge_label)
 
     def trace_tail(self, n: int = 20) -> list[dict]:
         return self.obs.tracer.tail(n)

@@ -37,6 +37,7 @@ import time
 
 from licensee_tpu.corpus.artifact import short_fingerprint
 from licensee_tpu.fleet import faults
+from licensee_tpu.fleet.http_edge import HttpEdgeServer
 from licensee_tpu.fleet.router import FrontServer, Router
 from licensee_tpu.fleet.supervisor import Supervisor, worker_env
 from licensee_tpu.fleet.wire import WireError, oneshot
@@ -863,6 +864,412 @@ def selftest_reload(
             "stub_workers": stub,
             "clean_rolls": good_rolls,
             "traffic_rows": len(traffic.rows) if traffic else 0,
+            "problems": problems,
+        }
+        sys.stderr.write(json.dumps(summary) + "\n")
+    return 0 if not problems else 1
+
+
+def _free_port() -> int:
+    """Lease one loopback TCP port (bind :0, read, close).  A race
+    against another process grabbing the port between close and our
+    bind exists in principle; on a CI loopback it is noise-level, and
+    the selftest reports a bind failure honestly if it ever loses."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+    finally:
+        sock.close()
+
+
+class _HttpClient:
+    """A sequential HTTP/1.1 keep-alive client for the federation
+    drill: one TCP connection, one POST round trip at a time, real
+    status-line + Content-Length parsing (the drill gates on status
+    codes, so counting newlines is not enough)."""
+
+    def __init__(self, target: str, token: str | None,
+                 timeout_s: float):
+        from licensee_tpu.fleet.faults import _dial_stream
+
+        self.sock = _dial_stream(target, timeout_s=timeout_s)
+        self.reader = self.sock.makefile("rb")
+        self.token = token
+
+    def post(self, path: str, body: bytes) -> tuple[int, dict, bytes]:
+        auth = (
+            f"Authorization: Bearer {self.token}\r\n" if self.token else ""
+        )
+        head = (
+            f"POST {path} HTTP/1.1\r\n"
+            f"Host: edge\r\n{auth}"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode("utf-8")
+        self.sock.sendall(head + body)
+        status_line = self.reader.readline()
+        parts = status_line.decode("utf-8", "replace").split(" ", 2)
+        if len(parts) < 2 or not parts[1].isdigit():
+            raise OSError(f"bad status line {status_line!r}")
+        code = int(parts[1])
+        headers: dict = {}
+        while True:
+            line = self.reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode(
+                "utf-8", "replace"
+            ).partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", "0"))
+        payload = self.reader.read(length) if length else b""
+        return code, headers, payload
+
+    def close(self) -> None:
+        try:
+            self.reader.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _edge_burst(
+    edge_target: str, token: str, problems: list[str],
+    rate: float = 600.0, duration_s: float = 1.0, n_conns: int = 2,
+) -> dict:
+    """The HTTP open-loop burst through the real edge: subprocess
+    clients write pipelined keep-alive POSTs at a fixed arrival rate.
+    Gates: every request answered, all 200s, no stalled client."""
+    import subprocess
+
+    procs = []
+    for _ in range(n_conns):
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "licensee_tpu.fleet.faults",
+                "--open-loop-http", edge_target,
+                "--rate", str(rate / n_conns),
+                "--duration-s", str(duration_s),
+                "--token", token,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        )
+        procs.append(proc)
+    results = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=duration_s + 60.0)
+            results.append(json.loads(stdout))
+        except Exception:  # noqa: BLE001 — a dead client is a finding below
+            p.kill()
+    sent = sum(r["sent"] for r in results)
+    answered = sum(r["answered"] for r in results)
+    non_200 = sum(r.get("non_200") or 0 for r in results)
+    stalled = any(r["stalled"] for r in results) or (
+        len(results) < n_conns
+    )
+    if stalled or answered != sent:
+        problems.append(
+            f"HTTP burst stalled: {answered}/{sent} answered "
+            f"({len(results)}/{n_conns} clients reported)"
+        )
+    if non_200:
+        problems.append(f"HTTP burst saw {non_200} non-200 responses")
+    send_elapsed = max(
+        (r.get("send_elapsed_s") or 0.0 for r in results), default=0.0
+    )
+    return {
+        "sent": sent,
+        "answered": answered,
+        "non_200": non_200,
+        "offered_rps": round(sent / send_elapsed, 1)
+        if send_elapsed else None,
+    }
+
+
+def selftest_tcp(
+    verbose: bool = True,
+    stub: bool = True,
+    n_domains: int = 2,
+    workers_per_domain: int = 1,
+    n_requests: int = 120,
+) -> int:
+    """The cross-host federation selftest (``licensee-tpu fleet
+    --selftest-tcp``): ``n_domains`` supervisor domains — each a
+    supervisor, its worker(s), a domain router, and a domain front
+    server, ALL on loopback TCP — federated behind one front router
+    (``merge_label="host"``) and the HTTP/1.1 edge.  The drills:
+
+    * an HTTP open-loop keep-alive burst through the edge: every
+      request answers 200, no stalled client;
+    * SIGKILL of one domain's worker mid-stream: ZERO client-visible
+      errors — the domain answers ``no_backend_available`` fast and
+      the FRONT router fails the attempt over to the other host (the
+      federated failover path), while the domain's supervisor respawns
+      the worker and the host rejoins;
+    * auth: a wrong bearer token answers 401 without touching a
+      backend;
+    * a slowloris dribbling HTTP HEADERS over TCP is reaped by the
+      stall sweep while the drill traffic keeps answering;
+    * the front router's merged exposition nests ``host=`` outside the
+      per-domain ``worker=`` labels and parses clean.
+
+    ``stub=True`` (the CI path) runs protocol-faithful stub workers
+    over TCP; ``stub=False`` boots real serve workers on TCP ports."""
+    problems: list[str] = []
+    burst: dict | None = None
+    boot_timeout = 20.0 if stub else 240.0
+    req_timeout = 10.0 if stub else 120.0
+    token = "edge-selftest-token"
+    domains: list[dict] = []
+    front_router = None
+    edge = None
+    edge_thread = None
+    statuses: list[int] = []
+
+    def stub_tcp_argv(name: str, target: str) -> list[str]:
+        return [
+            sys.executable, "-m", "licensee_tpu.fleet.faults",
+            "--socket", target, "--name", name, "--service-ms", "5",
+        ]
+
+    def serve_tcp_argv(name: str, target: str) -> list[str]:
+        return [
+            sys.executable, "-m", "licensee_tpu.cli.main", "serve",
+            "--socket", target, "--max-delay-ms", "5",
+        ]
+
+    env = worker_env(None, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        # -- boot the per-host supervisor domains --
+        for d in range(n_domains):
+            workers = {
+                f"d{d}w{i}": f"127.0.0.1:{_free_port()}"
+                for i in range(workers_per_domain)
+            }
+            # the restart backoff is LONGER than the domain's dispatch
+            # deadline below, so a killed worker's domain provably
+            # answers no_backend_available before its replacement
+            # boots — the drill must exercise the CROSS-HOST failover
+            # path, not win a race against the local respawn
+            supervisor = Supervisor(
+                workers,
+                argv_for=(stub_tcp_argv if stub else serve_tcp_argv),
+                env_for=lambda name, chips: env,
+                probe_interval_s=0.25,
+                backoff_base_s=1.5 if stub else 0.25,
+                backoff_max_s=3.0,
+                startup_grace_s=boot_timeout,
+            )
+            # dispatch_wait_s is SHORT on the domain tier: a domain
+            # with its worker down must answer no_backend_available
+            # quickly so the front tier fails over to another host,
+            # instead of parking the request until the local respawn
+            router = Router(
+                workers,
+                supervisor=supervisor,
+                probe_interval_s=0.1,
+                request_timeout_s=req_timeout,
+                dispatch_wait_s=1.0 if stub else 10.0,
+                trace_sample=0.0,
+            )
+            domains.append({
+                "supervisor": supervisor,
+                "router": router,
+                "front_target": None,
+                "server": None,
+                "thread": None,
+            })
+        for dom in domains:
+            dom["supervisor"].start()
+        for d, dom in enumerate(domains):
+            if not dom["supervisor"].wait_healthy(boot_timeout):
+                problems.append(
+                    f"domain {d} workers never became healthy: "
+                    f"{dom['supervisor'].status()}"
+                )
+                raise _Abort()
+            dom["router"].start()
+            # in-process listeners lease their ports race-free: bind
+            # :0, read bound_port (only the worker SUBPROCESS targets
+            # above need the close-then-rebind _free_port lease)
+            dom["server"] = FrontServer(
+                "127.0.0.1:0", dom["router"], stall_timeout_s=2.0
+            )
+            dom["front_target"] = f"127.0.0.1:{dom['server'].bound_port}"
+            dom["thread"] = threading.Thread(
+                target=dom["server"].serve_forever,
+                kwargs={"poll_interval": 0.05}, daemon=True,
+            )
+            dom["thread"].start()
+
+        # -- the federation tier: one front router over the domains --
+        hosts = {
+            f"host{d}": dom["front_target"]
+            for d, dom in enumerate(domains)
+        }
+        front_router = Router(
+            hosts,
+            probe_interval_s=0.1,
+            request_timeout_s=req_timeout + 5.0,
+            dispatch_wait_s=req_timeout + 30.0,
+            trace_sample=0.0,
+            merge_label="host",
+        )
+        front_router.start()
+        edge = HttpEdgeServer(
+            "127.0.0.1:0", front_router,
+            tokens={token: "drill"},
+            rate_per_client=100000.0,
+            stall_timeout_s=2.0,
+        )
+        edge_target = f"127.0.0.1:{edge.bound_port}"
+        edge_thread = threading.Thread(
+            target=edge.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        edge_thread.start()
+
+        # -- HTTP open-loop burst through the edge --
+        burst = _edge_burst(
+            edge_target, token, problems,
+            rate=600.0 if stub else 60.0,
+        )
+
+        # -- auth: a wrong token answers 401, backends untouched --
+        client = _HttpClient(edge_target, "wrong-token", req_timeout)
+        try:
+            code, _hdrs, _body = client.post(
+                "/classify", b'{"content": "auth probe"}'
+            )
+        finally:
+            client.close()
+        if code != 401:
+            problems.append(f"bad token answered {code}, wanted 401")
+
+        # -- SIGKILL one host's worker mid-stream: zero client errors,
+        #    the front tier fails over across hosts --
+        loris = faults.Slowloris(
+            edge_target, mode="dribble", byte_interval_s=0.25,
+            give_up_s=30.0,
+            payload=b"POST /classify HTTP/1.1\r\nHost: edge\r\nContent-Le",
+        )
+        loris_box: dict = {}
+        loris_thread = threading.Thread(
+            target=lambda: loris_box.update(loris.run()), daemon=True
+        )
+        loris_thread.start()
+        victim = domains[0]["supervisor"]
+        kill_at = n_requests // 3
+        client = _HttpClient(edge_target, token, req_timeout + 30.0)
+        traces = set()
+        try:
+            for i in range(n_requests):
+                body = json.dumps(
+                    {"id": i, "content": f"federation drill {i}"}
+                ).encode("utf-8")
+                code, hdrs, _payload = client.post("/classify", body)
+                statuses.append(code)
+                if hdrs.get("x-trace-id"):
+                    traces.add(hdrs["x-trace-id"])
+                if i + 1 == kill_at:
+                    handle = next(iter(victim.workers.values()))
+                    if handle.pid is None:
+                        problems.append("victim worker had no pid")
+                    else:
+                        faults.kill(handle.pid)
+        except OSError as exc:
+            problems.append(f"drill client failed: {exc}")
+        finally:
+            client.close()
+        bad = [c for c in statuses if c != 200]
+        if bad:
+            problems.append(
+                f"{len(bad)} non-200 responses during the SIGKILL "
+                f"drill (e.g. {bad[:5]}) — a client saw the failure"
+            )
+        if len(statuses) != n_requests:
+            problems.append(
+                f"drill answered {len(statuses)}/{n_requests} requests"
+            )
+        if not traces:
+            problems.append(
+                "no X-Trace-Id header echoed — the telemetry plane "
+                "does not span the edge"
+            )
+        # -- the front tier actually failed over across hosts --
+        fstats = front_router.stats()["router"]
+        if fstats["failovers"] + fstats["retries"] < 1:
+            problems.append(
+                f"no cross-host failover recorded — did the kill "
+                f"land? {fstats}"
+            )
+        # -- the dead worker rejoined its domain --
+        name = next(iter(victim.workers))
+        deadline = time.perf_counter() + boot_timeout
+        revived = False
+        while time.perf_counter() < deadline:
+            handle = victim.workers[name]
+            if handle.restarts >= 1 and victim.probe(name) is not None:
+                revived = True
+                break
+            time.sleep(0.1)
+        if not revived:
+            problems.append(
+                f"domain-0 worker never rejoined: {victim.status()}"
+            )
+        health = victim.host_health()
+        if not health.get("serving"):
+            problems.append(f"domain-0 host health not serving: {health}")
+        loris_thread.join(timeout=40.0)
+        if not loris_box.get("reaped"):
+            problems.append(
+                f"HTTP header slowloris was not reaped: {loris_box}"
+            )
+        # -- merged exposition: host label OUTSIDE worker label --
+        exposition = front_router.prometheus()
+        grammar = check_exposition(exposition)
+        if grammar:
+            problems.append(f"merged exposition grammar: {grammar[:3]}")
+        if 'host="host1"' not in exposition:
+            problems.append("merged exposition missing host labels")
+        if not re.search(r'host="host\d",worker="', exposition):
+            problems.append(
+                "merged exposition does not nest host= outside the "
+                "per-domain worker= labels"
+            )
+    except _Abort:
+        pass
+    except Exception as exc:  # noqa: BLE001 — selftest must report, not die
+        problems.append(f"selftest crashed: {type(exc).__name__}: {exc}")
+    finally:
+        if edge is not None:
+            edge.shutdown()
+            edge.server_close()
+        if edge_thread is not None:
+            edge_thread.join(timeout=5.0)
+        if front_router is not None:
+            front_router.close()
+        for dom in domains:
+            if dom["server"] is not None:
+                dom["server"].shutdown()
+                dom["server"].server_close()
+            if dom["thread"] is not None:
+                dom["thread"].join(timeout=5.0)
+            dom["router"].close()
+            dom["supervisor"].stop()
+    if verbose:
+        summary = {
+            "fleet_tcp_selftest": "ok" if not problems else "FAIL",
+            "stub_workers": stub,
+            "domains": n_domains,
+            "burst": burst,
+            "drill_requests": len(statuses),
             "problems": problems,
         }
         sys.stderr.write(json.dumps(summary) + "\n")

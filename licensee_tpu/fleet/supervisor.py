@@ -828,6 +828,30 @@ class Supervisor:
                 for name, handle in self.workers.items()
             }
 
+    def host_health(self) -> dict:
+        """The host-level health verdict for the federation tier: this
+        supervisor's whole worker domain, summarized the way a FRONT
+        router (or an operator) wants it — how many replicas exist,
+        how many are answering probes, and whether the domain can take
+        new work at all.  Rides the router's ``stats`` verb, so a
+        cross-host probe sees domain health in one round trip."""
+        with self._lock:
+            handles = list(self.workers.values())
+            healthy = sum(1 for h in handles if h.state == HEALTHY)
+            dispatchable = sum(
+                1
+                for h in handles
+                if not h.draining and h.state not in (STOPPED, DOWN)
+            )
+            restarts = sum(h.restarts for h in handles)
+        return {
+            "workers": len(handles),
+            "healthy": healthy,
+            "dispatchable": dispatchable,
+            "restarts": restarts,
+            "serving": dispatchable > 0,
+        }
+
 
 def kill_worker(handle: WorkerHandle) -> None:
     """SIGKILL a supervised worker — the crash fault (faults.py rides

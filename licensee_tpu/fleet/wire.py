@@ -4,7 +4,10 @@ pooled probe path the supervisor's health checks ride.
 Every worker speaks the serve transport (serve/server.py): one JSON
 object per line in, one per line out, in request order.  The fleet tier
 talks to workers over the same contract — a probe is just a session of
-one ``{"op": "stats"}`` line.  Pooled connections carry ONE in-flight
+one ``{"op": "stats"}`` line.  Targets go through
+``serve.eventloop.parse_target``: a Unix socket path, or ``host:port``
+for the TCP federation tier (pooled TCP connections disable Nagle —
+TCP_NODELAY — before the first byte).  Pooled connections carry ONE in-flight
 request at a time, so the worker's in-order response guarantee is
 trivially the caller's per-request correctness; a sick connection is
 closed, never reused.  (The ROUTER's request path no longer lives here:
@@ -27,18 +30,57 @@ House rules (script/lint): monotonic clocks only, no print.
 
 from __future__ import annotations
 
+import errno
 import json
 import socket
 import threading
+import time
 from collections import deque
+
+from licensee_tpu.serve.eventloop import parse_target
+
+
+def json_str_field(text: str, key: str) -> str | None:
+    """Pull a string field's value out of a serialized JSON row without
+    parsing it — the hot-path extractor the router (inbound-trace
+    adoption) and the HTTP edge (X-Trace-Id/X-Corpus echo) share.
+
+    Only sound for fields whose values the SYSTEM mints (16-hex trace
+    IDs, 12-hex corpus fingerprints): their values never contain
+    escapes, and client-controlled text cannot forge the unescaped
+    ``"key":`` byte pattern through json.dumps (its quotes arrive
+    backslash-escaped).  Callers validate the extracted value against
+    the field's grammar before trusting it."""
+    marker = f'"{key}"'
+    i = text.rfind(marker)
+    if i < 0:
+        return None
+    i += len(marker)
+    n = len(text)
+    while i < n and text[i] in " \t":
+        i += 1
+    if i >= n or text[i] != ":":
+        return None
+    i += 1
+    while i < n and text[i] in " \t":
+        i += 1
+    if i >= n or text[i] != '"':
+        return None
+    i += 1
+    j = text.find('"', i)
+    if j <= i:
+        return None
+    return text[i:j]
 
 
 class WireError(OSError):
     """The backend could not answer: connect/send/recv failed or timed
     out, or the response line was not JSON.  ``kind`` says which
-    failure class: "connect" (dial failed), "timeout" (the peer is
-    there but silent), "closed" (peer hung up), or "protocol" (bad
-    response line) — the pool's retry policy keys off it."""
+    failure class: "connect" (dial failed), "refused" (ECONNREFUSED —
+    a provably dead listener; callers fail over rather than retry),
+    "timeout" (the peer is there but silent), "closed" (peer hung up),
+    or "protocol" (bad response line) — the pool's retry policy keys
+    off it."""
 
     def __init__(self, message: str, kind: str = "io"):
         super().__init__(message)
@@ -46,20 +88,56 @@ class WireError(OSError):
 
 
 class Connection:
-    """One Unix-socket JSONL connection: send a line, read a line."""
+    """One JSONL control connection: send a line, read a line.
+
+    ``target`` is a :func:`parse_target` target — a Unix socket path,
+    or ``host:port`` for TCP (TCP_NODELAY set before the dial: a
+    request/response line protocol dies under Nagle + delayed ACK).
+    The dial distinguishes the two connect-failure classes that demand
+    opposite reactions: EAGAIN means the listener's backlog is full
+    and the connect never STARTED — retried inside the dial budget —
+    while ECONNREFUSED means a provably dead host (kind "refused",
+    never retried here: failing over is the caller's job)."""
 
     def __init__(self, path: str, timeout: float):
         self.path = path
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        try:
-            self._sock.settimeout(timeout)
-            self._sock.connect(path)
-            self._file = self._sock.makefile("rwb")
-        except OSError as exc:
-            self._sock.close()
-            raise WireError(
-                f"connect {path!r}: {exc}", kind="connect"
-            ) from exc
+        kind, addr = parse_target(path)
+        family = (
+            socket.AF_INET if kind == "tcp" else socket.AF_UNIX
+        )
+        address = addr if kind == "tcp" else path
+        deadline = time.perf_counter() + max(0.05, float(timeout))
+        while True:
+            self._sock = socket.socket(family, socket.SOCK_STREAM)
+            try:
+                self._sock.settimeout(timeout)
+                if kind == "tcp":
+                    self._sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                self._sock.connect(address)
+                self._file = self._sock.makefile("rwb")
+                return
+            except OSError as exc:
+                self._sock.close()
+                if (
+                    exc.errno == errno.EAGAIN
+                    and time.perf_counter() < deadline
+                ):
+                    # backlog full: this connect never started — a
+                    # short blocking retry inside the budget (this is
+                    # the blocking wire layer; the loop-side twin is
+                    # eventloop._connect_stream's timer retry)
+                    time.sleep(0.02)
+                    continue
+                raise WireError(
+                    f"connect {path!r}: {exc}",
+                    kind=(
+                        "refused"
+                        if exc.errno == errno.ECONNREFUSED
+                        else "connect"
+                    ),
+                ) from exc
 
     def request(self, line: str, timeout: float) -> dict:
         """Send one request line, block for one response row."""
@@ -102,7 +180,11 @@ class Connection:
 
 # WireError kinds where a parked connection's failure says "this socket
 # went stale" (worker restarted under us) rather than "the worker is
-# sick" — worth one fresh dial before reporting failure
+# sick" — worth one fresh dial before reporting failure.  "refused" is
+# deliberately absent: ECONNREFUSED is a provably dead listener (a dead
+# HOST, on the TCP federation tier) and the right reaction is failing
+# over, not dialing the corpse again; "timeout" stays out so a wedged
+# worker costs one probe timeout, not two.
 _RETRY_FRESH_KINDS = ("connect", "closed", "io")
 
 

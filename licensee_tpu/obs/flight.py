@@ -43,7 +43,20 @@ HARVEST_TAIL = 20
 
 def flight_path_for_socket(socket_path: str) -> str:
     """The black-box path convention shared by workers (writers) and
-    the supervisor (harvester): the worker's socket path + ``.flight``."""
+    the supervisor (harvester): the worker's socket path + ``.flight``.
+
+    A worker serving a TCP target (``host:port`` — the federation
+    tier) has no socket FILE to anchor the box to, so its dump lands
+    in the temp dir under a sanitized target name; both sides derive
+    the same path from the same target string, so the convention still
+    needs no plumbing."""
+    if ":" in os.path.basename(socket_path):
+        import tempfile
+
+        safe = socket_path.replace(os.path.sep, "_").replace(":", "_")
+        return os.path.join(
+            tempfile.gettempdir(), f"licensee-tpu-{safe}.flight"
+        )
     return f"{socket_path}.flight"
 
 

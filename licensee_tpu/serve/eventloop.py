@@ -19,12 +19,18 @@ Three pieces, bottom up:
   then the connection — never a parked thread), and a
   ``partial_since`` stamp that marks a peer mid-line (the slowloris
   tell: bytes without a newline).
-* :class:`LoopJsonlServer` — a listening Unix socket on a loop; accepts
-  are loop callbacks, each connection becomes a LineConn handed to
+* :class:`LoopJsonlServer` — a listening socket on a loop; accepts are
+  loop callbacks, each connection becomes a LineConn handed to
   ``handle_connection``, and a periodic sweep reaps connections whose
   partial line has stalled longer than ``stall_timeout_s`` (a client
   that dribbles bytes or half-closes mid-line is closed and forgotten —
-  it never holds a session, a thread, or a pool slot).
+  it never holds a session, a thread, or a pool slot).  The listener is
+  a Unix socket OR an AF_INET one: every transport target in the tree
+  goes through :func:`parse_target`, so ``"host:port"`` anywhere a
+  socket path is accepted puts that endpoint on TCP (with TCP_NODELAY —
+  a JSONL request/response protocol dies under Nagle+delayed-ACK) and
+  the fleet tier federates across hosts on the very same loop
+  machinery.
 
 Everything here is loop-thread-disciplined: ``register``/``close``/
 ``write`` mutations happen on the loop thread (cross-thread callers go
@@ -54,6 +60,22 @@ import selectors
 
 class LoopClosedError(RuntimeError):
     """The event loop has been stopped; nothing further can run on it."""
+
+
+def parse_target(target: str) -> tuple[str, object]:
+    """Classify one transport target: ``("tcp", (host, port))`` for a
+    ``host:port`` string, ``("unix", path)`` for everything else.
+
+    The rule is conservative so no existing socket path changes
+    meaning: a target counts as TCP only when it contains no path
+    separator AND ends in ``:<digits>`` with a non-empty host.  A bare
+    name ("w0.sock"), an absolute path, and a relative path all stay
+    AF_UNIX."""
+    if os.path.sep not in target:
+        host, sep, port = target.rpartition(":")
+        if sep and host and port.isdigit():
+            return "tcp", (host, int(port))
+    return "unix", target
 
 
 class Timer:
@@ -380,6 +402,12 @@ class LineConn:
         self.max_line_bytes = int(max_line_bytes)
         self.max_write_bytes = int(max_write_bytes)
         self._rbuf = bytearray()
+        # mixed framing (the HTTP edge): while a blob is expected the
+        # next N inbound bytes are raw payload delivered via
+        # ``on_blob``, not lines — see expect_blob()
+        self.on_blob = None
+        self._blob_remaining = 0
+        self._blob_buf = bytearray()
         self._wbuf: deque[memoryview] = deque()
         self._wbytes = 0
         self._events = selectors.EVENT_READ
@@ -417,6 +445,14 @@ class LineConn:
         if self._closed:
             raise OSError("connection closed")
         self._write_bytes(text.encode("utf-8") + b"\n")
+
+    def write_bytes_on_loop(self, data: bytes) -> None:
+        """Queue raw bytes (no newline framing) — the HTTP edge's
+        response writer; loop thread only.  Same coalesced-flush and
+        closed-connection contracts as ``write_line_on_loop``."""
+        if self._closed:
+            raise OSError("connection closed")
+        self._write_bytes(bytes(data))
 
     def _write_bytes(self, data: bytes) -> None:
         if self._closed:
@@ -511,6 +547,9 @@ class LineConn:
                 return
 
     def _split_lines(self) -> None:
+        if self.on_blob is not None:
+            self._consume_mixed()
+            return
         # one split() over the whole chunk, not a find/del/copy per
         # line: at saturation a single recv carries many pipelined
         # lines and the per-line buffer churn was measurable
@@ -526,6 +565,62 @@ class LineConn:
             return
         if self._rbuf:
             if self.partial_since is None:
+                self.partial_since = time.perf_counter()
+            if len(self._rbuf) > self.max_line_bytes:
+                self.close(f"line over {self.max_line_bytes} bytes")
+        else:
+            self.partial_since = None
+
+    # -- mixed line/blob framing (the HTTP edge) --
+
+    def expect_blob(self, n: int) -> None:
+        """Switch the next ``n`` inbound bytes to raw-payload framing:
+        once they arrive, ``on_blob(bytes)`` fires with the whole blob
+        and line framing resumes.  Loop thread only; requires an
+        ``on_blob`` handler and ``n > 0`` (a zero-length body needs no
+        read — handle it inline)."""
+        if self.on_blob is None:
+            raise RuntimeError("expect_blob needs an on_blob handler")
+        if n <= 0:
+            raise ValueError(f"expect_blob wants n > 0, got {n!r}")
+        self._blob_remaining = int(n)
+
+    def _consume_mixed(self) -> None:
+        """Frame-at-a-time parse for connections whose handler may
+        switch between line and blob framing per callback (an HTTP
+        request line / header lines, then a Content-Length body).  The
+        per-frame ``find`` costs more than the batch split, but header
+        volume is a handful of short lines per request — the JSONL hot
+        path never comes through here."""
+        progress = False
+        while not self._closed:
+            if self._blob_remaining:
+                take = min(self._blob_remaining, len(self._rbuf))
+                if take:
+                    self._blob_buf += self._rbuf[:take]
+                    del self._rbuf[:take]
+                    self._blob_remaining -= take
+                if self._blob_remaining:
+                    break  # mid-body: the stall stamp below covers it
+                blob = bytes(self._blob_buf)
+                self._blob_buf.clear()
+                progress = True
+                self.on_blob(blob)
+                continue
+            idx = self._rbuf.find(b"\n")
+            if idx < 0:
+                break
+            raw = bytes(self._rbuf[:idx])
+            del self._rbuf[: idx + 1]
+            progress = True
+            self.on_line(raw.decode("utf-8", errors="replace"))
+        if self._closed:
+            return
+        if self._rbuf or self._blob_remaining:
+            # mid-line OR mid-body counts as a partial request: the
+            # slowloris sweep reaps a dribbled body exactly like a
+            # dribbled line
+            if progress or self.partial_since is None:
                 self.partial_since = time.perf_counter()
             if len(self._rbuf) > self.max_line_bytes:
                 self.close(f"line over {self.max_line_bytes} bytes")
@@ -609,9 +704,10 @@ class LineConn:
         self._loop.call_soon_threadsafe(_arm)
 
 
-def connect_unix(loop: EventLoop, path: str, timeout_s: float,
-                 on_connect, on_error):
-    """Non-blocking Unix-socket connect on the loop thread.
+def _connect_stream(loop: EventLoop, family: int, address,
+                    label: str, timeout_s: float, on_connect, on_error):
+    """The shared non-blocking connect state machine behind
+    :func:`connect_unix` and :func:`connect_tcp`.
 
     Exactly one of ``on_connect(sock)`` (a connected non-blocking
     socket, ownership transferred) or ``on_error(exc)`` fires, on the
@@ -644,27 +740,35 @@ def connect_unix(loop: EventLoop, path: str, timeout_s: float,
     def attempt() -> None:
         if done[0]:
             return
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock = socket.socket(family, socket.SOCK_STREAM)
         sock.setblocking(False)
+        if family == socket.AF_INET:
+            # request/response JSONL dies under Nagle + delayed ACK:
+            # every pooled/probe/backend dial disables it up front
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # connect_ex is the NON-blocking dial: it reports EINPROGRESS/
         # EAGAIN instead of parking the thread
-        err = sock.connect_ex(path)
+        err = sock.connect_ex(address)
         if err == 0:
             pending["sock"] = sock
             finish(None)
             return
         if err == errno.EAGAIN:
-            # AF_UNIX EAGAIN is NOT "in progress": the listener's
-            # backlog is full and this connect never STARTED — the fd
-            # would report writable with SO_ERROR 0 while unconnected.
-            # There is nothing to wait on; retry until the deadline.
+            # EAGAIN is NOT "in progress": on AF_UNIX the listener's
+            # backlog is full, on AF_INET the ephemeral port range is
+            # momentarily exhausted — either way this connect never
+            # STARTED (the fd would report writable with SO_ERROR 0
+            # while unconnected).  There is nothing to wait on; retry
+            # until the deadline.  ECONNREFUSED is the opposite signal
+            # — a provably dead host — and fails over immediately via
+            # the error path below.
             sock.close()
             pending["retry"] = loop.call_later(0.02, attempt)
             return
         if err != errno.EINPROGRESS:
             sock.close()
             finish(
-                OSError(err, f"connect {path!r}: {os.strerror(err)}")
+                OSError(err, f"connect {label!r}: {os.strerror(err)}")
             )
             return
         pending["sock"] = sock
@@ -673,20 +777,55 @@ def connect_unix(loop: EventLoop, path: str, timeout_s: float,
             code = sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
             finish(
                 None if code == 0 else
-                OSError(code, f"connect {path!r}: {os.strerror(code)}")
+                OSError(code, f"connect {label!r}: {os.strerror(code)}")
             )
 
         loop.register(sock, selectors.EVENT_WRITE, on_writable)
 
     pending["deadline"] = loop.call_later(
-        timeout_s, finish, TimeoutError(f"connect {path!r} timed out")
+        timeout_s, finish, TimeoutError(f"connect {label!r} timed out")
     )
     attempt()
 
     def abort() -> None:
-        finish(OSError(f"connect {path!r} aborted"))
+        finish(OSError(f"connect {label!r} aborted"))
 
     return abort
+
+
+def connect_unix(loop: EventLoop, path: str, timeout_s: float,
+                 on_connect, on_error):
+    """Non-blocking Unix-socket connect on the loop thread (see
+    :func:`_connect_stream` for the callback/abort contract)."""
+    return _connect_stream(
+        loop, socket.AF_UNIX, path, path, timeout_s, on_connect, on_error
+    )
+
+
+def connect_tcp(loop: EventLoop, host: str, port: int, timeout_s: float,
+                on_connect, on_error):
+    """Non-blocking TCP connect on the loop thread: same contract as
+    :func:`connect_unix`, with TCP_NODELAY set before the dial.  Hosts
+    should be numeric (or otherwise resolver-free): ``connect_ex`` on a
+    name that needs DNS would do the lookup synchronously on the loop
+    thread."""
+    return _connect_stream(
+        loop, socket.AF_INET, (host, int(port)), f"{host}:{port}",
+        timeout_s, on_connect, on_error,
+    )
+
+
+def connect_target(loop: EventLoop, target: str, timeout_s: float,
+                   on_connect, on_error):
+    """Dial a :func:`parse_target` target — the one connect entry the
+    router's pools and probes use, so every fleet edge speaks AF_UNIX
+    or AF_INET by target spelling alone."""
+    kind, addr = parse_target(target)
+    if kind == "tcp":
+        host, port = addr
+        return connect_tcp(loop, host, port, timeout_s,
+                           on_connect, on_error)
+    return connect_unix(loop, target, timeout_s, on_connect, on_error)
 
 
 class SocketInUseError(OSError):
@@ -746,9 +885,15 @@ def prepare_unix_socket_path(path: str) -> None:
 
 
 class LoopJsonlServer:
-    """A listening Unix socket whose accepts, reads, and writes all run
-    on an event loop.  Subclasses implement ``handle_connection(sock)``
+    """A listening socket whose accepts, reads, and writes all run on
+    an event loop.  Subclasses implement ``handle_connection(sock)``
     to wrap each accepted socket (typically in a LineConn).
+
+    ``path`` is a :func:`parse_target` target: a filesystem path binds
+    an AF_UNIX listener (with the stale-socket reclaim), a
+    ``host:port`` string binds AF_INET (SO_REUSEADDR; port 0 picks an
+    ephemeral port, reported as ``bound_port``) — the network edge and
+    the cross-host fleet tier ride the same server class.
 
     The facade mirrors ``socketserver`` so existing callers and tests
     drive it unchanged: ``serve_forever(poll_interval=...)`` blocks
@@ -764,19 +909,35 @@ class LoopJsonlServer:
         loop: EventLoop | None = None,
         stall_timeout_s: float = 30.0,
     ):
-        prepare_unix_socket_path(path)
+        self.kind, addr = parse_target(path)
+        if self.kind == "unix":
+            prepare_unix_socket_path(path)
+            self._listener = socket.socket(
+                socket.AF_UNIX, socket.SOCK_STREAM
+            )
+        else:
+            self._listener = socket.socket(
+                socket.AF_INET, socket.SOCK_STREAM
+            )
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+            )
         self.path = path
         self.stall_timeout_s = float(stall_timeout_s)
         self._own_loop = loop is None
         self.loop = EventLoop(name="jsonl-server") if loop is None else loop
-        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
             self._listener.setblocking(False)
-            self._listener.bind(path)
+            self._listener.bind(addr if self.kind == "tcp" else path)
             self._listener.listen(128)
         except OSError:
             self._listener.close()
             raise
+        # the concrete TCP port (host:0 binds ephemeral — selftests and
+        # benches lease ports this way without a bind race)
+        self.bound_port = (
+            self._listener.getsockname()[1] if self.kind == "tcp" else None
+        )
         if self._own_loop:
             self.loop.start()
         self._conns: set[LineConn] = set()  # loop-thread only
@@ -867,6 +1028,13 @@ class LoopJsonlServer:
                 return
             except OSError:
                 return
+            if self.kind == "tcp":
+                try:
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    pass  # already closing: the LineConn will notice
             self.handle_connection(sock)
 
     def track_connection(self, conn: LineConn) -> None:

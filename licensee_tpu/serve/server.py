@@ -58,7 +58,7 @@ from licensee_tpu.serve.scheduler import MicroBatcher, QueueFullError
 
 __all__ = [
     "serve_session", "serve_stdio", "serve_unix", "selftest",
-    "selftest_reload", "JsonlUnixServer", "UnixServer",
+    "selftest_reload", "JsonlUnixServer", "UnixServer", "TcpServer",
     "SocketInUseError", "prepare_unix_socket_path",
 ]
 
@@ -466,6 +466,15 @@ class UnixServer(JsonlUnixServer):
 
     def run_session(self, lines, write_line) -> None:
         serve_session(self.batcher, lines, write_line)
+
+
+class TcpServer(UnixServer):
+    """The serve worker on an AF_INET listener — the federation tier's
+    worker transport.  ``UnixServer`` already routes ``host:port``
+    targets to a TCP listener through ``parse_target`` (TCP_NODELAY on
+    every accepted connection); this name makes the cross-host worker
+    tier explicit and pins the port picked for a ``host:0`` bind as
+    ``bound_port``."""
 
 
 def serve_unix(batcher: MicroBatcher, path: str) -> None:

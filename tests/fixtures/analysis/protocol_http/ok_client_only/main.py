@@ -1,0 +1,8 @@
+"""OK client-only program: sends a schema-declared route with no edge
+module in sight (a load-generator harness) — nothing to diff the
+serving side against, nothing to flag."""
+
+
+def probe(sock):
+    sock.sendall(b"GET /healthz HTTP/1.1\r\nHost: edge\r\n\r\n")
+    return sock.recv(65536)

@@ -1,0 +1,34 @@
+"""OK edge: ROUTES and STATUS_TEXT match the declared schema exactly;
+every mint site uses a declared code."""
+
+ROUTES = {
+    ("POST", "/classify"): "content",
+    ("GET", "/healthz"): "health",
+    ("GET", "/metrics"): "prometheus",
+}
+
+STATUS_TEXT = {
+    200: "OK",
+    400: "Bad Request",
+    401: "Unauthorized",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    413: "Payload Too Large",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+def _respond(conn, code, body):
+    conn.write(b"HTTP/1.1 %d %s\r\n\r\n" % (code, STATUS_TEXT[code].encode()))
+    conn.write(body)
+
+
+def handle(conn, route, authed):
+    if route not in ROUTES:
+        _respond(conn, 404, b"{}")
+    elif not authed:
+        _respond(conn, 401, b"{}")
+    else:
+        _respond(conn, 200, b"{}")

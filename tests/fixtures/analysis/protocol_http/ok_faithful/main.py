@@ -1,0 +1,10 @@
+"""OK client: posts the declared classify route through the edge."""
+
+
+def classify(sock, body):
+    head = (
+        "POST /classify HTTP/1.1\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode()
+    sock.sendall(head + body)
+    return sock.recv(65536)

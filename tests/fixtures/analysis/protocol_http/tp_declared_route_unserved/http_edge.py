@@ -1,0 +1,31 @@
+"""TP edge: the ROUTES table silently dropped /metrics — the schema
+still declares it, and the client next door still asks for it."""
+
+ROUTES = {  # BAD
+    ("POST", "/classify"): "content",
+    ("GET", "/healthz"): "health",
+}
+
+STATUS_TEXT = {
+    200: "OK",
+    400: "Bad Request",
+    401: "Unauthorized",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    413: "Payload Too Large",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+def _respond(conn, code, body):
+    conn.write(b"HTTP/1.1 %d\r\n\r\n" % code)
+    conn.write(body)
+
+
+def handle(conn, route):
+    if route in ROUTES:
+        _respond(conn, 200, b"{}")
+    else:
+        _respond(conn, 404, b"{}")

@@ -1,0 +1,7 @@
+"""TP client: scrapes the route the edge dropped — every request
+would answer 404."""
+
+
+def scrape(sock):
+    sock.sendall(b"GET /metrics HTTP/1.1\r\nHost: edge\r\n\r\n")  # BAD
+    return sock.recv(65536)

@@ -1,0 +1,33 @@
+"""TP edge: serves a route the schema never declared — edge drift is
+a two-place change, and this table moved alone."""
+
+ROUTES = {  # BAD
+    ("POST", "/classify"): "content",
+    ("GET", "/healthz"): "health",
+    ("GET", "/metrics"): "prometheus",
+    ("POST", "/v2/classify"): "content",
+}
+
+STATUS_TEXT = {
+    200: "OK",
+    400: "Bad Request",
+    401: "Unauthorized",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    413: "Payload Too Large",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+def _respond(conn, code, body):
+    conn.write(b"HTTP/1.1 %d %s\r\n\r\n" % (code, STATUS_TEXT[code].encode()))
+    conn.write(body)
+
+
+def handle(conn, route):
+    if route in ROUTES:
+        _respond(conn, 200, b"{}")
+    else:
+        _respond(conn, 404, b"{}")

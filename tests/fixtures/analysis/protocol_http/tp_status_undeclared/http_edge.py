@@ -1,0 +1,33 @@
+"""TP edge: the STATUS table grew a code (418) the schema does not
+declare, and a mint site uses it."""
+
+ROUTES = {
+    ("POST", "/classify"): "content",
+    ("GET", "/healthz"): "health",
+    ("GET", "/metrics"): "prometheus",
+}
+
+STATUS_TEXT = {  # BAD
+    200: "OK",
+    400: "Bad Request",
+    401: "Unauthorized",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    413: "Payload Too Large",
+    418: "I'm a teapot",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+def _respond(conn, code, body):
+    conn.write(b"HTTP/1.1 %d\r\n\r\n" % code)
+    conn.write(body)
+
+
+def handle(conn, route):
+    if route in ROUTES:
+        _respond(conn, 200, b"{}")
+    else:
+        _respond(conn, 418, b"{}")  # BAD

@@ -62,6 +62,7 @@ DIR_TO_RULE = {
     "stale_pragma": "stale-pragma",
     "protocol_drift": "protocol-drift",
     "protocol_stub": "protocol-stub-divergence",
+    "protocol_http": "protocol-http-drift",
     "metrics_doc": "metrics-doc",
 }
 

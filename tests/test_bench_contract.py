@@ -150,6 +150,13 @@ def _fat_details() -> dict:
                 "x_vs_pr4_closed_loop": 99999.99,
                 "loop_max_lag_ms": 99999.999,
             },
+            "edge_saturation": {
+                "deadline_ms": 99999.9,
+                "rounds": [{"target_rps": 99_999_999.9}] * 16,
+                "max_rps": 99_999_999.9,
+                "p99_ms_at_max": 99999.99,
+                "loop_max_lag_ms": 99999.999,
+            },
         },
         "host_model": {
             "z" * 30: 9.9,
@@ -239,6 +246,11 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["fleet"]["rps_2w"] == 99_999_999.9
     assert d["fleet"]["failover_errors"] == 99_999_999
     assert d["fleet"]["restart_recovery_s"] == 99999.999
+    # the network-edge saturation scalars (PR 13): offered HTTP rps at
+    # SLO through the real edge, and its p99 at max
+    assert d["fleet"]["sat_rps"] == 99_999_999.9
+    assert d["fleet"]["edge_sat_rps"] == 99_999_999.9
+    assert d["fleet"]["edge_sat_p99_ms"] == 99999.99
     assert d["obs"]["prom_lines"] == 99_999_999
     assert d["obs"]["traces"] == 99_999_999
     # the telemetry plane's headline scalars (PR 12): the SLO burn
@@ -277,6 +289,7 @@ def test_headline_survives_missing_rows(bench_mod):
     assert headline["details"]["e2e_files_per_sec"]["readme"] is None
     assert headline["details"]["serve_path"]["cached_rps"] is None
     assert headline["details"]["fleet"]["rps_2w"] is None
+    assert headline["details"]["fleet"]["edge_sat_rps"] is None
     assert headline["details"]["stripes"]["speedup"] is None
     assert headline["details"]["stripes"]["identical_output"] is None
     # a skipped serve suite degrades the obs/slo scalars to None —
@@ -284,6 +297,24 @@ def test_headline_survives_missing_rows(bench_mod):
     assert headline["details"]["obs"]["slo"]["ok"] is None
     assert headline["details"]["obs"]["slo"]["availability_burn"] is None
     assert headline["details"]["obs"]["traces_assembled"] is None
+
+
+def test_fast_mode_fleet_keys_say_skipped(bench_mod):
+    """The PR 13 satellite: a fast-mode run stamps every
+    details.fleet.* headline key with the "skipped" marker — the
+    driver record must distinguish "not run" from "broken" (null)."""
+    details = _fat_details()
+    details["fleet"] = "skipped"
+    headline = bench_mod.make_headline("m", 1.0, 1.0, details)
+    fleet = headline["details"]["fleet"]
+    assert fleet, "fleet block vanished"
+    assert set(fleet) == set(bench_mod.FLEET_HEADLINE_KEYS)
+    assert all(v == "skipped" for v in fleet.values()), fleet
+    for key in ("edge_sat_rps", "edge_sat_p99_ms", "sat_rps"):
+        assert fleet[key] == "skipped"
+    # and the stamped line still fits the driver capture
+    line = json.dumps(headline, separators=(",", ":"))
+    assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
 
 
 def test_headline_artifact_always_written(bench_mod, tmp_path):

@@ -1,0 +1,639 @@
+"""The network edge's protocol corners (PR 13): HTTP/1.1 keep-alive
+framing over TCP against a live stub fleet — header dribble, oversized
+bodies, invalid requests mid-pipeline, auth and rate-limit refusals,
+backpressure translation — plus the TCP wire lift (parse_target,
+TCP_NODELAY, ECONNREFUSED-vs-EAGAIN) and the 2-domain federation
+drill."""
+
+import json
+import math
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from licensee_tpu.fleet import faults
+from licensee_tpu.fleet.http_edge import HttpEdgeServer, _TokenBucket
+from licensee_tpu.fleet.router import Router
+from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+from licensee_tpu.fleet.wire import (
+    Connection,
+    WireError,
+    json_str_field,
+    oneshot,
+)
+from licensee_tpu.serve.eventloop import parse_target
+
+TOKEN = "test-edge-token"
+
+
+def _stub_argv(extra=()):
+    def argv(name, sock):
+        return [
+            sys.executable, "-m", "licensee_tpu.fleet.faults",
+            "--socket", sock, "--name", name, "--service-ms", "1",
+            *extra,
+        ]
+
+    return argv
+
+
+class _Fleet:
+    """One stub fleet + router + HTTP edge on loopback TCP, torn down
+    in reverse order."""
+
+    def __init__(self, n_workers=1, stub_args=(), edge_kwargs=None,
+                 worker_tcp=False):
+        self.tmp = tempfile.mkdtemp(prefix="licensee-edge-test-")
+        if worker_tcp:
+            self.sockets = {
+                f"w{i}": f"127.0.0.1:{_free_port()}"
+                for i in range(n_workers)
+            }
+        else:
+            self.sockets = {
+                f"w{i}": os.path.join(self.tmp, f"w{i}.sock")
+                for i in range(n_workers)
+            }
+        self.supervisor = Supervisor(
+            self.sockets, argv_for=_stub_argv(stub_args),
+            env_for=lambda name, chips: worker_env(None, None),
+            probe_interval_s=0.1, backoff_base_s=0.1, backoff_max_s=1.0,
+        )
+        self.supervisor.start()
+        assert self.supervisor.wait_healthy(30.0)
+        self.router = Router(
+            self.sockets, supervisor=self.supervisor,
+            probe_interval_s=0.1, request_timeout_s=10.0,
+            dispatch_wait_s=5.0, trace_sample=0.0,
+        )
+        self.router.start()
+        kwargs = {"tokens": {TOKEN: "tester"},
+                  "rate_per_client": 10000.0,
+                  "stall_timeout_s": 1.0}
+        kwargs.update(edge_kwargs or {})
+        self.edge = HttpEdgeServer("127.0.0.1:0", self.router, **kwargs)
+        self.port = self.edge.bound_port
+        self.thread = threading.Thread(
+            target=self.edge.serve_forever,
+            kwargs={"poll_interval": 0.05}, daemon=True,
+        )
+        self.thread.start()
+
+    def close(self):
+        self.edge.shutdown()
+        self.edge.server_close()
+        self.thread.join(timeout=5.0)
+        self.router.close()
+        self.supervisor.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _free_port() -> int:
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+    finally:
+        sock.close()
+
+
+def _connect(port, timeout=10.0):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _request(body: bytes, token=TOKEN, path="/classify",
+             method="POST", headers=()) -> bytes:
+    lines = [f"{method} {path} HTTP/1.1", "Host: edge"]
+    if token:
+        lines.append(f"Authorization: Bearer {token}")
+    lines.append(f"Content-Length: {len(body)}")
+    lines.extend(headers)
+    return ("\r\n".join(lines) + "\r\n\r\n").encode() + body
+
+
+def _read_response(reader):
+    """(status, headers, body) off a buffered socket reader; None at
+    EOF."""
+    status_line = reader.readline()
+    if not status_line:
+        return None
+    code = int(status_line.split(b" ")[1])
+    headers = {}
+    while True:
+        line = reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = line.decode().partition(":")
+        headers[name.strip().lower()] = value.strip()
+    n = int(headers.get("content-length", "0"))
+    body = reader.read(n) if n else b""
+    return code, headers, body
+
+
+def _roundtrip(port, raw: bytes, n_responses=1, timeout=15.0):
+    sock = _connect(port, timeout)
+    try:
+        sock.sendall(raw)
+        reader = sock.makefile("rb")
+        out = []
+        for _ in range(n_responses):
+            resp = _read_response(reader)
+            if resp is None:
+                break
+            out.append(resp)
+        reader.close()
+        return out
+    finally:
+        sock.close()
+
+
+# -- wire / transport lift ---------------------------------------------
+
+
+def test_parse_target_grammar():
+    assert parse_target("/tmp/x.sock") == ("unix", "/tmp/x.sock")
+    assert parse_target("w0.sock") == ("unix", "w0.sock")
+    assert parse_target("127.0.0.1:7001") == ("tcp", ("127.0.0.1", 7001))
+    assert parse_target("host:0") == ("tcp", ("host", 0))
+    # a path containing a colon stays a path
+    assert parse_target("dir/w:1")[0] == "unix"
+    assert parse_target(":123")[0] == "unix"
+
+
+def test_wire_refused_kind_on_dead_tcp_host():
+    port = _free_port()  # leased then released: provably refused
+    with pytest.raises(WireError) as exc:
+        Connection(f"127.0.0.1:{port}", 2.0)
+    assert exc.value.kind == "refused"
+
+
+def test_wire_tcp_connection_sets_nodelay_and_round_trips():
+    port = _free_port()
+    target = f"127.0.0.1:{port}"
+    proc = None
+    import subprocess
+
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "licensee_tpu.fleet.faults",
+             "--socket", target, "--name", "tcpstub"],
+            stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.perf_counter() + 20.0
+        row = None
+        while time.perf_counter() < deadline:
+            try:
+                row = oneshot(target, {"op": "stats"}, 2.0)
+                break
+            except WireError:
+                time.sleep(0.1)
+        assert row is not None and row["stats"]["worker"] == "tcpstub"
+        conn = Connection(target, 2.0)
+        try:
+            assert conn._sock.getsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY
+            )
+            row = conn.request(json.dumps({"op": "stats"}), 2.0)
+            assert "stats" in row
+        finally:
+            conn.close()
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
+def test_json_str_field_extraction():
+    assert json_str_field('{"trace": "ab12", "x": 1}', "trace") == "ab12"
+    assert json_str_field('{"trace":"ab12"}', "trace") == "ab12"
+    assert json_str_field('{"other": "y"}', "trace") is None
+    # client-controlled escaped text cannot forge the pattern
+    assert json_str_field(
+        json.dumps({"id": '{"trace":"evil"}'}), "trace"
+    ) is None
+
+
+def test_token_bucket_refill_horizon():
+    bucket = _TokenBucket(rate=10.0, burst=2.0)
+    assert bucket.take() == 0.0
+    assert bucket.take() == 0.0
+    wait = bucket.take()
+    assert 0.0 < wait <= 0.1 + 1e-6
+    assert math.ceil(wait) >= 1 or wait < 1
+
+
+# -- edge protocol corners ---------------------------------------------
+
+
+def test_classify_roundtrip_and_header_echo():
+    with _Fleet() as fleet:
+        body = json.dumps({"id": 7, "content": "hello edge"}).encode()
+        [(code, headers, payload)] = _roundtrip(
+            fleet.port, _request(body)
+        )
+        assert code == 200
+        row = json.loads(payload)
+        assert row["key"] == "stub-mit"
+        assert headers.get("x-trace-id") == row["trace"]
+        assert headers.get("x-corpus") == row["corpus"]
+
+
+def test_pipelined_keepalive_answers_in_order():
+    with _Fleet(n_workers=2) as fleet:
+        raw = b"".join(
+            _request(json.dumps({"id": i, "content": f"blob {i}"}).encode())
+            for i in range(8)
+        )
+        responses = _roundtrip(fleet.port, raw, n_responses=8)
+        assert [c for c, _h, _b in responses] == [200] * 8
+        ids = [json.loads(b)["id"] for _c, _h, b in responses]
+        assert ids == list(range(8))  # arrival order, always
+
+
+def test_invalid_request_mid_pipeline_answers_then_burns():
+    with _Fleet() as fleet:
+        good = _request(json.dumps({"id": 1, "content": "x"}).encode())
+        raw = good + b"NOT AN HTTP LINE\r\n" + good
+        sock = _connect(fleet.port)
+        try:
+            sock.sendall(raw)
+            reader = sock.makefile("rb")
+            first = _read_response(reader)
+            second = _read_response(reader)
+            assert first is not None and first[0] == 200
+            assert second is not None and second[0] == 400
+            assert second[1].get("connection") == "close"
+            # the third (valid) request after the burn is never parsed:
+            # the connection closes instead
+            assert _read_response(reader) is None
+            reader.close()
+        finally:
+            sock.close()
+
+
+def test_oversized_body_refused_413_and_burned():
+    with _Fleet(edge_kwargs={"max_body_bytes": 128}) as fleet:
+        body = b'{"content": "' + b"x" * 400 + b'"}'
+        [(code, headers, payload)] = _roundtrip(
+            fleet.port, _request(body)
+        )
+        assert code == 413
+        assert headers.get("connection") == "close"
+        assert b"bad_request" in payload
+
+
+def test_http_header_dribble_slowloris_reaped_over_tcp():
+    with _Fleet() as fleet:
+        loris = faults.Slowloris(
+            f"127.0.0.1:{fleet.port}", mode="dribble",
+            byte_interval_s=0.1, give_up_s=20.0,
+            payload=b"POST /classify HTTP/1.1\r\nHost: edge\r\nContent-Le",
+        )
+        box = {}
+        thread = threading.Thread(
+            target=lambda: box.update(loris.run()), daemon=True
+        )
+        thread.start()
+        # honest traffic keeps answering while the dribbler stalls
+        body = json.dumps({"id": 1, "content": "alive"}).encode()
+        [(code, _h, _b)] = _roundtrip(fleet.port, _request(body))
+        assert code == 200
+        thread.join(timeout=30.0)
+        assert box.get("reaped"), box
+
+
+def test_body_dribble_slowloris_reaped():
+    with _Fleet() as fleet:
+        # complete headers, then a body that never finishes
+        head = (
+            "POST /classify HTTP/1.1\r\nHost: edge\r\n"
+            f"Authorization: Bearer {TOKEN}\r\n"
+            "Content-Length: 1000\r\n\r\n"
+        ).encode()
+        loris = faults.Slowloris(
+            f"127.0.0.1:{fleet.port}", mode="dribble",
+            byte_interval_s=0.1, give_up_s=20.0,
+            payload=head + b'{"content": "never finished',
+        )
+        box = loris.run()
+        assert box.get("reaped"), box
+
+
+def test_auth_failure_401():
+    with _Fleet() as fleet:
+        body = json.dumps({"content": "x"}).encode()
+        [(code, headers, payload)] = _roundtrip(
+            fleet.port, _request(body, token="wrong")
+        )
+        assert code == 401
+        assert headers.get("www-authenticate") == "Bearer"
+        [(code, _h, _b)] = _roundtrip(
+            fleet.port, _request(body, token=None)
+        )
+        assert code == 401
+        # healthz stays unauthenticated (load-balancer probes)
+        [(code, _h, payload)] = _roundtrip(
+            fleet.port,
+            _request(b"", token=None, path="/healthz", method="GET"),
+        )
+        assert code == 200 and json.loads(payload)["ok"] is True
+
+
+def test_rate_limit_429_with_retry_after():
+    with _Fleet(
+        edge_kwargs={"rate_per_client": 2.0, "burst": 2.0}
+    ) as fleet:
+        body = json.dumps({"content": "x"}).encode()
+        raw = b"".join(_request(body) for _ in range(5))
+        responses = _roundtrip(fleet.port, raw, n_responses=5)
+        codes = [c for c, _h, _b in responses]
+        assert codes[:2] == [200, 200]
+        assert set(codes[2:]) == {429}
+        throttled = responses[2]
+        assert int(throttled[1]["retry-after"]) >= 1
+        assert b"queue_full" in throttled[2]
+
+
+def test_queue_full_backpressure_maps_to_429():
+    with _Fleet(stub_args=("--queue-full",)) as fleet:
+        body = json.dumps({"content": "x"}).encode()
+        [(code, headers, payload)] = _roundtrip(
+            fleet.port, _request(body)
+        )
+        assert code == 429
+        assert int(headers["retry-after"]) >= 1
+        assert json.loads(payload)["error"] == "queue_full"
+
+
+def test_router_shutdown_maps_to_503():
+    fleet = _Fleet()
+    try:
+        # put the router into its closing state WITHOUT stopping the
+        # shared loop (the edge rides it): exactly the in-flight
+        # shutdown window the 503 translation covers
+        fleet.router.loop.run_sync(fleet.router._shutdown_on_loop)
+        body = json.dumps({"content": "x"}).encode()
+        [(code, _h, payload)] = _roundtrip(fleet.port, _request(body))
+        assert code == 503
+        assert b"router_closed" in payload
+        # and healthz says so too
+        [(code, _h, payload)] = _roundtrip(
+            fleet.port,
+            _request(b"", token=None, path="/healthz", method="GET"),
+        )
+        assert code == 503 and json.loads(payload)["ok"] is False
+    finally:
+        fleet.close()
+
+
+def test_unknown_route_404_and_wrong_method_405_keep_alive():
+    with _Fleet() as fleet:
+        ok = _request(json.dumps({"content": "x"}).encode())
+        raw = (
+            _request(b"", path="/nope", method="GET")
+            + _request(b'{"content": "x"}', path="/classify",
+                       method="GET")
+            + ok
+        )
+        responses = _roundtrip(fleet.port, raw, n_responses=3)
+        assert [c for c, _h, _b in responses] == [404, 405, 200]
+
+
+def test_empty_body_is_400_keep_alive():
+    with _Fleet() as fleet:
+        raw = _request(b"") + _request(
+            json.dumps({"content": "x"}).encode()
+        )
+        responses = _roundtrip(fleet.port, raw, n_responses=2)
+        assert [c for c, _h, _b in responses] == [400, 200]
+
+
+def test_metrics_endpoint_serves_merged_exposition():
+    with _Fleet() as fleet:
+        # a counted request first: a labeled counter family renders
+        # only once a child exists
+        body = json.dumps({"content": "count me"}).encode()
+        [(code, _h, _b)] = _roundtrip(fleet.port, _request(body))
+        assert code == 200
+        [(code, headers, payload)] = _roundtrip(
+            fleet.port, _request(b"", path="/metrics", method="GET"),
+            timeout=20.0,
+        )
+        assert code == 200
+        assert headers["content-type"] == "text/plain"
+        text = payload.decode()
+        assert "edge_http_requests_total" in text
+        assert 'worker="w0"' in text
+
+
+def test_drr_fair_queue_interleaves_clients():
+    """Two clients, one hogging with fat bodies: DRR must not let the
+    hog starve the small-body client."""
+    with _Fleet(
+        n_workers=1,
+        stub_args=("--service-ms", "20"),
+        edge_kwargs={
+            "tokens": {"hog-token": "hog", "mouse-token": "mouse"},
+            "max_inflight": 1,
+            "quantum_bytes": 256,
+        },
+    ) as fleet:
+        fat = json.dumps({"content": "y" * 2000}).encode()
+        thin = json.dumps({"content": "z"}).encode()
+        done: dict = {}
+
+        def run(name, token, body, n):
+            t0 = time.perf_counter()
+            responses = _roundtrip(
+                fleet.port,
+                b"".join(_request(body, token=token) for _ in range(n)),
+                n_responses=n, timeout=60.0,
+            )
+            done[name] = (
+                time.perf_counter() - t0,
+                [c for c, _h, _b in responses],
+            )
+
+        hog = threading.Thread(
+            target=run, args=("hog", "hog-token", fat, 20), daemon=True
+        )
+        hog.start()
+        time.sleep(0.1)  # the hog's queue is deep before the mouse asks
+        run("mouse", "mouse-token", thin, 1)
+        hog.join(timeout=60.0)
+        assert done["mouse"][1] == [200]
+        assert all(c == 200 for c in done["hog"][1])
+        # the mouse waited ~one service slot, not the hog's whole queue
+        assert done["mouse"][0] < done["hog"][0] / 2, done
+
+
+# -- federation ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_two_domain_tcp_federation_selftest():
+    """The acceptance drill end to end: 2 supervisor domains over
+    loopback TCP + HTTP edge, SIGKILL mid-stream, zero client-visible
+    errors (fleet/selftest.py selftest_tcp — also cibuild stage 2c3)."""
+    from licensee_tpu.fleet.selftest import selftest_tcp
+
+    assert selftest_tcp(verbose=True, stub=True) == 0
+
+
+def test_federated_router_fails_over_domain_errors():
+    """A backend answering no_backend_available is a failed ATTEMPT at
+    the tier above — failed over, never relayed (the cross-host
+    contract), while a healthy single-host fleet is untouched."""
+    with _Fleet(n_workers=2) as fleet:
+        # front tier over ONE healthy domain + one dead target: every
+        # request must answer via the healthy domain
+        front_target = f"127.0.0.1:{_free_port()}"
+        from licensee_tpu.fleet.router import FrontServer
+
+        domain_front = FrontServer(front_target, fleet.router)
+        dft = threading.Thread(
+            target=domain_front.serve_forever,
+            kwargs={"poll_interval": 0.05}, daemon=True,
+        )
+        dft.start()
+        dead_target = f"127.0.0.1:{_free_port()}"
+        front = Router(
+            {"hostA": front_target, "hostB": dead_target},
+            probe_interval_s=0.1, request_timeout_s=10.0,
+            dispatch_wait_s=10.0, trace_sample=0.0,
+            merge_label="host",
+        )
+        front.start()
+        try:
+            for i in range(10):
+                row = front.dispatch({"id": i, "content": f"fed {i}"})
+                assert not row.get("error"), row
+                assert row["key"] == "stub-mit"
+            stats = front.stats()
+            # the domain router's stats expose worker-shaped scheduler
+            # depth for the front tier's probed-depth math
+            assert "scheduler" in fleet.router.stats()
+            assert stats["backends"]["hostA"]["ok"] == 10
+            exposition = front.prometheus()
+            assert 'host="hostA"' in exposition
+            assert 'host="hostA",worker="' in exposition
+        finally:
+            front.close()
+            domain_front.shutdown()
+            domain_front.server_close()
+            dft.join(timeout=5.0)
+
+
+def test_trace_adoption_across_tiers():
+    """A line arriving with a valid 16-hex trace keeps it end to end —
+    the federation tier's correlation contract."""
+    with _Fleet() as fleet:
+        row = fleet.router.dispatch(
+            {"id": 1, "content": "adopt me",
+             "trace": "00deadbeef00cafe"}
+        )
+        assert row.get("trace") == "00deadbeef00cafe"
+        # an invalid trace value is NOT adopted: the router mints
+        row = fleet.router.dispatch(
+            {"id": 2, "content": "mint me", "trace": "nope"}
+        )
+        assert row.get("trace") != "nope"
+
+
+def test_trace_adoption_is_top_level_only():
+    """Adoption must match the worker's TOP-LEVEL parse: a nested
+    "trace" occurrence (which a textual last-occurrence scan would
+    grab) must not poison the pipelining cross-check — the review's
+    live repro burned the pooled connection on every retry."""
+    with _Fleet() as fleet:
+        # nested trace AFTER the top-level one: both tiers must agree
+        # on the top-level value, zero failovers
+        body = json.dumps({
+            "id": 1, "trace": "aaaaaaaaaaaaaaaa", "content": "x",
+            "opts": {"trace": "bbbbbbbbbbbbbbbb"},
+        }).encode()
+        [(code, headers, payload)] = _roundtrip(
+            fleet.port, _request(body)
+        )
+        assert code == 200
+        assert json.loads(payload)["trace"] == "aaaaaaaaaaaaaaaa"
+        # nested-only trace: the router must MINT (the worker adopts
+        # nothing), and the response still correlates
+        body = json.dumps({
+            "id": 2, "content": "y",
+            "opts": {"trace": "cccccccccccccccc"},
+        }).encode()
+        [(code, _h, payload)] = _roundtrip(fleet.port, _request(body))
+        assert code == 200
+        row = json.loads(payload)
+        assert row["trace"] != "cccccccccccccccc"
+        stats = fleet.router.stats()["router"]
+        assert stats["failovers"] == 0 and stats["retries"] == 0, stats
+
+
+def test_burned_session_still_answers_requests_queued_before_burn():
+    """Answer-then-burn with the DRR queue backed up: requests parked
+    BEFORE the invalid frame must still answer, then the 400 flushes
+    and the connection closes — a burned session must not strand its
+    earlier slots (review finding)."""
+    with _Fleet(
+        stub_args=("--service-ms", "30"),
+        edge_kwargs={"max_inflight": 1},
+    ) as fleet:
+        good = _request(json.dumps({"content": "x"}).encode())
+        raw = good + good + good + b"GARBAGE LINE\r\n"
+        sock = _connect(fleet.port, timeout=30.0)
+        try:
+            sock.sendall(raw)
+            reader = sock.makefile("rb")
+            codes = []
+            for _ in range(4):
+                resp = _read_response(reader)
+                if resp is None:
+                    break
+                codes.append(resp[0])
+            assert codes == [200, 200, 200, 400], codes
+            assert _read_response(reader) is None  # burned after
+            reader.close()
+        finally:
+            sock.close()
+
+
+def test_merge_expositions_nests_host_outside_worker():
+    from licensee_tpu.obs import merge_expositions
+
+    worker_labeled = (
+        "# HELP x_total t.\n# TYPE x_total counter\n"
+        'x_total{worker="w0"} 1\nx_total{worker="w1"} 2\n'
+    )
+    merged = merge_expositions(
+        {"hostA": worker_labeled, "hostB": worker_labeled},
+        label="host",
+    )
+    assert 'x_total{host="hostA",worker="w0"} 1' in merged
+    assert 'x_total{host="hostB",worker="w1"} 2' in merged
+    from licensee_tpu.obs import check_exposition
+
+    assert check_exposition(merged) == []
+
+
+def test_supervisor_host_health():
+    with _Fleet(n_workers=2) as fleet:
+        health = fleet.supervisor.host_health()
+        assert health["workers"] == 2
+        assert health["healthy"] == 2
+        assert health["serving"] is True
+        assert fleet.router.stats()["host"]["serving"] is True
