@@ -654,16 +654,17 @@ def bench_overlap(
 
 
 def bench_method_crossover(
-    widths: tuple = (128, 304, 608, 1216, 2432),
+    widths: tuple = (128, 304, 608, 1216, 2432, 4864),
     n_blobs: int = 16384,
     iters: int = 5,
 ) -> dict:
     """Refresh the popcount/matmul method crossover PAST vendored
     width: the ROADMAP flagged the old table (measured once at T<=608)
     as stale for artifact corpora grown beyond it, so this prices both
-    kernels at T=608 (vendored+SPDX width) and doubled/quadrupled
-    template pools (extend_templates: perturbed real bitsets, same
-    dtypes/density) and checks ``resolve_method``'s rung table
+    kernels at T=608 (vendored+SPDX width) and doubled/quadrupled/
+    octupled template pools (extend_templates: perturbed real bitsets,
+    same dtypes/density — the r7 sweep tops out at T=4864, 8x the
+    full-SPDX width) and checks ``resolve_method``'s rung table
     (kernels/batch.py METHOD_CROSSOVER — what ``method="auto"`` and
     every reload's ``build_classifier_like`` re-resolution consult)
     against the measured winner at every width."""
@@ -830,6 +831,64 @@ def bench_stripes(
             rate(n_stripes) / rate(1), 2
         )
     return out
+
+
+def bench_ingest(n_files: int = 4096) -> dict:
+    """Streaming container ingestion priced against the loose-file
+    path on the SAME blob set: one synthetic license corpus classified
+    twice — once from n_files loose files, once streamed out of a
+    single tarball (`archive.tar::*`, members stored under the loose
+    names so the two outputs must be BYTE-IDENTICAL) — through the
+    identical BatchProject pipeline.  The acceptance shape: the tar
+    rate within 20% of loose (the container source must not starve the
+    featurize lane), sha256-equal outputs, and the container-verdict
+    sidecar present."""
+    import hashlib
+    import io
+    import tarfile
+    import tempfile
+
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    with tempfile.TemporaryDirectory(prefix="bench_ingest_") as tmpdir:
+        corpus_dir = os.path.join(tmpdir, "corpus")
+        os.mkdir(corpus_dir)
+        paths = write_bench_corpus(corpus_dir, n_files, "license")
+        tar = os.path.join(tmpdir, "archive.tar")
+        with tarfile.open(tar, "w") as tf:
+            for p in paths:
+                with open(p, "rb") as f:
+                    data = f.read()
+                info = tarfile.TarInfo(name=p)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        row: dict = {"files": n_files}
+        digests = {}
+        for label, manifest in (("loose", paths), ("tar", [f"{tar}::*"])):
+            out = os.path.join(tmpdir, f"{label}.jsonl")
+            project = BatchProject(manifest, batch_size=1024)
+            try:
+                stats = project.run(out, resume=False)
+            finally:
+                project.close()
+            elapsed = stats.stage_seconds.get("elapsed", 0.0) or 1e-9
+            row[f"{label}_files_per_sec"] = round(n_files / elapsed, 1)
+            with open(out, "rb") as f:
+                digests[label] = hashlib.sha256(f.read()).hexdigest()
+        row["vs_loose"] = round(
+            row["tar_files_per_sec"] / row["loose_files_per_sec"], 3
+        )
+        row["identical_output"] = digests["tar"] == digests["loose"]
+        with open(
+            os.path.join(tmpdir, "tar.jsonl.containers.jsonl"),
+            encoding="utf-8",
+        ) as f:
+            containers = [json.loads(line) for line in f]
+        row["container_rows"] = len(containers)
+        row["container_license"] = (
+            containers[0].get("license") if containers else None
+        )
+        return row
 
 
 def bench_reference_fallback(reps: int = 300) -> dict:
@@ -1782,7 +1841,7 @@ def bench_edge_saturation(
 # still fits (tests/test_bench_contract.py pins this against a
 # worst-case details dict) — and BENCH_r06.json now carries the same
 # headline as a FILE, so the stdout window is no longer load-bearing
-HEADLINE_BYTE_BUDGET = 1700
+HEADLINE_BYTE_BUDGET = 1800
 
 # the driver-facing headline artifact, written UNCONDITIONALLY by
 # main() (fast mode included) so a skipped or truncated stdout capture
@@ -1850,6 +1909,12 @@ FLEET_HEADLINE_KEYS = (
     "edge_sat_p99_ms",
 )
 
+# the headline's streaming-ingestion block — fast mode stamps exactly
+# this set "skipped"; tests/test_bench_contract.py pins the members
+INGEST_HEADLINE_KEYS = (
+    "tar_files_per_sec", "vs_loose", "identical_output",
+)
+
 
 def make_headline(
     metric: str, value: float, vs_baseline: float, details: dict
@@ -1880,6 +1945,9 @@ def make_headline(
     edge = fleet.get("edge_saturation") or {}
     hm = details.get("host_model") or {}
     stripes = details.get("stripes") or {}
+    ingest_row = details.get("ingest")
+    ingest_skipped = ingest_row == "skipped"
+    ingest = ingest_row if isinstance(ingest_row, dict) else {}
     n_str = stripes.get("stripes")
     stripes_n_row = stripes.get(f"{n_str}_stripes") or {} if n_str else {}
     return {
@@ -1998,6 +2066,18 @@ def make_headline(
                 "predicted_speedup": stripes.get("predicted_speedup"),
                 "identical_output": stripes.get("identical_output"),
             },
+            # streaming container ingestion priced against the loose-
+            # file path on the same blob set (full row: details.ingest);
+            # fast mode stamps every key "skipped"
+            "ingest": (
+                {k: "skipped" for k in INGEST_HEADLINE_KEYS}
+                if ingest_skipped
+                else {
+                    "tar_files_per_sec": ingest.get("tar_files_per_sec"),
+                    "vs_loose": ingest.get("vs_loose"),
+                    "identical_output": ingest.get("identical_output"),
+                }
+            ),
             "details_file": "BENCH_DETAILS.json",
         },
     }
@@ -2147,6 +2227,11 @@ def main() -> None:
     stripes = run_slow(
         "stripes", bench_stripes, host_model=host_model
     )
+    ingest = run_slow("ingest", bench_ingest)
+    if fast and ingest is None:
+        # same contract as the fleet stamp: "skipped" != null — the
+        # driver record must say NOT RUN, not broken
+        ingest = "skipped"
     reference_fallback = run_slow(
         "reference_fallback", bench_reference_fallback
     )
@@ -2188,6 +2273,7 @@ def main() -> None:
         "host_model": host_model,
         "method_crossover": method_crossover,
         "stripes": stripes,
+        "ingest": ingest,
         "reference_fallback": reference_fallback,
         "tp_width": tp_width,
         "scalar_agreement": agreement,

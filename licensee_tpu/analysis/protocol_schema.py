@@ -33,6 +33,15 @@ REQUEST_OPS: dict[str, tuple[str, ...]] = {
     # (worker vs stub) is untouched
     "traces": ("id", "n", "trace_id"),
     "reload": ("id", "corpus"),
+    # normalized blob vs closest (or named) template, rendered as an
+    # inline word diff (serve/diffverb.py) — same content body as the
+    # op-less classification row plus the optional comparison target.
+    # Relayed THROUGH the fleet router like a content row (stateless,
+    # idempotent, any worker answers), so it carries/echoes the
+    # spliced "trace" the pipelining cross-check rides
+    "diff": (
+        "id", "content", "content_b64", "filename", "license", "trace",
+    ),
 }
 
 # error codes a response row's "error" field may carry (prefix before
@@ -49,6 +58,8 @@ ERROR_CODES: tuple[str, ...] = (
     "no_backend_available",
     "router_closed",
     "router_not_started",
+    # the diff verb named a license key the corpus does not know
+    "unknown_license",
 )
 
 # response-row fields a client may read; every one must have at least
@@ -70,6 +81,7 @@ RESPONSE_FIELDS: tuple[str, ...] = (
     "prometheus",
     "traces",
     "reload",
+    "diff",
 )
 
 # every wire "op" the checker enumerates: request verbs plus error

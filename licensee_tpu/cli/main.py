@@ -197,7 +197,7 @@ def _diff(license_key: str, license_to_diff) -> int:
 def cmd_diff(args) -> int:
     from licensee_tpu.project_files.license_file import LicenseFile
 
-    if not args.license:
+    if not args.license and not args.socket:
         print(
             "Usage: provide a license to diff against with --license (spdx name)",
             file=sys.stderr,
@@ -220,7 +220,47 @@ def cmd_diff(args) -> int:
         if file is None:
             print("No license file found", file=sys.stderr)
             return 1
+    if args.socket:
+        return _diff_via_worker(args, file)
     return _diff(args.license, file)
+
+
+def _diff_via_worker(args, file) -> int:
+    """The wire form of the diff command: one ``{"op": "diff"}`` round
+    trip to a serving worker, which normalizes the blob through the
+    featurizer's own pipeline and word-diffs it against the closest
+    (or ``--license``-named) template — no local corpus build, no git
+    subprocess, so it works against any live worker socket."""
+    request = {"op": "diff", "content": file.content or ""}
+    if file.filename:
+        request["filename"] = file.filename
+    if args.license:
+        request["license"] = args.license
+    try:
+        row = _scrape_row(args.socket, request, args.timeout)
+    except OSError as exc:
+        print(f"error: cannot reach worker: {exc}", file=sys.stderr)
+        return 1
+    if row.get("error"):
+        print(f"error: {row['error']}", file=sys.stderr)
+        return 1
+    diff = row.get("diff") or {}
+    if diff.get("key") is None:
+        print("No comparable license template", file=sys.stderr)
+        return 1
+    print(f"Comparing to {diff.get('spdx_id') or diff.get('key')}:")
+    _print_table(
+        [
+            ["Input Length:", diff.get("input_length")],
+            ["License length:", diff.get("license_length")],
+            ["Similarity:", format_percent(diff.get("similarity") or 0.0)],
+        ]
+    )
+    if diff.get("identical"):
+        print("Exact match!")
+        return 0
+    print(diff.get("diff") or "")
+    return 0
 
 
 def cmd_license_path(args) -> int:
@@ -563,7 +603,7 @@ def _dump_run_artifacts(args, stats) -> None:
             "total", "dice_matched", "reference_matched",
             "package_matched", "prefiltered_copyright",
             "prefiltered_exact", "unmatched", "read_errors",
-            "featurize_errors", "dedupe_hits",
+            "featurize_errors", "dedupe_hits", "skipped_oversized",
         ):
             rows_g.labels(kind=kind).set(getattr(stats, kind))
         _atomic_write(args.prom_file, render_prometheus(registry))
@@ -594,6 +634,23 @@ def cmd_batch_detect(args) -> int:
         )
         return 1
     if args.stripes is not None:
+        # striping is denominated in raw manifest ENTRIES; container
+        # entries expand to many rows, so the supervisor and workers
+        # would disagree about span arithmetic — refuse loudly here
+        # instead of corrupting a merge (single-process ingest works)
+        from licensee_tpu.ingest.sources import is_container_entry
+
+        with open(args.manifest, encoding="utf-8") as f:
+            has_containers = any(
+                is_container_entry(line.strip()) for line in f
+            )
+        if has_containers:
+            print(
+                "error: container manifest entries ('::' forms) are "
+                "not supported with --stripes yet; run single-process",
+                file=sys.stderr,
+            )
+            return 1
         return _run_striped(args)
     kwargs, err = _load_corpus(args.corpus)
     if err:
@@ -730,7 +787,10 @@ def cmd_batch_detect(args) -> int:
         else:
             # the shared route -> read -> classify -> attribute pass
             # (identical semantics to the pipelined run(), minus dedupe)
+            from licensee_tpu.ingest import SkippedBlob
+
             contents, results = project.classify_paths(paths)
+            rows = []
             for path, content, result in zip(paths, contents, results):
                 row = {"path": path, **result.as_dict()}
                 if content is None:
@@ -741,15 +801,33 @@ def cmd_batch_detect(args) -> int:
                     # analysis: disable=protocol-drift
                     row["error"] = "read_error"
                     project.stats.read_errors += 1
+                elif isinstance(content, SkippedBlob):
+                    # the 64 KiB cap: skipped, never truncated-and-
+                    # scored (the marker's own code, e.g. "oversized")
+                    row["error"] = content.error
+                    project.stats.skipped_oversized += 1
                 elif result.error:
                     row["error"] = result.error
                     project.stats.featurize_errors += 1
                 else:
                     project._count(result)
                 project.stats.total += 1
+                rows.append(row)
                 print(json.dumps(row))
+            if project.ingest is not None and project.ingest.spans:
+                # container-level verdict rows (the reference's
+                # Project#license algebra) after the per-blob stream
+                from licensee_tpu.ingest.verdict import container_verdict
+
+                for entry, start, count in project.ingest.spans:
+                    span_rows = [
+                        (rows[i]["path"], rows[i])
+                        for i in range(start, start + count)
+                    ]
+                    print(json.dumps(container_verdict(entry, span_rows)))
             stats = project.stats
     finally:
+        project.close()
         if profiler:
             import jax
 
@@ -1509,6 +1587,18 @@ def build_parser() -> argparse.ArgumentParser:
     diff = sub.add_parser("diff", help=_COMMAND_HELP["diff"])
     add_common(diff)
     diff.add_argument("--license", default=None)
+    diff.add_argument(
+        "--socket", default=None, metavar="PATH|HOST:PORT",
+        help=(
+            "Diff over the wire instead of locally: one {\"op\": "
+            "\"diff\"} round trip to a live serve worker (closest "
+            "template when --license is omitted)"
+        ),
+    )
+    diff.add_argument(
+        "--timeout", type=float, default=30.0,
+        help="Wire diff round-trip timeout in seconds (default 30)",
+    )
     diff.set_defaults(func=cmd_diff)
 
     lp = sub.add_parser("license-path", help=_COMMAND_HELP["license-path"])

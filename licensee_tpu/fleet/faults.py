@@ -608,6 +608,23 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
         with state.lock:
             tail = list(state.traces)[-int(msg.get("n", 20)):]
         return {"id": rid, "traces": tail}
+    if op == "diff":
+        # protocol-faithful, semantically canned (like the stub's
+        # verdict rows): the real worker's word-diff verb answers a
+        # "diff" object keyed by the comparison target, echoing the
+        # router-spliced trace for the pipelining cross-check
+        row = {
+            "id": rid,
+            "diff": {
+                "key": "stub-mit",
+                "similarity": 0.99,
+                "identical": False,
+                "diff": "{+stub+}",
+            },
+        }
+        if msg.get("trace"):
+            row["trace"] = msg["trace"]
+        return row
     if op is not None:
         return {"id": rid, "error": f"bad_request: unknown op {op!r}"}
     # a content row

@@ -1539,6 +1539,14 @@ class _FrontSession:
             # validator, serve/server.py) — the router only owns routing
             self._submit_content(line, msg)
             return
+        if op == "diff":
+            # the word-diff verb is stateless, idempotent, and
+            # answered by any worker from its serving corpus — relay
+            # it exactly like a content row (the WORKER validates the
+            # payload and echoes the spliced trace; failover/hedging
+            # semantics apply unchanged)
+            self._submit_content(line, msg)
+            return
         if op == "stats":
             fmt = msg.get("format")
             if fmt not in (None, "json", "prometheus"):

@@ -1,0 +1,37 @@
+"""Streaming container ingestion: tarballs, zips, and bare git repos as
+blob sources for the batch tier, without extracting to disk.
+
+Manifest entries address containers with a ``::`` separator
+(``archive.tar::path``, ``archive.tar::*``, ``repo.git::HEAD``); the
+expansion/reader machinery lives in ``sources.py`` and the
+container-level verdict algebra (the reference's ``Project#license`` /
+``#licenses`` semantics over batch rows) in ``verdict.py``.
+
+This ``__init__`` stays import-light on purpose: the CLI scans
+manifests for container entries before any heavy (jax) import happens,
+and ``serve/featurize.py`` imports :class:`SkippedBlob` to thread the
+skip-not-truncate read contract through the shared produce stage.
+"""
+
+from __future__ import annotations
+
+
+class SkippedBlob:
+    """A blob the reader refused to load — most commonly ``oversized``
+    (past the reference's MAX_LICENSE_SIZE 64 KiB cap, git_project.rb:53).
+
+    Skipped means skipped: the blob is never truncated-and-scored; its
+    output row carries ``error`` = :attr:`error` instead of a verdict."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: str = "oversized"):
+        self.error = error
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"SkippedBlob({self.error!r})"
+
+
+OVERSIZED = "oversized"
+
+__all__ = ["SkippedBlob", "OVERSIZED"]

@@ -1,0 +1,215 @@
+"""Container-level verdict semantics over per-blob batch rows.
+
+The reference's whole value is the PROJECT-level verdict
+(``Project#license`` / ``#licenses``, projects/project.rb:24-52): a
+single unique non-copyright match names the license, more than one
+collapses to ``other`` (with the LGPL dual-file exception,
+project.rb:102-106), and a scored license file that fails every
+matcher still counts as ``other`` (license_file.rb:92-98).  This
+module re-expresses exactly that algebra over the batch tier's
+finished per-blob rows, so a streamed container gets the same verdict
+an interactive ``licensee detect`` of its extracted tree would —
+parity is gated by tests/test_ingest.py against the real
+``projects/project.py`` on identical file sets.
+
+On top of the reference algebra, the dual-license shape composes an
+SPDX expression: a container holding exactly two confidently-matched,
+distinct real licenses (the ``LICENSE-MIT`` + ``LICENSE-APACHE``
+convention) keeps the reference's ``other`` verdict but additionally
+carries ``"spdx_expression": "MIT OR Apache-2.0"`` so downstream
+tooling sees the disjunction instead of a shrug.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def _root_names(members: list[str]) -> list[tuple[str, str]]:
+    """(root_name, member) pairs for the container's ROOT-level files.
+
+    The reference scans only the project root (git_project.rb:64-76:
+    root tree, type blob).  Forge tarballs wrap the tree in one shared
+    top-level directory (``repo-1.2.3/``), and archive members may be
+    stored under arbitrarily deep shared prefixes; the longest
+    directory run EVERY member shares is the logical root, stripped
+    before the root-level test."""
+    comps = [m.split("/") for m in members]
+    while comps and all(len(c) > 1 for c in comps):
+        heads = {c[0] for c in comps}
+        if len(heads) != 1:
+            break
+        comps = [c[1:] for c in comps]
+    return [
+        ("/".join(c), m)
+        for c, m in zip(comps, members)
+        if len(c) == 1 and c[0]
+    ]
+
+
+def container_verdict(entry: str, files: list[tuple[str, dict]]) -> dict:
+    """The reference Project algebra over finished per-blob rows.
+
+    ``files`` is the container's (member_name, row) list in container
+    order; rows are the per-blob JSONL dicts (``key`` / ``matcher`` /
+    ``confidence`` / optional ``error``).  Returns the container row.
+    """
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.project_files.license_file import (
+        COPYRIGHT_NAME_REGEX,
+        LicenseFile,
+    )
+
+    roots = _root_names([name for name, _ in files])
+    by_member = {name: row for name, row in files}
+    candidates = []  # (name, score, effective license key, row)
+    for root_name, member in roots:
+        row = by_member[member]
+        if row.get("error"):
+            continue  # unreadable/oversized: never a candidate
+        score = LicenseFile.name_score(root_name)
+        if score <= 0:
+            continue
+        # license_file.rb:92-98: a scored license file that fails all
+        # matchers is still 'other' — it looked like a license
+        key = row.get("key") or "other"
+        candidates.append((root_name, score, key, row))
+    # project.rb:111-117: sort by score descending, stable on input order
+    candidates.sort(key=lambda c: -c[1])
+
+    def lic(key):
+        return License.find(key)
+
+    def is_lgpl_file(name, key):
+        found = lic(key)
+        return name.lower() == "copying.lesser" and bool(
+            found and found.lgpl_q
+        )
+
+    # project.rb:137-145: LGPL gets priority when the top file is GPL'd
+    if candidates:
+        first = lic(candidates[0][2])
+        if first is not None and first.gpl_q:
+            lesser = next(
+                (
+                    i
+                    for i, c in enumerate(candidates)
+                    if is_lgpl_file(c[0], c[2])
+                ),
+                None,
+            )
+            if lesser is not None:
+                candidates.insert(0, candidates.pop(lesser))
+
+    def uniq(keys):
+        out = []
+        for k in keys:
+            if k not in out:
+                out.append(k)
+        return out
+
+    licenses = uniq(c[2] for c in candidates)
+
+    def is_copyright(c):
+        # project_file.rb:90-95: COPYRIGHT-named file whose content is
+        # only a copyright statement (the Copyright matcher fired)
+        name, _score, _key, row = c
+        return row.get("matcher") == "copyright" and bool(
+            COPYRIGHT_NAME_REGEX.search(name)
+        )
+
+    without_copyright = uniq(c[2] for c in candidates if not is_copyright(c))
+
+    # project.rb:102-106: LGPL in COPYING.lesser beside a GPL COPYING
+    is_lgpl = (
+        len(licenses) == 2
+        and len(candidates) == 2
+        and is_lgpl_file(candidates[0][0], candidates[0][2])
+        and bool(
+            lic(candidates[1][2]) and lic(candidates[1][2]).gpl_q
+        )
+    )
+
+    if len(without_copyright) == 1 or (is_lgpl and without_copyright):
+        license_key = without_copyright[0]
+    elif len(without_copyright) > 1:
+        license_key = "other"
+    else:
+        license_key = None
+
+    row = {
+        "container": entry,
+        "files": len(files),
+        "license": license_key,
+        "licenses": licenses,
+        "matched_files": [c[0] for c in candidates],
+    }
+
+    # SPDX expression composition: exactly two distinct REAL licenses
+    # (pseudo keys like other/no-license have no SPDX id to compose),
+    # each a confident matcher verdict, and not the LGPL pair — the
+    # dual-license shape
+    if license_key == "other" and len(without_copyright) == 2:
+        spdx = [
+            found.spdx_id
+            for k in without_copyright
+            if (found := lic(k)) is not None
+            and found.spdx_id not in (None, "NOASSERTION", "NONE")
+        ]
+        confident = all(
+            c[3].get("key") and c[3].get("matcher") != "copyright"
+            for c in candidates
+            if not is_copyright(c)
+        )
+        if len(spdx) == 2 and confident:
+            row["spdx_expression"] = " OR ".join(spdx)
+    return row
+
+
+def write_container_verdicts(
+    output: str, spans: list[tuple[str, int, int]]
+) -> str:
+    """Derive one container row per whole-container span from the
+    finished per-blob JSONL and write ``<output>.containers.jsonl``
+    atomically.
+
+    Purely a function of the (deterministic, resume-safe) per-blob
+    output, so a rerun after any crash — even one that tore a
+    container in half — regenerates identical container rows once the
+    blob rows are complete: container-granularity resume safety rides
+    on blob-granularity resume for free.  Streams the output file;
+    only one container's candidate rows are held at a time."""
+    path = f"{output}.containers.jsonl"
+    ordered = sorted(spans, key=lambda s: s[1])
+    rows: list[str] = []
+    with open(output, encoding="utf-8") as f:
+        lines = enumerate(f)
+        cursor = -1
+        line = None
+
+        def advance_to(target: int) -> str:
+            nonlocal cursor, line
+            while cursor < target:
+                try:
+                    cursor, line = next(lines)
+                except StopIteration:
+                    raise ValueError(
+                        f"{output!r} ends at row {cursor + 1}, but a "
+                        f"container span needs row {target + 1} — the "
+                        "per-blob output does not cover the expansion"
+                    ) from None
+            return line
+
+        for entry, start, count in ordered:
+            current = []
+            for j in range(count):
+                row = json.loads(advance_to(start + j))
+                current.append((row["path"], row))
+            rows.append(json.dumps(container_verdict(entry, current)))
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        for r in rows:
+            f.write(r + "\n")
+    os.replace(tmp, path)
+    return path

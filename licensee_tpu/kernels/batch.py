@@ -87,7 +87,11 @@ def _refscan_native():
 # past the vendored pool): matmul wins from a few hundred templates up
 # and its lead GROWS with T (the MXU amortizes the 32x bit unpack over
 # ever-larger contractions) — the r5 worry that the crossover might
-# invert above vendored width did not materialize.  ``method="auto"``
+# invert above vendored width did not materialize.  Re-benched
+# 2026-08-04 with the sweep extended to T = 4864 (8x full-SPDX width,
+# the ROADMAP's "past vendored" refresh): matmul ~15x popcount at the
+# widest rung on this backend, the gap still widening — table
+# unchanged, ``auto`` agrees at every measured rung.  ``method="auto"``
 # (and every reload's re-resolution through serve/reload.py
 # build_classifier_like) consults this table.
 METHOD_CROSSOVER: tuple = ((128, "popcount"), (None, "matmul"))

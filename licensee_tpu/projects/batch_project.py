@@ -164,6 +164,9 @@ class BatchStats:
     read_errors: int = 0
     featurize_errors: int = 0
     dedupe_hits: int = 0
+    # blobs past the MAX_LICENSE_SIZE 64 KiB cap: skipped, never
+    # truncated-and-scored (their rows carry error="oversized")
+    skipped_oversized: int = 0
     # --mode auto: rows per dispatched chain ("license" / "readme" /
     # "package" / "none" for filenames no table scores) — the per-mode
     # stats split of a mixed-manifest run
@@ -190,6 +193,8 @@ class BatchStats:
             del d["routed"]  # fixed-mode runs keep their old stats shape
         if not d["pipeline"]:
             del d["pipeline"]  # unpipelined paths keep their old shape
+        if not d["skipped_oversized"]:
+            del d["skipped_oversized"]  # capped runs keep their old shape
         d["stage_seconds"] = {
             k: round(v, 4) for k, v in self.stage_seconds.items()
         }
@@ -259,6 +264,43 @@ class BatchProject:
                 len(paths), self.process_index, self.process_count
             )
             paths = paths[lo:hi]
+        # -- streaming container ingestion (ingest/sources.py) --
+        #
+        # Manifest entries may address tar/zip/git containers
+        # (`archive.tar::member`, `archive.tar::*`, `repo.git::HEAD`);
+        # whole-container forms expand here into one work item per
+        # member blob, read straight out of the container by the
+        # produce workers — no extraction to disk.  Expansion is
+        # deterministic, so the blob-level resume invariant (line
+        # count == completed prefix) holds unchanged; the expansion
+        # fingerprint joins the resume sidecar so a rewritten archive
+        # refuses to resume instead of appending foreign rows.
+        self.ingest = None
+        from licensee_tpu.ingest.sources import (
+            expand_manifest,
+            is_container_entry,
+        )
+
+        if any(is_container_entry(p) for p in paths):
+            if self.process_count > 1:
+                # striping math is denominated in raw manifest ENTRIES;
+                # a container entry expands to many rows, so the
+                # supervisor and the workers would disagree about span
+                # arithmetic.  Future work — refuse loudly for now.
+                raise ValueError(
+                    "container manifest entries ('::' forms) are not "
+                    "supported with manifest striping / multi-host "
+                    "runs yet; run single-process"
+                )
+            if featurize_procs:
+                # container readers hold open fds/odb handles that do
+                # not survive pickling into spawn workers
+                raise ValueError(
+                    "container manifest entries ('::' forms) cannot be "
+                    "combined with --featurize-procs"
+                )
+            self.ingest = expand_manifest(paths)
+            paths = self.ingest.paths
         self.paths = paths
         # a caller-supplied classifier (pad_batch_to must equal batch_size)
         # reuses its compiled scorer across runs — e.g. a warmed-up one
@@ -414,8 +456,20 @@ class BatchProject:
             paths = [line.strip() for line in f if line.strip()]
         return cls(paths, **kwargs)
 
-    def _read(self, path: str) -> bytes | None:
+    def _read(self, path: str):
+        """bytes, None (unreadable), or a SkippedBlob marker (the
+        64 KiB cap) — read_capped's contract."""
         return _read_capped(path)
+
+    def _read_hook(self, start: int):
+        """The produce-stage read hook for the chunk at ``start``:
+        loose manifests read by path; expanded manifests read BY
+        GLOBAL INDEX through the container sources (display names are
+        not unique across containers)."""
+        if self.ingest is None:
+            return None  # produce_batch's loose-file default
+        read_at = self.ingest.read_at
+        return lambda _path, i: read_at(start + i)
 
     @staticmethod
     def _resume_point(output: str) -> int:
@@ -452,6 +506,12 @@ class BatchProject:
             self.dedupe,
             self.attribution,
             cache=self._dedupe_cache if self.dedupe else None,
+            read=self._read_hook(start),
+            filenames=(
+                self.ingest.filenames[start : start + self.batch_size]
+                if self.ingest is not None
+                else None
+            ),
         ))
 
     def _run_config(self) -> dict:
@@ -493,6 +553,14 @@ class BatchProject:
             "threshold": self.threshold,
             "closest": self.classifier.closest,
             "attribution": self.attribution,
+            # the container-expansion fingerprint (None for loose-only
+            # manifests): a resumed run must expand to the SAME rows —
+            # an archive rewritten between runs changes the sha and
+            # refuses instead of appending rows of a different
+            # container after a completed prefix of the old one
+            "ingest": (
+                self.ingest.fingerprint() if self.ingest is not None else None
+            ),
             # descriptive only (never compared): names the corpus in
             # refusal messages — "the output was written with X"
             "corpus_source": self.corpus_source,
@@ -716,6 +784,24 @@ class BatchProject:
                 nonlocal t_progress
                 expect_seq = 0
                 stats = self.stats
+
+                if self.ingest is not None:
+                    from licensee_tpu.ingest.sources import split_entry
+                else:
+                    split_entry = None
+
+                def route_name(p: str) -> str:
+                    # the attribution filename gate must see the
+                    # MEMBER's basename for an explicit
+                    # `container::member` entry (display string stays
+                    # as written); whole-container rows already
+                    # display the member itself
+                    if split_entry is not None:
+                        parsed = split_entry(p)
+                        if parsed is not None:
+                            return os.path.basename(parsed[1])
+                    return os.path.basename(p)
+
                 cache = self._dedupe_cache
                 dedupe = self.dedupe
                 dedupe_cap = self.dedupe_cap
@@ -745,6 +831,7 @@ class BatchProject:
                             results[i] = results[j]
                         t1 = time.perf_counter()
                         read_errors = featurize_errors = dedupe_hits = 0
+                        skipped_oversized = 0
                         lines: list[str] = []
                         append = lines.append
                         for k, (path, is_err, result) in enumerate(
@@ -752,10 +839,16 @@ class BatchProject:
                         ):
                             error = None
                             if is_err:
-                                # distinguish "could not read" from "no
-                                # license"
-                                error = "read_error"
-                                read_errors += 1
+                                # is_err carries the read disposition
+                                # code: "read_error" ("could not read"
+                                # vs "no license") or "oversized" (the
+                                # 64 KiB cap: skipped, never
+                                # truncated-and-scored)
+                                error = is_err
+                                if is_err == "oversized":
+                                    skipped_oversized += 1
+                                else:
+                                    read_errors += 1
                             elif result.error:
                                 # poisoned blob: contained per-row, run
                                 # continues
@@ -769,7 +862,7 @@ class BatchProject:
                                 ):
                                     result.attribution = attribution_for(
                                         contents[k],
-                                        os.path.basename(path),
+                                        route_name(path),
                                         result,
                                         route=(
                                             routes[k]
@@ -827,6 +920,7 @@ class BatchProject:
                         stats.read_errors += read_errors
                         stats.featurize_errors += featurize_errors
                         stats.dedupe_hits += dedupe_hits
+                        stats.skipped_oversized += skipped_oversized
                         t2 = time.perf_counter()
                         stats.add_stage("write", t2 - t1)
                         if trace is not None:
@@ -973,31 +1067,65 @@ class BatchProject:
             if writer_err:
                 raise writer_err[0]
         self.stats.pipeline = lanes.occupancy()
+        if self.ingest is not None and self.ingest.spans:
+            # container-level verdicts (the reference's Project#license
+            # algebra over this run's finished rows) — derived purely
+            # from the completed per-blob output and replaced
+            # atomically, so any interrupted run regenerates identical
+            # rows on its resumed completion: resume safety at
+            # container granularity rides on the blob-level invariant
+            from licensee_tpu.ingest.verdict import write_container_verdicts
+
+            t0 = time.perf_counter()
+            write_container_verdicts(output, self.ingest.spans)
+            self.stats.add_stage("containers", time.perf_counter() - t0)
         self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats
+
+    def close(self) -> None:
+        """Release container handles (open archive fds, git ODB
+        handles) held by an expanded manifest; a loose-manifest
+        project holds nothing."""
+        if self.ingest is not None:
+            self.ingest.close()
 
     def classify_paths(self, paths: list[str]):
         """Route, read, classify and (optionally) attribute paths in one
         unpipelined pass — the small-manifest twin of run(), used by the
         CLI's no---output mode.  Returns (contents, results); a row's
-        content is None when the read failed (the caller decides how to
-        surface that), b"" when auto routing skipped the read."""
+        content is None when the read failed, a SkippedBlob when the
+        reader refused it (the 64 KiB cap; the caller decides how to
+        surface both), b"" when auto routing skipped the read."""
         from licensee_tpu.kernels.batch import BatchClassifier
 
-        filenames = [os.path.basename(p) for p in paths]
+        if self.ingest is not None and paths is self.paths:
+            filenames = list(self.ingest.filenames)
+        else:
+            filenames = [os.path.basename(p) for p in paths]
         routes = None
         if self.mode == "auto":
             routes = [BatchClassifier.route_for(f) for f in filenames]
             for r in routes:
                 self.stats.add_route(r)
-        contents = [
-            self._read(p)
-            if routes is None or routes[i] is not None
-            else b""
-            for i, p in enumerate(paths)
-        ]
+        if self.ingest is not None and paths is self.paths:
+            # container reads are positional (display names may repeat
+            # across containers); only the project's own expanded path
+            # list carries that alignment
+            contents = [
+                self.ingest.read_at(i)
+                if routes is None or routes[i] is not None
+                else b""
+                for i in range(len(paths))
+            ]
+        else:
+            contents = [
+                self._read(p)
+                if routes is None or routes[i] is not None
+                else b""
+                for i, p in enumerate(paths)
+            ]
         results = self.classifier.classify_blobs(
-            [c if c is not None else b"" for c in contents],
+            [c if isinstance(c, (bytes, str)) else b"" for c in contents],
             threshold=self.threshold,
             filenames=filenames,
             routes=routes,
@@ -1005,7 +1133,7 @@ class BatchProject:
         if self.attribution:
             for i, r in enumerate(results):
                 if (
-                    contents[i] is not None
+                    isinstance(contents[i], (bytes, str))
                     and not r.error
                     and r.key is not None
                 ):

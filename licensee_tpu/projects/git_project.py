@@ -73,13 +73,18 @@ class _NativeBackend:
             ]
         return self._files
 
-    def load_file(self, file: dict) -> bytes:
+    def load_file(self, file: dict) -> bytes | None:
         from licensee_tpu.native.gitodb import GitODBError
 
         try:
-            return self._odb.read_blob(file["oid"], MAX_LICENSE_SIZE)
+            # one byte past the cap detects oversize without a separate
+            # size probe: an oversized blob is SKIPPED (None), never
+            # truncated-and-scored — a 64 KiB head can match a license
+            # the rest of the file contradicts (git_project.rb:53 cap)
+            data = self._odb.read_blob(file["oid"], MAX_LICENSE_SIZE + 1)
         except GitODBError as exc:
             raise InvalidRepository(str(exc)) from exc
+        return None if len(data) > MAX_LICENSE_SIZE else data
 
 
 class _SubprocessBackend:
@@ -127,9 +132,10 @@ class _SubprocessBackend:
                 files.append({"name": name, "oid": oid, "dir": "."})
         return files
 
-    def load_file(self, file: dict) -> bytes:
+    def load_file(self, file: dict) -> bytes | None:
         data = _run_git(self.repo, "cat-file", "blob", file["oid"])
-        return data[:MAX_LICENSE_SIZE]
+        # same skip-not-truncate cap semantics as the native backend
+        return None if len(data) > MAX_LICENSE_SIZE else data
 
 
 class GitProject(Project):
@@ -172,5 +178,8 @@ class GitProject(Project):
             self.__dict__["_files"] = cached
         return cached
 
-    def load_file(self, file: dict) -> bytes:
+    def load_file(self, file: dict) -> bytes | None:
+        """Blob bytes, or None for a blob past the MAX_LICENSE_SIZE
+        cap (skipped, never truncated-and-scored — the Project layer
+        drops skipped candidates)."""
         return self._backend.load_file(file)

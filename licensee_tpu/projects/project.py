@@ -74,9 +74,16 @@ class Project:
                 cached = []
             else:
                 found = self._find_files(LicenseFile.name_score)
-                loaded = [
-                    LicenseFile(self.load_file(f), f) for f in found
-                ]
+                loaded = []
+                for f in found:
+                    content = self.load_file(f)
+                    if content is None:
+                        # a backend refusing a blob (the 64 KiB
+                        # MAX_LICENSE_SIZE cap, git_project.py): the
+                        # file is skipped outright, never scored on a
+                        # truncated head
+                        continue
+                    loaded.append(LicenseFile(content, f))
                 cached = self._prioritize_lgpl(loaded)
             self.__dict__["_license_files"] = cached
         return cached

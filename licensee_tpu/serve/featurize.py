@@ -23,6 +23,7 @@ import json
 import os
 import time
 
+from licensee_tpu.ingest import OVERSIZED, SkippedBlob
 from licensee_tpu.kernels.batch import BatchClassifier, BlobResult
 
 # placeholder for a row that duplicates an earlier row of the SAME batch:
@@ -38,15 +39,29 @@ IN_BATCH_DUP = BlobResult(None, None, 0.0, error="in_batch_dup_unresolved")
 UNROUTED = BlobResult(None, None, 0.0)
 
 
-def read_capped(path: str) -> bytes | None:
-    """Read at most 64 KiB — the MAX_LICENSE_SIZE cap (git_project.rb:53);
-    None on any OS error (the caller reports a read_error row).  The one
-    read policy for every ingestion path."""
+def read_capped(path: str):
+    """The one loose-file read policy for every ingestion path: a blob
+    past the MAX_LICENSE_SIZE 64 KiB cap (git_project.rb:53) is SKIPPED
+    — a :class:`SkippedBlob` marker, an ``"error": "oversized"`` row —
+    never truncated-and-scored (a truncated head can score as a clean
+    match for text the full file then contradicts).  None on any OS
+    error (the caller reports a read_error row).  The container readers
+    (ingest/sources.py) and the git backends (projects/git_project.py)
+    enforce the same skip semantics."""
     try:
         with open(path, "rb") as f:
-            return f.read(64 * 1024)
+            data = f.read(64 * 1024 + 1)
     except OSError:
         return None
+    if len(data) > 64 * 1024:
+        return SkippedBlob(OVERSIZED)
+    return data
+
+
+def _read_loose(path: str, _index: int):
+    """The default 2-arg read hook: loose files via read_capped (the
+    index is only meaningful to container readers)."""
+    return read_capped(path)
 
 
 @functools.lru_cache(maxsize=4096)
@@ -127,27 +142,53 @@ def content_key(
 
 
 def produce_batch(
-    classifier, chunk, mode, dedupe, attribution, cache=None
+    classifier, chunk, mode, dedupe, attribution, cache=None, read=None,
+    filenames=None,
 ):
     """The produce stage, shared by the thread path (live ``cache``) and
     the worker-process path (``cache=None`` — the cross-batch cache
     lives in the parent, which applies it on receipt).
 
+    ``read(path, i)`` loads one blob by display path + in-chunk index —
+    the seam the streaming container sources (ingest/sources.py) plug
+    into; the default reads loose files via :func:`read_capped`.  The
+    index matters for container reads: two containers in one manifest
+    may hold the same member name, so the reader must address by
+    position, never by display string.  A read may answer bytes, None
+    (-> a ``read_error`` row), or a :class:`SkippedBlob` (-> a row
+    carrying its skip reason, e.g. ``oversized``).
+
+    ``filenames`` overrides the per-row routing/dispatch name (default:
+    each path's basename) — container entries route by their MEMBER's
+    basename, not their display string.
+
     In auto mode the filename routes FIRST: a manifest entry no score
     table claims skips the read, the hash, and the device entirely — on
     a 50M mixed manifest the unrecognized majority costs one regex scan
     of the basename and nothing else."""
-    filenames = [os.path.basename(p) for p in chunk]
+    if read is None:
+        read = _read_loose
+    if filenames is None:
+        filenames = [os.path.basename(p) for p in chunk]
     routes: list | None = None
     if mode == "auto":
         routes = [BatchClassifier.route_for(f) for f in filenames]
     t0 = time.perf_counter()
     contents = [
-        read_capped(p)
+        read(p, i)
         if routes is None or routes[i] is not None
         else b""
         for i, p in enumerate(chunk)
     ]
+    # per-row read disposition: None = clean, else the error code the
+    # writer emits ("read_error", "oversized", ...)
+    read_errs: list = [None] * len(chunk)
+    for i, c in enumerate(contents):
+        if c is None:
+            read_errs[i] = "read_error"
+        elif isinstance(c, SkippedBlob):
+            read_errs[i] = c.error
+            contents[i] = None
     t1 = time.perf_counter()
     keys: list = [None] * len(chunk)
     preset: list = [None] * len(chunk)
@@ -193,7 +234,6 @@ def produce_batch(
                 pre_rows = [None] * len(chunk)
             pre_rows[i] = jsonl_row(chunk[i], p, None)
     t2 = time.perf_counter()
-    read_errs = [c is None for c in contents]
     if attribution:
         # keep raw contents ONLY for rows that can still need the
         # attribution regex (license/readme route, not already finished

@@ -19,6 +19,9 @@ Request lines:
   {"op": "reload", "corpus": "...", "id": ...}  # blue/green corpus swap
                                      # (vendored | spdx | SPDX dir |
                                      # artifact path; validated, atomic)
+  {"op": "diff", "content": "...", "license": "mit", "id": ...}
+                                     # normalized blob vs closest (or
+                                     # named) template, inline word diff
 Response lines:
   {"id": ..., "key": ..., "matcher": ..., "confidence": ...,
    "cached": ..., "trace": "16-hex trace id"}
@@ -64,6 +67,22 @@ __all__ = [
 
 # an upstream hop's trace ID (the fleet router's): 16 lowercase hex
 TRACE_ID_RE = re.compile(r"\A[0-9a-f]{16}\Z")
+
+
+def _parse_content(msg: dict):
+    """(content, error) for the ``content`` / ``content_b64`` body the
+    classification row and the ``diff`` verb share."""
+    if "content_b64" in msg:
+        try:
+            return base64.b64decode(msg["content_b64"]), None
+        except (ValueError, TypeError) as exc:
+            return None, f"bad_request: {exc}"
+    content = msg.get("content")
+    if not isinstance(content, str):
+        return None, (
+            "bad_request: missing 'content' (or 'content_b64') string"
+        )
+    return content, None
 
 
 def _render_result(req) -> dict:
@@ -171,6 +190,44 @@ class _Session:
             elif kind == "trace":
                 rid, n = payload
                 row = {"id": rid, "traces": self.batcher.trace_tail(n)}
+            elif kind == "diff":
+                # computed at write time like stats (host-side Dice
+                # ranking + word diff, a few ms — a diagnostics verb,
+                # not the scoring hot path)
+                from licensee_tpu.serve.diffverb import (
+                    UnknownLicenseError,
+                    diff_payload,
+                )
+
+                rid, content, filename, license_key, trace_id = payload
+                # ONE classifier snapshot: pool fence and the corpus
+                # stamp must name the same blue/green epoch
+                clf = self.batcher.classifier
+                corpus = getattr(clf, "corpus", None)
+                try:
+                    row = {
+                        "id": rid,
+                        "diff": diff_payload(
+                            content, filename, license_key, corpus=corpus
+                        ),
+                    }
+                    if corpus is not None:
+                        from licensee_tpu.corpus.artifact import (
+                            corpus_fingerprint,
+                        )
+
+                        row["corpus"] = short_fingerprint(
+                            corpus_fingerprint(corpus)
+                        )
+                except UnknownLicenseError as exc:
+                    row = {"id": rid, "error": f"unknown_license: {exc}"}
+                except Exception as exc:  # noqa: BLE001 — session containment
+                    row = {"id": rid, "error": f"internal_error: {exc}"}
+                if trace_id is not None:
+                    # echo the upstream hop's trace like content rows
+                    # do — the fleet router's pipelining cross-check
+                    # rides this field on relayed diff verbs
+                    row["trace"] = trace_id
             else:
                 row = payload
             try:
@@ -229,31 +286,74 @@ class _Session:
                 return
             self._emit("reload", _ReloadHandle(self.batcher, rid, source))
             return
+        if op == "diff":
+            # the normalized-blob-vs-template word diff (diffverb.py):
+            # same content body as a classification row, plus an
+            # optional "license" key naming the comparison target
+            content, err = _parse_content(msg)
+            if err is not None:
+                self._emit("raw", {"id": rid, "error": err})
+                return
+            size = (
+                len(content)
+                if isinstance(content, bytes)
+                else len(content.encode("utf-8"))
+            )
+            if size > 64 * 1024:
+                # the same MAX_LICENSE_SIZE cap every ingestion path
+                # enforces — measured in BYTES whichever encoding the
+                # content arrived in — and the bound that keeps the
+                # word-diff's worst case (adversarial repetitive text
+                # vs the widest template) to ~0.3 s on the session
+                # writer
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: diff content exceeds the "
+                     "64 KiB MAX_LICENSE_SIZE cap"},
+                )
+                return
+            filename = msg.get("filename")
+            if filename is not None and not isinstance(filename, str):
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: filename must be a string"},
+                )
+                return
+            license_key = msg.get("license")
+            if license_key is not None and not isinstance(license_key, str):
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: license must be a string"},
+                )
+                return
+            trace_id = msg.get("trace")
+            if trace_id is not None and (
+                not isinstance(trace_id, str)
+                or not TRACE_ID_RE.match(trace_id)
+            ):
+                self._emit(
+                    "raw",
+                    {"id": rid,
+                     "error": "bad_request: trace must be 16 lowercase "
+                     "hex"},
+                )
+                return
+            self._emit(
+                "diff", (rid, content, filename, license_key, trace_id)
+            )
+            return
         if op is not None:
             self._emit(
                 "raw", {"id": rid, "error": f"bad_request: unknown op {op!r}"}
             )
             return
-        if "content_b64" in msg:
-            try:
-                content = base64.b64decode(msg["content_b64"])
-            except (ValueError, TypeError) as exc:
-                self._emit(
-                    "raw", {"id": rid, "error": f"bad_request: {exc}"}
-                )
-                return
-        else:
-            content = msg.get("content")
-            if not isinstance(content, str):
-                self._emit(
-                    "raw",
-                    {
-                        "id": rid,
-                        "error": "bad_request: missing 'content' "
-                        "(or 'content_b64') string",
-                    },
-                )
-                return
+        content, err = _parse_content(msg)
+        if err is not None:
+            self._emit("raw", {"id": rid, "error": err})
+            return
         # client-controlled fields are type-checked HERE: a malformed
         # value must cost its sender one error line, never the server
         filename = msg.get("filename")

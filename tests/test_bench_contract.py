@@ -192,6 +192,15 @@ def _fat_details() -> dict:
             "speedup": 99.99,
             "predicted_speedup": 99.99,
         },
+        "ingest": {
+            "files": 1_000_000,
+            "loose_files_per_sec": 99_999_999.9,
+            "tar_files_per_sec": 99_999_999.9,
+            "vs_loose": 99.999,
+            "identical_output": True,
+            "container_rows": 99_999_999,
+            "container_license": "x" * 40,
+        },
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
         "scalar_agreement": {
@@ -228,8 +237,9 @@ def test_headline_line_fits_driver_capture(bench_mod):
     assert n <= bench_mod.HEADLINE_BYTE_BUDGET, n
     # and inside the driver's ~2000-char tail even with the TPU-plugin
     # warning line sharing the tail window (the BENCH_r06.json file
-    # artifact is the durable copy regardless)
-    assert n <= 1700
+    # artifact is the durable copy regardless); re-pinned 1700 -> 1800
+    # when the streaming-ingest block joined the headline
+    assert n <= 1800
 
 
 def test_headline_carries_the_headline_numbers(bench_mod):
@@ -274,6 +284,11 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["stripes"]["speedup"] == 99.99
     assert d["stripes"]["predicted_speedup"] == 99.99
     assert d["stripes"]["identical_output"] is True
+    # the streaming-ingestion scalars (PR 14): tar-source rate vs the
+    # loose-file path on the same blob set + the bit-identical gate
+    assert d["ingest"]["tar_files_per_sec"] == 99_999_999.9
+    assert d["ingest"]["vs_loose"] == 99.999
+    assert d["ingest"]["identical_output"] is True
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 
@@ -282,9 +297,12 @@ def test_headline_survives_missing_rows(bench_mod):
     balloon."""
     details = _fat_details()
     for k in ("end_to_end_1m", "end_to_end_1m_auto", "scalar_agreement",
-              "end_to_end_readme", "serve_path", "fleet", "stripes"):
+              "end_to_end_readme", "serve_path", "fleet", "stripes",
+              "ingest"):
         details[k] = None
     headline = bench_mod.make_headline("m", 1.0, 1.0, details)
+    assert headline["details"]["ingest"]["tar_files_per_sec"] is None
+    assert headline["details"]["ingest"]["identical_output"] is None
     assert headline["details"]["at_scale_license"]["resume_ok"] is None
     assert headline["details"]["e2e_files_per_sec"]["readme"] is None
     assert headline["details"]["serve_path"]["cached_rps"] is None
@@ -313,6 +331,19 @@ def test_fast_mode_fleet_keys_say_skipped(bench_mod):
     for key in ("edge_sat_rps", "edge_sat_p99_ms", "sat_rps"):
         assert fleet[key] == "skipped"
     # and the stamped line still fits the driver capture
+    line = json.dumps(headline, separators=(",", ":"))
+    assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
+
+
+def test_fast_mode_ingest_keys_say_skipped(bench_mod):
+    """The PR 14 satellite: fast mode stamps the details.ingest
+    headline keys "skipped" — not-run must never read as broken."""
+    details = _fat_details()
+    details["ingest"] = "skipped"
+    headline = bench_mod.make_headline("m", 1.0, 1.0, details)
+    ingest = headline["details"]["ingest"]
+    assert set(ingest) == set(bench_mod.INGEST_HEADLINE_KEYS)
+    assert all(v == "skipped" for v in ingest.values()), ingest
     line = json.dumps(headline, separators=(",", ":"))
     assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
 

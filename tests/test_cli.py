@@ -413,3 +413,53 @@ def test_fleet_requires_socket_or_selftest(capsys):
     err = capsys.readouterr().err
     assert rc == 1
     assert "--socket" in err
+
+
+def test_diff_socket_speaks_the_wire_verb(capsys, monkeypatch):
+    """`diff --socket` sends one {"op": "diff"} round trip and renders
+    the worker's payload (the wire itself is covered in test_serve)."""
+    import importlib
+
+    cli = importlib.import_module("licensee_tpu.cli.main")
+
+    sent = {}
+
+    def fake_scrape(socket_path, request, timeout):
+        sent.update(socket=socket_path, request=request)
+        return {
+            "id": None,
+            "diff": {
+                "key": "mit", "spdx_id": "MIT", "similarity": 98.4,
+                "identical": False, "input_length": 10,
+                "license_length": 11, "diff": "shared [-old-]{+new+}",
+            },
+        }
+
+    monkeypatch.setattr(cli, "_scrape_row", fake_scrape)
+    rc, out = run_cli(
+        ["diff", fixture_path("mit"), "--socket", "/tmp/w.sock"], capsys
+    )
+    assert rc == 0
+    assert sent["socket"] == "/tmp/w.sock"
+    assert sent["request"]["op"] == "diff"
+    assert "content" in sent["request"]
+    assert "Comparing to MIT:" in out
+    assert "{+new+}" in out
+
+
+def test_diff_socket_surfaces_unknown_license(capsys, monkeypatch):
+    import importlib
+
+    cli = importlib.import_module("licensee_tpu.cli.main")
+
+    monkeypatch.setattr(
+        cli, "_scrape_row",
+        lambda *_a: {"id": None, "error": "unknown_license: nope"},
+    )
+    rc = main([
+        "diff", fixture_path("mit"), "--socket", "/tmp/w.sock",
+        "--license", "nope",
+    ])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "unknown_license" in err

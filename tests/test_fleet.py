@@ -103,6 +103,19 @@ def test_router_dispatches_to_least_loaded(stub_fleet):
     assert {r["worker"] for r in rows} == {"w_idle"}
 
 
+def test_router_relays_the_diff_verb(stub_fleet):
+    """The diff verb is stateless and idempotent, so the front door
+    relays it to a worker like a content row (with the spliced trace
+    echoed back through the pipelining cross-check)."""
+    sockets = {"w0": stub_fleet.spawn("w0")}
+    with Router(sockets, probe_interval_s=0.05) as router:
+        row = router.dispatch(
+            {"id": 7, "op": "diff", "content": "some license text"}
+        )
+    assert row["id"] == 7
+    assert row["diff"]["key"] == "stub-mit"
+
+
 def test_router_failover_on_worker_sigkill(stub_fleet):
     """Continuous load, one worker SIGKILLed mid-stream: zero client-
     visible errors — the dead worker's in-flight requests retry on the
@@ -283,16 +296,20 @@ def test_front_socket_session_end_to_end(stub_fleet, tmp_path):
                     {"id": 4, "op": "stats", "format": "prometheus"},
                     {"id": 5, "op": "trace", "n": 5},
                     {"id": 6, "op": "nope"},
+                    # the word-diff verb relays through the front door
+                    # like a content row (stateless, any worker)
+                    {"id": 7, "op": "diff", "content": "blob"},
                 ):
                     f.write(json.dumps(row).encode() + b"\n")
                 f.flush()
-                rows = [json.loads(f.readline()) for _ in range(6)]
+                rows = [json.loads(f.readline()) for _ in range(7)]
         finally:
             server.shutdown()
             server.server_close()
             thread.join(timeout=5.0)
-    assert [r["id"] for r in rows] == [1, 2, 3, 4, 5, 6]
+    assert [r["id"] for r in rows] == [1, 2, 3, 4, 5, 6, 7]
     assert rows[0]["key"] == "stub-mit" and rows[1]["key"] == "stub-mit"
+    assert rows[6]["diff"]["key"] == "stub-mit"
     fleet_stats = rows[2]["stats"]
     assert fleet_stats["router"]["ok"] >= 2
     assert fleet_stats["backends"]["w0"]["healthy"] is True

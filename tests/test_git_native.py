@@ -102,6 +102,10 @@ def test_native_bare_repo(packed_repo, tmp_path):
 
 
 def test_native_blob_cap(packed_repo):
+    """A blob past MAX_LICENSE_SIZE is SKIPPED (None), never truncated
+    and scored — a 64 KiB head can match a license the rest of the
+    file contradicts (the ingest-consistency contract; the project
+    layer drops skipped candidates entirely)."""
     with open(os.path.join(packed_repo, "BIG"), "wb") as f:
         f.write(b"x" * (200 * 1024))
     subprocess.run(["git", "add", "."], cwd=packed_repo, check=True,
@@ -110,7 +114,9 @@ def test_native_blob_cap(packed_repo):
                    check=True, capture_output=True)
     native = _NativeBackend(packed_repo, None)
     big = [f for f in native.files() if f["name"] == "BIG"][0]
-    assert len(native.load_file(big)) == 64 * 1024  # MAX_LICENSE_SIZE cap
+    assert native.load_file(big) is None  # MAX_LICENSE_SIZE: skip
+    small = [f for f in native.files() if f["name"] == "LICENSE"][0]
+    assert native.load_file(small)  # under the cap: real bytes
     native.close()
 
 

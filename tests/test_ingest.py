@@ -1,0 +1,722 @@
+"""Streaming container ingestion (licensee_tpu/ingest/): the ``::``
+manifest grammar, tar/zip/git blob sources, the 64 KiB skip-not-
+truncate cap, loose-vs-container output parity (the golden gate),
+torn-container refusal, resume at container granularity, and the
+container-level verdict algebra's parity with projects/project.py.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import subprocess
+import tarfile
+import zipfile
+
+import pytest
+
+from licensee_tpu.ingest import OVERSIZED, SkippedBlob
+from licensee_tpu.ingest.sources import (
+    IngestError,
+    expand_manifest,
+    is_container_entry,
+    split_entry,
+)
+from licensee_tpu.ingest.verdict import container_verdict
+
+
+def _body(key: str) -> str:
+    from licensee_tpu.corpus.license import License
+
+    return re.sub(r"\[(\w+)\]", "example", License.find(key).content or "")
+
+
+def _make_tar(path, files: dict[str, bytes]) -> str:
+    with tarfile.open(path, "w") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name=name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+def _make_zip(path, files: dict[str, bytes]) -> str:
+    with zipfile.ZipFile(path, "w") as zf:
+        for name, data in files.items():
+            zf.writestr(name, data)
+    return str(path)
+
+
+# -- the :: entry grammar --
+
+
+def test_entry_grammar():
+    assert split_entry("/x/archive.tar::LICENSE") == (
+        "/x/archive.tar", "LICENSE",
+    )
+    assert split_entry("/x/a.zip::*") == ("/x/a.zip", "*")
+    assert split_entry("/x/repo.git::HEAD") == ("/x/repo.git", "HEAD")
+    # member names may contain further colons: split on the FIRST ::
+    assert split_entry("a.tar::weird::name") == ("a.tar", "weird::name")
+    # plain paths — even with a lone "::" whose prefix is no container
+    assert split_entry("/plain/file.txt") is None
+    assert split_entry("/not-an-archive.bin::x") is None
+    assert not is_container_entry("/plain/file.txt")
+    assert is_container_entry("a.tar::*")
+
+
+def test_plain_directory_with_separator_stays_loose(tmp_path):
+    """A '::' entry whose prefix is an ordinary directory (no git
+    layout) is NOT a container claim: it stays a loose path whose
+    failed read is row-contained — one read_error row, never a fatal
+    IngestError for the whole run."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "v2").mkdir()
+    entry = f"{d}::v2/file.txt"
+    assert split_entry(entry) is None
+    assert not is_container_entry(entry)
+    project = BatchProject([entry], batch_size=8, mesh=None)
+    out = str(tmp_path / "out.jsonl")
+    try:
+        stats = project.run(out, resume=False)
+    finally:
+        project.close()
+    rows = [json.loads(line) for line in open(out)]
+    assert rows[0]["error"] == "read_error"
+    assert stats.read_errors == 1
+
+
+def test_explicit_member_routes_by_member_name(tmp_path):
+    """--mode auto must route an explicit `a.tar::LICENSE` entry by
+    the MEMBER's basename (its display string stays as written) —
+    the same blob must score identically however it is addressed."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = _make_tar(tmp_path / "a.tar", {"LICENSE": _body("mit").encode()})
+    out = str(tmp_path / "out.jsonl")
+    project = BatchProject(
+        [f"{tar}::LICENSE"], batch_size=8, mesh=None, mode="auto"
+    )
+    try:
+        stats = project.run(out, resume=False)
+    finally:
+        project.close()
+    row = json.loads(open(out).readline())
+    assert row["path"] == f"{tar}::LICENSE"  # display as written
+    assert row["key"] == "mit"  # routed + scored like a loose LICENSE
+    assert stats.routed == {"license": 1}
+
+
+def test_zip_duplicate_members_collapse_to_last(tmp_path):
+    """Duplicate member names INSIDE one zip (an appended archive)
+    collapse to one row of the archive's effective copy — last wins,
+    like extraction — instead of emitting rows whose bytes silently
+    all come from the last occurrence."""
+    zp = str(tmp_path / "dup.zip")
+    with zipfile.ZipFile(zp, "w") as zf:
+        zf.writestr("LICENSE", "first copy")
+        zf.writestr("LICENSE", "second copy")
+    ex = expand_manifest([f"{zp}::*"])
+    try:
+        assert ex.paths == ["LICENSE"]
+        assert ex.read_at(0) == b"second copy"
+        assert ex.spans == [(f"{zp}::*", 0, 1)]
+    finally:
+        ex.close()
+
+
+def test_empty_selector_refused(tmp_path):
+    tar = _make_tar(tmp_path / "a.tar", {"LICENSE": b"x"})
+    with pytest.raises(IngestError, match="empty selector"):
+        expand_manifest([f"{tar}::"])
+
+
+def test_compressed_tar_refused(tmp_path):
+    import gzip
+
+    plain = _make_tar(tmp_path / "a.tar", {"LICENSE": b"x"})
+    gz = tmp_path / "a.tar.gz"
+    with open(plain, "rb") as src, gzip.open(gz, "wb") as dst:
+        dst.write(src.read())
+    with pytest.raises(IngestError, match="compressed tar"):
+        expand_manifest([f"{gz}::*"])
+
+
+# -- readers: members, caps, positional reads --
+
+
+def test_tar_reader_order_cap_and_missing(tmp_path):
+    tar = _make_tar(
+        tmp_path / "a.tar",
+        {
+            "z_first": b"zz",
+            "a_second": b"aa",
+            "BIG": b"x" * (64 * 1024 + 1),
+        },
+    )
+    ex = expand_manifest([f"{tar}::*"])
+    try:
+        # archive order, not sorted
+        assert ex.paths == ["z_first", "a_second", "BIG"]
+        assert ex.read_at(0) == b"zz"
+        big = ex.read_at(2)
+        assert isinstance(big, SkippedBlob) and big.error == OVERSIZED
+        assert ex.spans == [(f"{tar}::*", 0, 3)]
+    finally:
+        ex.close()
+    # an explicit member that does not exist: a read_error row, not a
+    # refusal — the container itself is sound
+    ex = expand_manifest([f"{tar}::nope"])
+    try:
+        assert ex.paths == [f"{tar}::nope"]
+        assert ex.read_at(0) is None
+        assert ex.spans == []  # single members get no container span
+    finally:
+        ex.close()
+
+
+def test_zip_reader_and_cap(tmp_path):
+    zp = _make_zip(
+        tmp_path / "a.zip",
+        {"LICENSE": _body("mit").encode(), "BIG": b"y" * (65 * 1024)},
+    )
+    ex = expand_manifest([f"{zp}::*"])
+    try:
+        assert ex.paths == ["LICENSE", "BIG"]
+        assert ex.read_at(0) == _body("mit").encode()
+        assert isinstance(ex.read_at(1), SkippedBlob)
+    finally:
+        ex.close()
+
+
+def test_duplicate_member_names_across_containers(tmp_path):
+    """Two containers holding the same member name: reads are
+    positional, so each row gets its own container's bytes."""
+    t1 = _make_tar(tmp_path / "one.tar", {"LICENSE": b"first"})
+    t2 = _make_tar(tmp_path / "two.tar", {"LICENSE": b"second"})
+    ex = expand_manifest([f"{t1}::*", f"{t2}::*"])
+    try:
+        assert ex.paths == ["LICENSE", "LICENSE"]
+        assert ex.read_at(0) == b"first"
+        assert ex.read_at(1) == b"second"
+    finally:
+        ex.close()
+
+
+def test_mixed_manifest_spans(tmp_path):
+    loose = tmp_path / "loose.txt"
+    loose.write_bytes(b"loose bytes")
+    tar = _make_tar(tmp_path / "a.tar", {"m1": b"1", "m2": b"2"})
+    ex = expand_manifest([str(loose), f"{tar}::m1", f"{tar}::*"])
+    try:
+        assert ex.paths == [str(loose), f"{tar}::m1", "m1", "m2"]
+        assert ex.read_at(0) == b"loose bytes"
+        assert ex.read_at(1) == b"1"
+        assert ex.spans == [(f"{tar}::*", 2, 2)]
+    finally:
+        ex.close()
+
+
+def test_oversized_loose_file_skipped(tmp_path):
+    from licensee_tpu.serve.featurize import read_capped
+
+    big = tmp_path / "BIG_LICENSE"
+    big.write_bytes(b"z" * (64 * 1024 + 1))
+    got = read_capped(str(big))
+    assert isinstance(got, SkippedBlob) and got.error == OVERSIZED
+    ok = tmp_path / "ok"
+    ok.write_bytes(b"z" * (64 * 1024))  # exactly at the cap: kept
+    assert read_capped(str(ok)) == b"z" * (64 * 1024)
+
+
+# -- torn-container refusal --
+
+
+def test_failed_expansion_leaks_no_handles(tmp_path):
+    """A torn container midway through a manifest must close the
+    handles already opened for the containers before it."""
+    good = _make_tar(tmp_path / "good.tar", {"LICENSE": b"x"})
+    torn = str(tmp_path / "torn.tar")
+    _make_tar(torn, {"LICENSE": _body("mit").encode() * 4})
+    with open(torn, "r+b") as f:
+        f.truncate(1000)
+    before = len(os.listdir("/proc/self/fd"))
+    with pytest.raises(IngestError):
+        expand_manifest([f"{good}::*", f"{torn}::*"])
+    assert len(os.listdir("/proc/self/fd")) == before
+
+
+def test_oversized_prom_kind_exported(tmp_path, capsys):
+    """The skipped_oversized counter reaches the --prom-file
+    exposition beside every other result kind."""
+    from licensee_tpu.cli.main import main
+
+    big = tmp_path / "BIG_LICENSE"
+    big.write_bytes(b"x" * (70 * 1024))
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{big}\n")
+    prom = tmp_path / "run.prom"
+    rc = main([
+        "batch-detect", str(manifest), "--output",
+        str(tmp_path / "o.jsonl"), "--mesh", "none",
+        "--prom-file", str(prom),
+    ])
+    assert rc == 0
+    text = prom.read_text()
+    assert 'batch_rows{kind="skipped_oversized"} 1' in text
+
+
+def test_torn_tar_refused(tmp_path):
+    tar = _make_tar(
+        tmp_path / "a.tar", {"LICENSE": _body("mit").encode() * 4}
+    )
+    with open(tar, "r+b") as f:
+        f.truncate(1000)  # keep the header, tear the member data
+    with pytest.raises(IngestError):
+        expand_manifest([f"{tar}::*"])
+
+
+def test_garbage_zip_refused(tmp_path):
+    bad = tmp_path / "bad.zip"
+    bad.write_bytes(b"this is not a zip central directory")
+    with pytest.raises(IngestError, match="cannot read zip"):
+        expand_manifest([f"{bad}::*"])
+
+
+def test_truncated_git_pack_refused(git_repo):
+    repo = git_repo
+    # corrupt every packfile and loose object: the revision's root tree
+    # becomes unreachable and expansion must refuse, not emit rows
+    for root, _dirs, files in os.walk(os.path.join(repo, ".git", "objects")):
+        for name in files:
+            p = os.path.join(root, name)
+            os.chmod(p, 0o644)
+            with open(p, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(p) // 4))
+    with pytest.raises(IngestError):
+        expand_manifest([f"{repo}::HEAD"])
+
+
+# -- git containers --
+
+
+@pytest.fixture
+def git_repo(tmp_path):
+    repo = str(tmp_path / "proj.git")
+    os.makedirs(repo)
+    env = {
+        **os.environ,
+        "GIT_CONFIG_GLOBAL": "/dev/null",
+        "GIT_CONFIG_SYSTEM": "/dev/null",
+    }
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-C", repo, *args],
+            check=True, capture_output=True, env=env,
+        )
+
+    git("init", "-q")
+    with open(os.path.join(repo, "LICENSE"), "w", encoding="utf-8") as f:
+        f.write(_body("isc"))
+    with open(os.path.join(repo, "BIG"), "wb") as f:
+        f.write(b"x" * (80 * 1024))
+    os.makedirs(os.path.join(repo, "src"))
+    with open(os.path.join(repo, "src", "x.py"), "w") as f:
+        f.write("pass\n")
+    git("add", ".")
+    git("-c", "user.email=a@b", "-c", "user.name=n", "commit", "-qm", "x")
+    # repack so the blobs live in a packfile, the forge-scan shape
+    git("gc", "-q", "--aggressive")
+    return repo
+
+
+def test_git_container_root_tree_and_cap(git_repo):
+    ex = expand_manifest([f"{git_repo}::HEAD"])
+    try:
+        # root-level blobs only (git_project.rb:64-76) — src/x.py is not
+        # a root entry
+        assert set(ex.paths) == {"LICENSE", "BIG"}
+        i_lic = ex.paths.index("LICENSE")
+        i_big = ex.paths.index("BIG")
+        assert ex.read_at(i_lic).decode("utf-8") == _body("isc")
+        assert isinstance(ex.read_at(i_big), SkippedBlob)  # the 64 KiB cap
+    finally:
+        ex.close()
+
+
+def test_git_container_end_to_end(git_repo, tmp_path):
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    out = str(tmp_path / "git.jsonl")
+    project = BatchProject([f"{git_repo}::HEAD"], batch_size=8, mesh=None)
+    try:
+        stats = project.run(out, resume=False)
+    finally:
+        project.close()
+    rows = {r["path"]: r for r in map(json.loads, open(out))}
+    assert rows["LICENSE"]["key"] == "isc"
+    assert rows["BIG"]["error"] == "oversized"
+    assert stats.skipped_oversized == 1
+    containers = [
+        json.loads(line) for line in open(f"{out}.containers.jsonl")
+    ]
+    assert containers == [
+        {
+            "container": f"{git_repo}::HEAD",
+            "files": 2,
+            "license": "isc",
+            "licenses": ["isc"],
+            "matched_files": ["LICENSE"],
+        }
+    ]
+
+
+# -- the golden parity gate: containers of the vendored corpus --
+
+
+@pytest.mark.slow
+def test_vendored_corpus_container_parity(tmp_path):
+    """A tarball AND a zip of the vendored corpus must yield
+    byte-identical (sha256) per-blob JSONL to the loose-file manifest
+    run — the acceptance gate for the streaming sources."""
+    import hashlib
+
+    from licensee_tpu.projects.batch_project import BatchProject
+    from licensee_tpu.vendor_paths import LICENSE_DIR
+
+    paths = sorted(
+        os.path.join(LICENSE_DIR, n)
+        for n in os.listdir(LICENSE_DIR)
+        if n.endswith(".txt")
+    )
+    assert len(paths) >= 40
+    files = {}
+    for p in paths:
+        with open(p, "rb") as f:
+            files[p] = f.read()  # members stored under the loose names
+    tar = _make_tar(tmp_path / "corpus.tar", files)
+    zp = _make_zip(tmp_path / "corpus.zip", files)
+
+    digests = {}
+    for label, manifest in (
+        ("loose", paths),
+        ("tar", [f"{tar}::*"]),
+        ("zip", [f"{zp}::*"]),
+    ):
+        out = str(tmp_path / f"{label}.jsonl")
+        project = BatchProject(manifest, batch_size=16, mesh=None)
+        try:
+            project.run(out, resume=False)
+        finally:
+            project.close()
+        with open(out, "rb") as f:
+            digests[label] = hashlib.sha256(f.read()).hexdigest()
+    assert digests["tar"] == digests["loose"]
+    assert digests["zip"] == digests["loose"]
+
+
+# -- resume at container granularity --
+
+
+@pytest.mark.slow
+def test_resume_mid_container(tmp_path):
+    """A run killed mid-container (simulated as the torn output a
+    SIGKILL leaves: a complete prefix plus half a row) must resume to
+    byte-identical per-blob output AND an identical container-verdict
+    sidecar."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    files = {
+        f"repo/LICENSE_{i:02d}": (
+            f"Copyright (c) {2000 + i}\n\n{_body('mit')}"
+        ).encode()
+        for i in range(24)
+    }
+    tar = _make_tar(tmp_path / "r.tar", files)
+    entry = f"{tar}::*"
+
+    golden = str(tmp_path / "golden.jsonl")
+    project = BatchProject([entry], batch_size=8, mesh=None, dedupe=False)
+    try:
+        project.run(golden, resume=False)
+    finally:
+        project.close()
+    with open(golden, "rb") as f:
+        golden_bytes = f.read()
+    with open(f"{golden}.containers.jsonl", "rb") as f:
+        golden_containers = f.read()
+
+    # fabricate the crash artifact: 10 complete rows + a torn 11th,
+    # beside the sidecar the dead run wrote at open
+    out = str(tmp_path / "resumed.jsonl")
+    lines = golden_bytes.split(b"\n")
+    with open(out, "wb") as f:
+        f.write(b"\n".join(lines[:10]) + b"\n" + lines[10][: len(lines[10]) // 2])
+    with open(f"{golden}.meta.json", "rb") as f:
+        meta = f.read()
+    with open(f"{out}.meta.json", "wb") as f:
+        f.write(meta)
+
+    project = BatchProject([entry], batch_size=8, mesh=None, dedupe=False)
+    try:
+        project.run(out, resume=True)
+    finally:
+        project.close()
+    with open(out, "rb") as f:
+        assert f.read() == golden_bytes
+    with open(f"{out}.containers.jsonl", "rb") as f:
+        assert f.read() == golden_containers
+
+
+def test_rewritten_container_refuses_resume(tmp_path):
+    """The expansion fingerprint in the resume sidecar: an archive
+    rewritten between runs (different member set) must refuse to
+    resume instead of appending rows of a foreign container."""
+    from licensee_tpu.projects.batch_project import (
+        BatchProject,
+        ResumeConfigError,
+    )
+
+    tar = str(tmp_path / "a.tar")
+    _make_tar(tar, {"LICENSE": _body("mit").encode(), "A": b"a"})
+    out = str(tmp_path / "out.jsonl")
+    project = BatchProject([f"{tar}::*"], batch_size=8, mesh=None)
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    _make_tar(tar, {"LICENSE": _body("mit").encode(), "B": b"b"})
+    project = BatchProject([f"{tar}::*"], batch_size=8, mesh=None)
+    try:
+        with pytest.raises(ResumeConfigError, match="ingest"):
+            project.run(out, resume=True)
+    finally:
+        project.close()
+
+
+def test_rewritten_content_same_names_refuses_resume(tmp_path):
+    """Same member NAMES, different bytes: the fingerprint folds
+    content evidence (tar layout/mtimes, zip CRCs, git oids), so a
+    repacked archive still refuses instead of appending rows scored
+    from different content."""
+    from licensee_tpu.projects.batch_project import (
+        BatchProject,
+        ResumeConfigError,
+    )
+
+    zp = str(tmp_path / "a.zip")
+    _make_zip(zp, {"LICENSE": _body("mit").encode(), "A": b"old bytes"})
+    out = str(tmp_path / "out.jsonl")
+    project = BatchProject([f"{zp}::*"], batch_size=8, mesh=None)
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    _make_zip(zp, {"LICENSE": _body("mit").encode(), "A": b"NEW BYTES"})
+    project = BatchProject([f"{zp}::*"], batch_size=8, mesh=None)
+    try:
+        with pytest.raises(ResumeConfigError, match="ingest"):
+            project.run(out, resume=True)
+    finally:
+        project.close()
+
+
+# -- guardrails --
+
+
+def test_containers_refuse_striping_and_procs(tmp_path):
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = _make_tar(tmp_path / "a.tar", {"LICENSE": b"x"})
+    with pytest.raises(ValueError, match="striping"):
+        BatchProject(
+            [f"{tar}::*"], mesh=None,
+            process_index=0, process_count=2,
+        )
+    with pytest.raises(ValueError, match="featurize-procs"):
+        BatchProject([f"{tar}::*"], mesh=None, featurize_procs=2)
+
+
+def test_cli_stripes_refuses_containers(tmp_path, capsys):
+    from licensee_tpu.cli.main import main
+
+    tar = _make_tar(tmp_path / "a.tar", {"LICENSE": b"x"})
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{tar}::*\n")
+    rc = main([
+        "batch-detect", str(manifest), "--stripes", "2",
+        "--output", str(tmp_path / "o.jsonl"),
+    ])
+    assert rc == 1
+    assert "not supported with --stripes" in capsys.readouterr().err
+
+
+def test_cli_stdout_mode_prints_container_rows(tmp_path, capsys):
+    from licensee_tpu.cli.main import main
+
+    tar = _make_tar(
+        tmp_path / "a.tar",
+        {
+            "r/LICENSE-MIT": _body("mit").encode(),
+            "r/LICENSE-APACHE": _body("apache-2.0").encode(),
+            "r/BIG": b"x" * (70 * 1024),
+        },
+    )
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{tar}::*\n")
+    rc = main(["batch-detect", str(manifest), "--mesh", "none"])
+    assert rc == 0
+    rows = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+    ]
+    blob_rows = {r["path"]: r for r in rows if "path" in r}
+    assert blob_rows["r/LICENSE-MIT"]["key"] == "mit"
+    assert blob_rows["r/BIG"]["error"] == "oversized"
+    container_rows = [r for r in rows if "container" in r]
+    assert len(container_rows) == 1
+    assert container_rows[0]["license"] == "other"
+    assert container_rows[0]["spdx_expression"] == "MIT OR Apache-2.0"
+
+
+# -- the container verdict algebra (parity with projects/project.py) --
+
+
+def _fs_verdict(tmp_path, files: dict[str, bytes]):
+    from licensee_tpu.projects.fs_project import FSProject
+
+    d = tmp_path / "fsproj"
+    os.makedirs(d, exist_ok=True)
+    for name, data in files.items():
+        with open(d / name, "wb") as f:
+            f.write(data)
+    project = FSProject(str(d))
+    return (
+        project.license.key if project.license else None,
+        sorted(lic.key for lic in project.licenses),
+    )
+
+
+def _rows_for(files: dict[str, bytes], tmp_path, tag: str):
+    """Finished per-blob rows for a file set, via the real batch path."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = _make_tar(tmp_path / f"{tag}.tar", files)
+    out = str(tmp_path / f"{tag}.jsonl")
+    project = BatchProject([f"{tar}::*"], batch_size=8, mesh=None)
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    with open(f"{out}.containers.jsonl", encoding="utf-8") as f:
+        return json.load(f)
+
+
+VERDICT_SHAPES = {
+    "single": {"LICENSE": "mit"},
+    "dual": {"LICENSE-APACHE": "apache-2.0", "LICENSE-MIT": "mit"},
+    "lgpl_pair": {"COPYING.lesser": "lgpl-3.0", "COPYING": "gpl-3.0"},
+    "none": {},
+}
+
+
+@pytest.mark.parametrize("shape", sorted(VERDICT_SHAPES))
+def test_container_verdict_matches_project(shape, tmp_path):
+    """The acceptance gate: container licenses[] rows must match the
+    projects/project.py verdict on the same file set."""
+    files = {
+        name: _body(key).encode()
+        for name, key in VERDICT_SHAPES[shape].items()
+    }
+    files["README.md"] = b"# a readme\n"
+    row = _rows_for(files, tmp_path, shape)
+    fs_license, fs_keys = _fs_verdict(tmp_path, files)
+    assert row["license"] == fs_license
+    assert sorted(row["licenses"]) == fs_keys
+
+
+def test_verdict_dual_license_spdx_expression(tmp_path):
+    row = _rows_for(
+        {
+            "LICENSE-APACHE": _body("apache-2.0").encode(),
+            "LICENSE-MIT": _body("mit").encode(),
+        },
+        tmp_path,
+        "dual_spdx",
+    )
+    # reference verdict preserved (multi-license -> other), expression
+    # composed on top — archive order decides the operand order
+    assert row["license"] == "other"
+    assert row["spdx_expression"] == "Apache-2.0 OR MIT"
+
+
+def test_verdict_unmatched_license_file_is_other():
+    # license_file.rb:92-98: a scored license file failing every
+    # matcher still counts as 'other'
+    row = container_verdict(
+        "c", [("LICENSE", {"key": None, "matcher": None, "confidence": 0.0})]
+    )
+    assert row["license"] == "other"
+    assert row["licenses"] == ["other"]
+    assert "spdx_expression" not in row
+
+
+def test_verdict_copyright_only_excluded():
+    # project.rb:153-155: COPYRIGHT-only files never decide the verdict
+    row = container_verdict(
+        "c",
+        [
+            ("COPYRIGHT", {
+                "key": "no-license", "matcher": "copyright",
+                "confidence": 100.0,
+            }),
+            ("LICENSE", {
+                "key": "mit", "matcher": "exact", "confidence": 100.0,
+            }),
+        ],
+    )
+    assert row["license"] == "mit"
+    # score order: LICENSE (1.0) before COPYRIGHT (0.35), project.rb:111
+    assert row["licenses"] == ["mit", "no-license"]
+
+
+def test_verdict_shared_prefix_root_only():
+    # nested members never count as root candidates; the shared
+    # top-level wrapper (forge tarball shape) is stripped first
+    row = container_verdict(
+        "c",
+        [
+            ("repo-1.0/LICENSE", {
+                "key": "mit", "matcher": "exact", "confidence": 100.0,
+            }),
+            ("repo-1.0/vendor/LICENSE", {
+                "key": "apache-2.0", "matcher": "exact",
+                "confidence": 100.0,
+            }),
+        ],
+    )
+    assert row["license"] == "mit"
+    assert row["matched_files"] == ["LICENSE"]
+
+
+def test_verdict_errored_rows_never_candidates():
+    row = container_verdict(
+        "c",
+        [
+            ("LICENSE", {
+                "key": None, "matcher": None, "confidence": 0.0,
+                "error": "oversized",
+            }),
+            ("COPYING", {
+                "key": "gpl-3.0", "matcher": "exact", "confidence": 100.0,
+            }),
+        ],
+    )
+    assert row["license"] == "gpl-3.0"
+    assert row["matched_files"] == ["COPYING"]

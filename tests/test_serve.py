@@ -703,6 +703,115 @@ def test_unix_socket_transport(clf, mit_body, tmp_path):
     assert sched["device_rows"] == 1  # the duplicate never hit the device
 
 
+# -- the diff verb (normalized blob vs template word diff) --
+
+
+def test_diff_verb_roundtrips_over_worker_socket(clf, mit_body, tmp_path):
+    """The acceptance drill: {"op":"diff"} over a real worker socket —
+    closest-template pick, named-license pick, and the
+    unknown_license refusal, all on one session."""
+    path = str(tmp_path / "diff.sock")
+    blob = mit_body + "\nan extra tail clause\n"
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0, buckets=(4,)) as b:
+        server = UnixServer(path, b)
+        thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        thread.start()
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(path)
+                f = s.makefile("rwb")
+                for row in (
+                    {"id": 1, "op": "diff", "content": blob,
+                     "filename": "LICENSE"},
+                    {"id": 2, "op": "diff", "content": mit_body,
+                     "license": "mit"},
+                    {"id": 3, "op": "diff", "content": blob,
+                     "license": "not-a-license"},
+                    {"id": 4, "op": "diff"},
+                ):
+                    f.write(json.dumps(row).encode() + b"\n")
+                f.flush()
+                rows = [json.loads(f.readline()) for _ in range(4)]
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5.0)
+    by_id = {r["id"]: r for r in rows}
+    closest = by_id[1]["diff"]
+    assert closest["key"] == "mit"
+    assert not closest["identical"]
+    assert "{+an extra tail clause+}" in closest["diff"]
+    assert 0.0 < closest["similarity"] <= 100.0
+    named = by_id[2]["diff"]
+    assert named["key"] == "mit" and named["identical"]
+    assert named["diff"] == ""
+    assert by_id[3]["error"].startswith("unknown_license")
+    assert by_id[4]["error"].startswith("bad_request")
+
+
+def test_word_diff_replace_matches_git_inline_form():
+    from licensee_tpu.normalize.worddiff import word_diff
+
+    # git --word-diff renders a replaced run as one adjacent pair
+    assert word_diff("a b c", "a x c") == "a [-b-]{+x+} c"
+    assert word_diff("a b", "a") == "a [-b-]"
+    assert word_diff("a", "a b") == "a {+b+}"
+    assert word_diff("same", "same") == "same"
+
+
+def test_diff_payload_fenced_to_the_serving_corpus():
+    """The blue/green fence: the diff verb must never rank or validate
+    against a template outside the LIVE corpus — the diff and the
+    verdict name the same epoch or the verb refuses."""
+    from licensee_tpu.corpus.compiler import CompiledCorpus
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.serve.diffverb import (
+        UnknownLicenseError,
+        diff_payload,
+    )
+
+    isc_only = CompiledCorpus.compile([License.find("isc")])
+    mit_text = re.sub(
+        r"\[(\w+)\]", "example", License.find("mit").content or ""
+    )
+    # a key the corpus does not serve refuses, even though the vendored
+    # pool knows it
+    with pytest.raises(UnknownLicenseError):
+        diff_payload(mit_text, "LICENSE", "mit", corpus=isc_only)
+    # closest-mode never picks an out-of-pool template: MIT text ranks
+    # mit first in the vendored pool, but the fence yields isc
+    row = diff_payload(mit_text, "LICENSE", corpus=isc_only)
+    assert row["key"] == "isc"
+    # no corpus (corpusless/package-mode worker): vendored pool intact
+    assert diff_payload(mit_text, "LICENSE")["key"] == "mit"
+
+
+def test_diff_verb_validates_fields(clf, mit_body):
+    out: list[str] = []
+    with MicroBatcher(classifier=clf, max_delay_ms=5.0) as b:
+        serve_session(
+            b,
+            [
+                json.dumps({"id": 1, "op": "diff", "content": "x",
+                            "license": 7}),
+                json.dumps({"id": 2, "op": "diff", "content": "x",
+                            "filename": 7}),
+                json.dumps({"id": 3, "op": "diff",
+                            "content_b64": "%%%not-base64%%%"}),
+                json.dumps({"id": 4, "op": "diff",
+                            "content": "x" * (64 * 1024 + 1)}),
+            ],
+            out.append,
+        )
+    rows = [json.loads(line) for line in out]
+    assert all(r["error"].startswith("bad_request") for r in rows)
+    # the 64 KiB MAX_LICENSE_SIZE cap bounds the word-diff's cost too
+    assert "64 KiB" in rows[3]["error"]
+
+
 # -- the shared featurize helper (offline/online drift guard) --
 
 
