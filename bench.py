@@ -888,6 +888,65 @@ def bench_ingest(n_files: int = 4096) -> dict:
         row["container_license"] = (
             containers[0].get("license") if containers else None
         )
+
+        # -- the striped block (expanded-count striping): the SAME
+        # tarball split across 2 real worker subprocesses by its
+        # EXPANDED blob count (the container's blobs span both
+        # stripes), merge gated sha256-identical against the 1-process
+        # tar run above, and the per-stripe steady-state rate priced
+        # against the loose-file striping rate on the same blob set —
+        # the container source must not starve a striped featurize
+        # lane any more than it starves the single-process one
+        from licensee_tpu.parallel.stripes import StripeRunner
+
+        cores = os.cpu_count() or 1
+        striped: dict = {"stripes": 2}
+
+        def striped_run(label: str, entry_lines: list[str]) -> str:
+            manifest = os.path.join(tmpdir, f"striped-{label}.txt")
+            with open(manifest, "w", encoding="utf-8") as f:
+                f.write("\n".join(entry_lines) + "\n")
+            dest = os.path.join(tmpdir, f"striped-{label}.jsonl")
+            runner = StripeRunner(
+                manifest, dest, 2,
+                forward_args=(
+                    "--batch-size", "1024",
+                    "--workers", str(max(1, cores // 2)),
+                ),
+                base_env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            summary = runner.run()
+            # per-stripe steady-state rate: each stripe's own rows
+            # over its own in-child elapsed (excludes the per-child
+            # JAX boot a real forge run amortizes away), averaged
+            rates = []
+            for srow in summary["per_stripe"]:
+                stats = srow.get("stats") or {}
+                el = (stats.get("stage_seconds") or {}).get("elapsed")
+                if el:
+                    rates.append((stats.get("total") or 0) / el)
+            striped[f"{label}_per_stripe_files_per_sec"] = round(
+                sum(rates) / len(rates), 1
+            ) if rates else None
+            with open(dest, "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+
+        tar_digest = striped_run("tar", [f"{tar}::*"])
+        striped_run("loose", paths)
+        striped["identical_output"] = tar_digest == digests["tar"]
+        t_rate = striped["tar_per_stripe_files_per_sec"]
+        l_rate = striped["loose_per_stripe_files_per_sec"]
+        striped["vs_loose_striping"] = (
+            round(t_rate / l_rate, 3) if t_rate and l_rate else None
+        )
+        with open(
+            os.path.join(
+                tmpdir, "striped-tar.jsonl.containers.jsonl"
+            ),
+            encoding="utf-8",
+        ) as f:
+            striped["container_rows"] = sum(1 for _ in f)
+        row["striped"] = striped
         return row
 
 
@@ -1837,11 +1896,12 @@ def bench_edge_saturation(
 # byte-budgeted: bounded scalar summaries only, with the open-ended
 # per-row blobs written to BENCH_DETAILS.json instead.
 # raised 1500 -> 1700 for the r6 obs.slo/traces scalars: the driver
-# tail captures ~2000 chars, and 1700 + a TPU-plugin warning line
+# tail captures ~2000 chars, and 1850 + a TPU-plugin warning line
 # still fits (tests/test_bench_contract.py pins this against a
 # worst-case details dict) — and BENCH_r06.json now carries the same
-# headline as a FILE, so the stdout window is no longer load-bearing
-HEADLINE_BYTE_BUDGET = 1800
+# headline as a FILE, so the stdout window is no longer load-bearing.
+# Re-pinned 1800 -> 1850 when the striped_* ingest keys joined (PR 15).
+HEADLINE_BYTE_BUDGET = 1850
 
 # the driver-facing headline artifact, written UNCONDITIONALLY by
 # main() (fast mode included) so a skipped or truncated stdout capture
@@ -1911,8 +1971,10 @@ FLEET_HEADLINE_KEYS = (
 
 # the headline's streaming-ingestion block — fast mode stamps exactly
 # this set "skipped"; tests/test_bench_contract.py pins the members
+# (striped_* joined in PR 15: the expanded-count striping gate)
 INGEST_HEADLINE_KEYS = (
     "tar_files_per_sec", "vs_loose", "identical_output",
+    "striped_identical", "striped_vs_loose",
 )
 
 
@@ -2076,6 +2138,16 @@ def make_headline(
                     "tar_files_per_sec": ingest.get("tar_files_per_sec"),
                     "vs_loose": ingest.get("vs_loose"),
                     "identical_output": ingest.get("identical_output"),
+                    # the expanded-count striping gate: 2-stripe tar
+                    # merge sha256-identical to the 1-process run, and
+                    # the per-stripe rate vs loose-file striping on
+                    # the same blobs (full row: details.ingest.striped)
+                    "striped_identical": (
+                        ingest.get("striped") or {}
+                    ).get("identical_output"),
+                    "striped_vs_loose": (
+                        ingest.get("striped") or {}
+                    ).get("vs_loose_striping"),
                 }
             ),
             "details_file": "BENCH_DETAILS.json",

@@ -394,6 +394,7 @@ def _run_striped(args) -> int:
     # corpus once in this process (~seconds), paid only when a resume
     # target exists — and a mismatch fails here instead of through one
     # restart-backoff cycle per stripe.
+    probe_layout = None
     if not args.no_resume and os.path.exists(args.output) and (
         os.path.exists(f"{args.output}.meta.json")
     ):
@@ -407,6 +408,20 @@ def _run_striped(args) -> int:
             ResumeConfigError,
         )
 
+        # container manifests: the sidecar's expansion fingerprint is
+        # part of the compared config, so the probe must expand the
+        # SAME manifest (metadata-only pass; handles closed below) —
+        # a rewritten archive then refuses here, before any spawn
+        from licensee_tpu.ingest.sources import is_container_entry
+
+        probe_paths: list[str] = []
+        with open(args.manifest, encoding="utf-8") as f:
+            if any(is_container_entry(line.strip()) for line in f):
+                with open(args.manifest, encoding="utf-8") as f2:
+                    probe_paths = [
+                        line.strip() for line in f2 if line.strip()
+                    ]
+        probe = None
         try:
             # device=False: the probe needs only the compiled corpus
             # fingerprint — the supervisor process must never claim a
@@ -421,7 +436,7 @@ def _run_striped(args) -> int:
                 device=False,
             )
             probe = BatchProject(
-                [],
+                probe_paths,
                 classifier=classifier,
                 batch_size=args.batch_size,
                 threshold=args.confidence,
@@ -432,12 +447,19 @@ def _run_striped(args) -> int:
                 corpus_source=args.corpus,
             )
             probe._check_resume_config(args.output, resume=True)
+            if probe.ingest is not None:
+                # hand the probe's (unrestricted) expansion layout to
+                # the runner so it never re-scans the same archives
+                probe_layout = probe.ingest.layout()
         except ResumeConfigError as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 1
         except ValueError as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 1
+        finally:
+            if probe is not None:
+                probe.close()
 
     # everything row-shaping or perf-relevant forwards verbatim to the
     # workers; --workers splits the host's cores across stripes unless
@@ -495,6 +517,7 @@ def _run_striped(args) -> int:
             chips_per_stripe=args.chips_per_stripe,
             progress_every=args.progress,
             on_event=event,
+            container_layout=probe_layout,
         )
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
@@ -634,23 +657,10 @@ def cmd_batch_detect(args) -> int:
         )
         return 1
     if args.stripes is not None:
-        # striping is denominated in raw manifest ENTRIES; container
-        # entries expand to many rows, so the supervisor and workers
-        # would disagree about span arithmetic — refuse loudly here
-        # instead of corrupting a merge (single-process ingest works)
-        from licensee_tpu.ingest.sources import is_container_entry
-
-        with open(args.manifest, encoding="utf-8") as f:
-            has_containers = any(
-                is_container_entry(line.strip()) for line in f
-            )
-        if has_containers:
-            print(
-                "error: container manifest entries ('::' forms) are "
-                "not supported with --stripes yet; run single-process",
-                file=sys.stderr,
-            )
-            return 1
+        # container manifests stripe too: spans are denominated in
+        # EXPANDED blob counts (ingest/sources.py expanded_layout), so
+        # the runner and the workers agree on span arithmetic and a
+        # single million-member tarball splits across stripes
         return _run_striped(args)
     kwargs, err = _load_corpus(args.corpus)
     if err:
@@ -682,6 +692,23 @@ def cmd_batch_detect(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if process_count > 1:
+        from licensee_tpu.ingest.sources import is_container_entry
+
+        with open(args.manifest, encoding="utf-8") as f:
+            if any(is_container_entry(line.strip()) for line in f):
+                # containers stripe by expanded count across hosts, but
+                # with no merge step there is no merged output to derive
+                # the container sidecar from — say so instead of letting
+                # the missing artifact pass silently
+                print(
+                    "warning: container entries in a multi-host run "
+                    "write per-host blob shards only; the "
+                    ".containers.jsonl sidecar is derived from a MERGED "
+                    "output (the single-host --stripes runner does this "
+                    "automatically)",
+                    file=sys.stderr,
+                )
     # the stripe-worker rank (internal: the --stripes runner spawns
     # workers with these): same striping math as the multi-host path,
     # minus the jax.distributed bootstrap — co-located stripes share no
@@ -814,17 +841,33 @@ def cmd_batch_detect(args) -> int:
                 project.stats.total += 1
                 rows.append(row)
                 print(json.dumps(row))
-            if project.ingest is not None and project.ingest.spans:
+            if project.ingest is not None and (
+                project.ingest.spans or project.ingest.subsets
+            ):
                 # container-level verdict rows (the reference's
-                # Project#license algebra) after the per-blob stream
-                from licensee_tpu.ingest.verdict import container_verdict
+                # Project#license algebra) after the per-blob stream —
+                # whole-container spans AND explicitly-listed member
+                # subsets, same grouping as the sidecar writer
+                from licensee_tpu.ingest.verdict import (
+                    container_groups,
+                    container_verdict,
+                )
 
-                for entry, start, count in project.ingest.spans:
-                    span_rows = [
-                        (rows[i]["path"], rows[i])
-                        for i in range(start, start + count)
+                for label, members in container_groups(
+                    project.ingest.spans, project.ingest.subsets
+                ):
+                    group_rows = [
+                        (
+                            member
+                            if member is not None
+                            else rows[i]["path"],
+                            rows[i],
+                        )
+                        for i, member in members
                     ]
-                    print(json.dumps(container_verdict(entry, span_rows)))
+                    print(
+                        json.dumps(container_verdict(label, group_rows))
+                    )
             stats = project.stats
     finally:
         project.close()

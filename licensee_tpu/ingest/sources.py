@@ -28,6 +28,18 @@ cap (git_project.rb:53) by SKIPPING oversized blobs (a
 :class:`SkippedBlob` marker -> an ``"error": "oversized"`` output row),
 never by truncating and scoring the head.
 
+Striping denomination (``--stripes`` / multi-host ranks) is EXPANDED
+blob counts, not raw manifest entries: every rank runs the same
+metadata-only expansion of the full manifest (member tables, central
+directories, git root trees — no blob bytes), then
+:meth:`ManifestExpansion.restrict`\\ s itself to its span of the
+expanded rows, closing the handles of containers its span never
+touches.  A single million-member tarball therefore splits across
+stripes, each stripe ``read_at()``-ing only its own span.
+:func:`expanded_layout` is the supervisor-side twin: one counting pass
+that returns the total, the container groups, and the expansion
+fingerprint, with every handle closed before it returns.
+
 Torn containers fail closed: a truncated tar member table, a zip with
 a corrupt central directory, or a git repo whose pack cannot resolve
 the revision's root tree all raise :class:`IngestError` at expansion
@@ -54,9 +66,10 @@ from licensee_tpu.projects.git_project import MAX_LICENSE_SIZE
 
 SEP = "::"
 
-# recognized-but-unsupported compressed tar forms: random access into a
-# compressed stream is O(archive) per member, so the reader refuses
-# loudly instead of quietly rescanning gigabytes per blob
+# compressed tar forms: random access into a compressed stream is
+# O(archive) per member, so these route to the sequential-WINDOW reader
+# (_SeqTarContainer) — one forward decompression pass per stripe span,
+# never a from-zero rescan per blob
 _COMPRESSED_TAR_SUFFIXES = (".tar.gz", ".tgz", ".tar.bz2", ".tar.xz", ".tbz2", ".txz")
 
 
@@ -86,7 +99,9 @@ def is_container_entry(entry: str) -> bool:
 
 def _container_kind(container: str) -> str | None:
     low = container.lower()
-    if low.endswith(_COMPRESSED_TAR_SUFFIXES) or low.endswith(".tar"):
+    if low.endswith(_COMPRESSED_TAR_SUFFIXES):
+        return "ctar"
+    if low.endswith(".tar"):
         return "tar"
     if low.endswith(".zip"):
         return "zip"
@@ -117,10 +132,10 @@ class _TarContainer:
         import tarfile
 
         if path.lower().endswith(_COMPRESSED_TAR_SUFFIXES):
+            # defensive: open_container routes these to _SeqTarContainer
             raise IngestError(
-                f"compressed tar {path!r} is not supported for streaming "
-                "ingestion (random access would rescan the whole stream "
-                "per blob); repack as plain .tar or zip"
+                f"compressed tar {path!r} needs the sequential-window "
+                "reader (_SeqTarContainer), not random-access pread"
             )
         self.path = path
         self._members: dict[str, tuple[int, int]] = {}
@@ -179,6 +194,187 @@ class _TarContainer:
         if self._fd is not None:
             os.close(self._fd)
             self._fd = None
+
+
+class _SeqTarContainer:
+    """Compressed tar (``.tar.gz`` and friends): a sequential-WINDOW
+    reader.  Random access into a compressed stream is O(archive) per
+    member, so reads ride ONE forward decompression pass instead.
+
+    The metadata scan (one full pass up front — a torn gzip fails
+    closed HERE, before any row is written) assigns every regular
+    member a stream ordinal.  ``read()`` advances a forward-only
+    ``r|*`` tarfile stream to the requested ordinal, caching any
+    WANTED member it passes over (``want()`` — the expansion registers
+    exactly the members its span will read, narrowed to the unread
+    suffix on resume via ``ManifestExpansion.mark_done_prefix``), so
+    the batch pipeline's bounded read reordering (``inflight`` produce
+    batches) pops the cache instead of rescanning.  Cache entries are
+    popped on read, and the window is additionally HARD-BOUNDED at
+    ``cache_bytes_max`` (FIFO eviction): a caller whose read order
+    strands entries — a --featurize-procs pool hands each worker only
+    some of the span's chunks — degrades to the counted rescan
+    fallback instead of holding an archive's worth of blobs.  A read
+    behind the window that was never cached (or was evicted) reopens
+    the stream once (``rescans`` counts them; the pipeline's in-order
+    pattern never takes this path — it exists so out-of-contract
+    orderings stay correct, not fast)."""
+
+    # the reorder window the pipeline needs is inflight batches x
+    # batch_size blobs of <= 64 KiB each; 32 MiB covers that many
+    # times over while keeping the stranded-entry worst case harmless
+    cache_bytes_max = 32 << 20
+
+    def __init__(self, path: str):
+        import tarfile
+        import zlib
+
+        self.path = path
+        self._lock = threading.Lock()
+        self._members: dict[str, tuple[int, int]] = {}
+        self._order: list[str] = []
+        self._evidence: list[str] = []
+        self._wanted: set[int] = set()
+        self._cache: dict[int, bytes] = {}
+        self._cache_bytes = 0
+        self._tf = None
+        self._iter = None
+        self._pos = 0
+        self.rescans = 0
+        try:
+            size = os.path.getsize(path)
+            self._evidence.append(f"ctar:{size}")
+            ordinal = 0
+            with tarfile.open(path, mode="r:*") as tf:
+                for info in tf:
+                    if not info.isreg():
+                        continue
+                    if info.name not in self._order:
+                        self._order.append(info.name)
+                    # duplicates collapse to the LAST occurrence (tar
+                    # extraction semantics, like _TarContainer)
+                    self._members[info.name] = (ordinal, info.size)
+                    self._evidence.append(
+                        f"{info.name}@{ordinal}+{info.size}"
+                        f":{info.mtime}:{info.chksum}"
+                    )
+                    ordinal += 1
+        except (tarfile.TarError, EOFError, OSError, zlib.error) as exc:
+            raise IngestError(
+                f"cannot read compressed tar {path!r}: {exc}"
+            ) from exc
+        self._closed = False
+
+    def members(self) -> list[str]:
+        return list(self._order)
+
+    def evidence(self) -> list[str]:
+        """Archive size plus every member's (stream ordinal, size,
+        mtime, header checksum) — same repack-refusal strength as the
+        plain-tar evidence."""
+        return list(self._evidence)
+
+    def want(self, member: str) -> None:
+        """Mark a member this expansion WILL read: only wanted members
+        are cached when the forward walk passes them (a stripe must
+        never buffer another stripe's span)."""
+        got = self._members.get(member)
+        if got is not None and got[1] <= MAX_LICENSE_SIZE:
+            self._wanted.add(got[0])
+
+    def reset_wants(self) -> None:
+        self._wanted.clear()
+        self._cache.clear()
+        self._cache_bytes = 0
+
+    def _close_stream(self) -> None:
+        if self._tf is not None:
+            try:
+                self._tf.close()
+            except OSError:
+                pass
+            self._tf = None
+            self._iter = None
+        self._pos = 0
+
+    def _next_reg(self):
+        import tarfile
+
+        if self._tf is None:
+            # r|* = strictly forward streaming decompression; members
+            # must be consumed in stream order, which is exactly the
+            # window discipline this reader enforces
+            self._tf = tarfile.open(self.path, mode="r|*")
+            self._iter = iter(self._tf)
+            self._pos = 0
+        while True:
+            info = next(self._iter)
+            if info.isreg():
+                return info
+
+    def read(self, member: str):
+        import tarfile
+        import zlib
+
+        got = self._members.get(member)
+        if got is None:
+            return None  # a read_error row, like the other readers
+        ordinal, size = got
+        if size > MAX_LICENSE_SIZE:
+            return SkippedBlob(OVERSIZED)
+        with self._lock:
+            data = self._cache.pop(ordinal, None)
+            if data is not None:
+                self._cache_bytes -= len(data)
+                return data
+            try:
+                if ordinal < self._pos:
+                    # behind the window and never cached: the one
+                    # correctness rescan (counted; in-contract callers
+                    # never reach here)
+                    self._close_stream()
+                    self.rescans += 1
+                while True:
+                    info = self._next_reg()
+                    o = self._pos
+                    self._pos += 1
+                    if o == ordinal:
+                        f = self._tf.extractfile(info)
+                        data = f.read() if f is not None else None
+                        if data is None or len(data) != size:
+                            return None
+                        return data
+                    if o in self._wanted:
+                        f = self._tf.extractfile(info)
+                        blob = f.read() if f is not None else None
+                        if blob is not None:
+                            self._cache[o] = blob
+                            self._cache_bytes += len(blob)
+                            while (
+                                self._cache_bytes > self.cache_bytes_max
+                                and self._cache
+                            ):
+                                # FIFO eviction: a stranded entry's
+                                # eventual read pays one rescan instead
+                                # of this cache paying the archive
+                                first = next(iter(self._cache))
+                                self._cache_bytes -= len(
+                                    self._cache.pop(first)
+                                )
+            except (
+                tarfile.TarError, EOFError, OSError, StopIteration,
+                zlib.error,
+            ):
+                # row-contained: the next read reopens a fresh stream
+                self._close_stream()
+                return None
+
+    def close(self) -> None:
+        if not self._closed:
+            self._close_stream()
+            self._cache.clear()
+            self._cache_bytes = 0
+            self._closed = True
 
 
 class _ZipContainer:
@@ -296,6 +492,8 @@ def open_container(container: str, selector: str):
     kind = _container_kind(container)
     if kind == "tar":
         return _TarContainer(container)
+    if kind == "ctar":
+        return _SeqTarContainer(container)
     if kind == "zip":
         return _ZipContainer(container)
     if kind == "git":
@@ -321,16 +519,28 @@ def _loose_read(path: str):
 
 class ManifestExpansion:
     """The expanded manifest: per-blob display paths, the container
-    spans behind them, and the positional reader the produce stage
+    groups behind them, and the positional reader the produce stage
     pulls blobs through.
 
     ``paths[i]`` is what the output row prints; ``read_at(i)`` loads
     the bytes (``None`` -> read_error row, :class:`SkippedBlob` ->
     skip row).  Reads are addressed BY INDEX, not by display path, so
     two containers holding the same member name can never cross wires.
+
+    ``total`` is the FULL expanded blob count — the striping
+    denominator — even after :meth:`restrict` narrows this instance to
+    one stripe's span; :meth:`fingerprint` is likewise computed over
+    the full expansion, so every stripe's resume sidecar (and the
+    merged output's) carries the same value as a single-process run.
     """
 
-    def __init__(self):
+    def __init__(self, entries: list[str]):
+        # the raw manifest entries this expansion came from — with
+        # ``span``, everything a worker process needs to re-open the
+        # containers itself (see descriptor()/from_descriptor)
+        self.entries = list(entries)
+        self.span: tuple[int, int] | None = None
+        self.total = 0
         self.paths: list[str] = []
         # parallel to paths: the filename the routing/dispatch tables
         # see (the MEMBER's basename for container blobs — an explicit
@@ -341,11 +551,22 @@ class ManifestExpansion:
         self._refs: list = []
         # whole-container groups: (entry, start, count) in manifest order
         self.spans: list[tuple[str, int, int]] = []
+        # explicitly-listed member groups: (container path,
+        # [(index, member), ...]) — `a.tar::LICENSE` + `a.tar::COPYING`
+        # in one manifest yield ONE container row over exactly the
+        # listed members (verdict.py), instead of silently skipping
+        # the container sidecar
+        self.subsets: list[tuple[str, list[tuple[int, str]]]] = []
         self._containers: list = []
+        self._fingerprint: str | None = None
+        self._any_containers = False
+        # resume support: rows [0, _done_prefix) of this view are
+        # already written and will never be read (mark_done_prefix)
+        self._done_prefix = 0
 
     @property
     def has_containers(self) -> bool:
-        return bool(self._containers)
+        return self._any_containers
 
     def read_at(self, index: int):
         ref = self._refs[index]
@@ -355,24 +576,143 @@ class ManifestExpansion:
         return container.read(member)
 
     def fingerprint(self) -> str | None:
-        """sha1 over the expanded path list PLUS per-container content
-        evidence (tar member offsets/sizes/mtimes/header checksums,
-        zip CRCs, git object ids) — the resume sidecar's proof that a
-        resumed run expands to the SAME rows of the SAME bytes.  An
-        archive rewritten between runs — even one keeping every member
-        name — must refuse, not silently append rows scored from
-        different content after a completed prefix of the old."""
-        if not self.has_containers:
-            return None
-        h = hashlib.sha1(usedforsecurity=False)
-        for p in self.paths:
-            h.update(p.encode("utf-8", "surrogatepass"))
-            h.update(b"\0")
-        for container in self._containers:
-            for line in container.evidence():
-                h.update(line.encode("utf-8", "surrogatepass"))
-                h.update(b"\0")
-        return h.hexdigest()
+        """sha1 over the FULL expanded path list PLUS per-container
+        content evidence (tar member offsets/sizes/mtimes/header
+        checksums, zip CRCs, git object ids) — the resume sidecar's
+        proof that a resumed run expands to the SAME rows of the SAME
+        bytes.  An archive rewritten between runs — even one keeping
+        every member name — must refuse, not silently append rows
+        scored from different content after a completed prefix of the
+        old.  Span-independent by construction (computed during the
+        full enumeration, before any restrict), so a stripe shard's
+        sidecar, the merged output's, and a single-process run's all
+        agree."""
+        return self._fingerprint if self._any_containers else None
+
+    def restrict(self, lo: int, hi: int) -> "ManifestExpansion":
+        """Narrow to the expanded-index span ``[lo, hi)`` — one
+        stripe's view.  Rows outside the span drop, container groups
+        clip to span-local indices, and containers whose members all
+        fall outside the span are CLOSED (a stripe never holds fds for
+        blobs another stripe owns).  ``total``/``fingerprint()`` keep
+        their full-expansion values."""
+        if not 0 <= lo <= hi <= self.total:
+            raise ValueError(
+                f"span [{lo}, {hi}) out of range for {self.total} "
+                "expanded entries"
+            )
+        self.paths = self.paths[lo:hi]
+        self.filenames = self.filenames[lo:hi]
+        self._refs = self._refs[lo:hi]
+        clipped = []
+        for entry, start, count in self.spans:
+            s, e = max(start, lo), min(start + count, hi)
+            if e > s:
+                clipped.append((entry, s - lo, e - s))
+        self.spans = clipped
+        self.subsets = [
+            (label, kept)
+            for label, members in self.subsets
+            if (kept := [(i - lo, m) for i, m in members if lo <= i < hi])
+        ]
+        live = {id(c) for ref in self._refs if ref is not None
+                for c in (ref[0],)}
+        keep = []
+        for c in self._containers:
+            if id(c) in live:
+                keep.append(c)
+            else:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        self._containers = keep
+        self.span = (lo, hi)
+        self._register_wants()
+        return self
+
+    def _register_wants(self) -> None:
+        """Tell sequential-window containers exactly which members
+        this expansion will read (its span minus any completed resume
+        prefix), so their forward walk caches nothing another stripe
+        owns and nothing a resumed run already wrote."""
+        for c in self._containers:
+            if hasattr(c, "reset_wants"):
+                c.reset_wants()
+        for ref in self._refs[self._done_prefix:]:
+            if ref is not None and hasattr(ref[0], "want"):
+                ref[0].want(ref[1])
+
+    def mark_done_prefix(self, done: int) -> None:
+        """Resume support: rows [0, done) of THIS view are already on
+        disk and will never be read — narrow the sequential-window
+        wants to the unread suffix, so the resumed forward walk skips
+        the completed prefix (decompress-and-discard) without caching
+        it."""
+        done = max(0, min(int(done), len(self._refs)))
+        if done > self._done_prefix:
+            self._done_prefix = done
+            self._register_wants()
+
+    def layout(self) -> dict:
+        """The supervisor-facing summary (see :func:`expanded_layout`):
+        total / container groups / fingerprint.  Call on an
+        UNRESTRICTED expansion — after :meth:`restrict` the groups are
+        span-local, not full-manifest."""
+        return {
+            "total": self.total,
+            "spans": list(self.spans),
+            "subsets": [(label, list(m)) for label, m in self.subsets],
+            "fingerprint": self.fingerprint(),
+        }
+
+    def descriptor(self) -> dict:
+        """A picklable re-open recipe for worker PROCESSES
+        (``--featurize-procs``): the raw entries, the span, and the
+        expansion fingerprint.  Workers rebuild their own expansion
+        from it (:meth:`from_descriptor`) — fresh container handles in
+        the worker, never inherited fds — and the fingerprint check
+        refuses if the containers changed between the parent's
+        expansion and the worker's."""
+        return {
+            "entries": list(self.entries),
+            "span": list(self.span) if self.span is not None else None,
+            "fingerprint": self.fingerprint(),
+            "done_prefix": self._done_prefix,
+        }
+
+    @classmethod
+    def from_descriptor(cls, desc: dict) -> "ManifestExpansion":
+        out = expand_manifest(desc["entries"])
+        try:
+            if desc.get("fingerprint") and (
+                out.fingerprint() != desc["fingerprint"]
+            ):
+                raise IngestError(
+                    "container contents changed under a running job: "
+                    "the worker's expansion fingerprint does not match "
+                    "the parent's"
+                )
+            span = desc.get("span")
+            if span is not None:
+                out.restrict(span[0], span[1])
+            if desc.get("done_prefix"):
+                out.mark_done_prefix(desc["done_prefix"])
+        except BaseException:
+            out.close()
+            raise
+        return out
+
+    def __getstate__(self):
+        # fds and ODB handles must never cross a process boundary — a
+        # pickled fd NUMBER would "work" in a fork child and silently
+        # share file offsets; spawn children would read a stranger's
+        # fd.  Workers ship descriptor() and re-open for themselves.
+        raise TypeError(
+            "ManifestExpansion holds live container handles and never "
+            "pickles; ship descriptor() and re-open with "
+            "from_descriptor() in the worker process"
+        )
 
     def close(self) -> None:
         for c in self._containers:
@@ -383,15 +723,25 @@ class ManifestExpansion:
         self._containers = []
 
 
-def expand_manifest(entries: list[str]) -> ManifestExpansion:
-    """Expand raw manifest entries into per-blob work items.
+def expand_manifest(
+    entries: list[str], span: tuple[int, int] | None = None
+) -> ManifestExpansion:
+    """Expand raw manifest entries into per-blob work items,
+    optionally restricted to the expanded-index ``span`` (a stripe's
+    view — see :meth:`ManifestExpansion.restrict`).
 
     Deterministic given the manifest and the container contents —
     the property the blob-level resume invariant (line count ==
     completed prefix) rides on."""
-    out = ManifestExpansion()
+    out = ManifestExpansion(entries)
     try:
         _expand_into(out, entries)
+        out.total = len(out.paths)
+        out._fingerprint = _full_fingerprint(out)
+        if span is not None:
+            out.restrict(span[0], span[1])
+        else:
+            out._register_wants()
     except BaseException:
         # a torn container midway through the manifest must not leak
         # the handles already opened for the containers before it
@@ -400,10 +750,40 @@ def expand_manifest(entries: list[str]) -> ManifestExpansion:
     return out
 
 
+def _full_fingerprint(out: ManifestExpansion) -> str:
+    h = hashlib.sha1(usedforsecurity=False)
+    for p in out.paths:
+        h.update(p.encode("utf-8", "surrogatepass"))
+        h.update(b"\0")
+    for container in out._containers:
+        for line in container.evidence():
+            h.update(line.encode("utf-8", "surrogatepass"))
+            h.update(b"\0")
+    return h.hexdigest()
+
+
+def expanded_layout(entries: list[str]) -> dict:
+    """The supervisor-side counting/spanning pass: ``total`` (the
+    expanded striping denominator), the whole-container ``spans`` and
+    explicit-member ``subsets`` in FULL expanded coordinates (the
+    merge-time container-verdict groups), and the expansion
+    ``fingerprint`` — with every container handle closed before
+    returning (the stripe runner supervises; its workers open their
+    own handles).  Metadata only: no blob bytes are read."""
+    ex = expand_manifest(entries)
+    try:
+        return ex.layout()
+    finally:
+        ex.close()
+
+
 def _expand_into(out: ManifestExpansion, entries: list[str]) -> None:
     # one open handle per (container path, git revision) pair, shared
     # by every entry that names it
     opened: dict[tuple[str, str], object] = {}
+    # explicit-member groups accumulate per container handle (manifest
+    # entries naming the same container may interleave other entries)
+    subset_of: dict[int, list[tuple[int, str]]] = {}
 
     def get_container(container: str, selector: str):
         kind = _container_kind(container)
@@ -423,6 +803,7 @@ def _expand_into(out: ManifestExpansion, entries: list[str]) -> None:
             out.filenames.append(os.path.basename(entry))
             out._refs.append(None)
             continue
+        out._any_containers = True
         container_path, selector = parsed
         if not selector:
             raise IngestError(
@@ -440,7 +821,16 @@ def _expand_into(out: ManifestExpansion, entries: list[str]) -> None:
             out.spans.append((entry, start, len(out.paths) - start))
         else:
             # explicit single member: the DISPLAY echoes back exactly
-            # as written; the routing filename is the member's own
+            # as written; the routing filename is the member's own.
+            # The listed members of one container form a subset group
+            # — a container row over exactly what was listed.
+            subset_of.setdefault(id(handle), []).append(
+                (len(out.paths), selector)
+            )
+            if len(subset_of[id(handle)]) == 1:
+                out.subsets.append(
+                    (container_path, subset_of[id(handle)])
+                )
             out.paths.append(entry)
             out.filenames.append(os.path.basename(selector))
             out._refs.append((handle, selector))

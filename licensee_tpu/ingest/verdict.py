@@ -18,6 +18,11 @@ distinct real licenses (the ``LICENSE-MIT`` + ``LICENSE-APACHE``
 convention) keeps the reference's ``other`` verdict but additionally
 carries ``"spdx_expression": "MIT OR Apache-2.0"`` so downstream
 tooling sees the disjunction instead of a shrug.
+
+Groups come in two shapes (``container_groups``): whole-container
+spans (``archive.tar::*``) and explicitly-listed member subsets
+(``archive.tar::LICENSE`` + ``archive.tar::COPYING`` in one manifest
+-> one container row over exactly the listed members).
 """
 
 from __future__ import annotations
@@ -167,49 +172,94 @@ def container_verdict(entry: str, files: list[tuple[str, dict]]) -> dict:
     return row
 
 
+def container_groups(
+    spans: list[tuple[str, int, int]],
+    subsets: list[tuple[str, list[tuple[int, str]]]] = (),
+) -> list[tuple[str, list[tuple[int, str | None]]]]:
+    """Normalize whole-container spans and explicitly-listed member
+    subsets into verdict groups ``(label, [(row_index, member), ...])``
+    ordered by first row index.
+
+    ``member`` is ``None`` for span rows (the per-blob row's own
+    ``path`` IS the member's stored name there); subset rows carry the
+    member selector explicitly, because their display path echoes the
+    manifest entry (``a.tar::LICENSE``) while the verdict algebra's
+    name scoring needs the MEMBER name."""
+    groups: list[tuple[str, list[tuple[int, str | None]]]] = []
+    for entry, start, count in spans:
+        groups.append((entry, [(start + j, None) for j in range(count)]))
+    for label, members in subsets:
+        groups.append((label, [(i, m) for i, m in members]))
+    groups.sort(key=lambda g: g[1][0][0] if g[1] else -1)
+    return groups
+
+
 def write_container_verdicts(
-    output: str, spans: list[tuple[str, int, int]]
+    output: str,
+    spans: list[tuple[str, int, int]],
+    subsets: list[tuple[str, list[tuple[int, str]]]] = (),
 ) -> str:
-    """Derive one container row per whole-container span from the
-    finished per-blob JSONL and write ``<output>.containers.jsonl``
-    atomically.
+    """Derive one container row per group — whole-container spans AND
+    explicitly-listed member subsets — from the finished per-blob
+    JSONL and write ``<output>.containers.jsonl`` atomically.
 
     Purely a function of the (deterministic, resume-safe) per-blob
     output, so a rerun after any crash — even one that tore a
     container in half — regenerates identical container rows once the
     blob rows are complete: container-granularity resume safety rides
-    on blob-granularity resume for free.  Streams the output file;
-    only one container's candidate rows are held at a time."""
+    on blob-granularity resume for free.  The stripe runner calls this
+    over the MERGED output with full-expansion groups, which is
+    exactly the blob-level join: per-stripe partial rows of a
+    container that spanned stripes re-enter the license algebra as one
+    merged set, and every container emits exactly one row.  Streams
+    the output file once; a group's rows are freed the moment its last
+    row passes (only interleaved groups overlap in memory)."""
     path = f"{output}.containers.jsonl"
-    ordered = sorted(spans, key=lambda s: s[1])
-    rows: list[str] = []
+    groups = container_groups(spans, subsets)
+    need: dict[int, list[tuple[int, int]]] = {}
+    for gi, (_label, members) in enumerate(groups):
+        for slot, (idx, _member) in enumerate(members):
+            need.setdefault(idx, []).append((gi, slot))
+    filled: list = [[None] * len(m) for _label, m in groups]
+    remaining = [len(m) for _label, m in groups]
+    rendered: list = [None] * len(groups)
+    for gi, (label, members) in enumerate(groups):
+        if not members:
+            # a container with zero regular members (directories only)
+            # still gets its row — a {"files": 0, "license": null}
+            # verdict, never a does-not-cover refusal
+            rendered[gi] = json.dumps(container_verdict(label, []))
+            filled[gi] = None
+    max_idx = max(need) if need else -1
     with open(output, encoding="utf-8") as f:
-        lines = enumerate(f)
-        cursor = -1
-        line = None
-
-        def advance_to(target: int) -> str:
-            nonlocal cursor, line
-            while cursor < target:
-                try:
-                    cursor, line = next(lines)
-                except StopIteration:
-                    raise ValueError(
-                        f"{output!r} ends at row {cursor + 1}, but a "
-                        f"container span needs row {target + 1} — the "
-                        "per-blob output does not cover the expansion"
-                    ) from None
-            return line
-
-        for entry, start, count in ordered:
-            current = []
-            for j in range(count):
-                row = json.loads(advance_to(start + j))
-                current.append((row["path"], row))
-            rows.append(json.dumps(container_verdict(entry, current)))
+        for i, line in enumerate(f):
+            if i > max_idx:
+                break
+            targets = need.get(i)
+            if not targets:
+                continue
+            row = json.loads(line)
+            for gi, slot in targets:
+                label, members = groups[gi]
+                member = members[slot][1]
+                filled[gi][slot] = (
+                    member if member is not None else row["path"], row
+                )
+                remaining[gi] -= 1
+                if remaining[gi] == 0:
+                    rendered[gi] = json.dumps(
+                        container_verdict(label, filled[gi])
+                    )
+                    filled[gi] = None  # free the row dicts
+    short = [groups[gi][0] for gi, r in enumerate(rendered) if r is None]
+    if short:
+        raise ValueError(
+            f"{output!r} does not cover the expansion: container "
+            f"group(s) {short[:3]!r} need rows past its end"
+        )
     tmp = f"{path}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
-        for r in rows:
+        for r in rendered:
             f.write(r + "\n")
     os.replace(tmp, path)
     return path

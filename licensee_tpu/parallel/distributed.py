@@ -241,13 +241,18 @@ def chips_for_worker(
 
 
 def count_manifest_entries(manifest: str) -> int:
-    """Non-blank line count — the ONE striping denominator.
+    """Non-blank line count — the striping denominator for LOOSE
+    manifests.
 
     Both sides of the shard row-count contract ride this: the stripe
     runner (parallel/stripes.py) sizes stripe spans from it, and
     ``BatchProject.from_manifest_file`` counts with it before
     collecting a span — so what counts as "an entry" can never drift
-    between supervisor and worker."""
+    between supervisor and worker.  Container manifests ('::' forms)
+    stripe by their EXPANDED blob count instead: both sides run the
+    same metadata-only enumeration (ingest/sources.py
+    ``expanded_layout`` / ``ManifestExpansion.restrict``), so the
+    no-drift property holds there by construction too."""
     n = 0
     with open(manifest, encoding="utf-8") as f:
         for line in f:

@@ -14,7 +14,12 @@ makes the documented scaling lever ONE command::
 The runner spawns N co-located worker processes on this host, each
 classifying a contiguous stripe of the manifest (the same
 ``manifest_stripe`` math the multi-host path uses, so a stripe IS a
-rank) and writing its own resume-safe JSONL shard.  No
+rank) and writing its own resume-safe JSONL shard.  Container
+manifests ('::' forms) stripe by their EXPANDED blob count — a single
+million-member tarball splits across stripes, each worker expanding
+the same manifest metadata-only and reading just its span — and the
+container-verdict sidecar is derived once from the MERGED output
+(exactly one row per container, even when its blobs spanned stripes).  No
 ``jax.distributed`` bootstrap is involved: the scoring workload has no
 cross-blob collectives, so co-located stripes need no coordinator — the
 stripe index/count ride the child's argv and chip subsets ride the SAME
@@ -305,6 +310,7 @@ class StripeRunner:
         sigterm_timeout_s: float = 10.0,
         progress_every: float = 0,
         on_event=None,
+        container_layout: dict | None = None,
     ):
         if stripes < 1:
             raise ValueError(f"stripes must be >= 1, got {stripes!r}")
@@ -319,6 +325,38 @@ class StripeRunner:
         self.manifest = manifest
         self.output = output
         self.n_entries = count_manifest_entries(manifest)
+        # container manifests ('::' forms): the striping denominator
+        # moves to the EXPANDED blob count — a single million-member
+        # tarball splits across stripes (each worker expands the same
+        # manifest metadata-only and keeps its span; the span math
+        # agrees with this layout by construction) — and the merged
+        # output's container-verdict sidecar is derived HERE after the
+        # merge (exactly one row per container, even when its blobs
+        # spanned stripes), so workers write per-blob shards only.
+        self.container_layout = None
+        from licensee_tpu.ingest.sources import is_container_entry
+
+        # streamed probe first: a 50M-line LOOSE manifest must never
+        # materialize in the supervisor
+        with open(manifest, encoding="utf-8") as f:
+            has_containers = any(
+                is_container_entry(line.strip()) for line in f
+            )
+        if has_containers:
+            if container_layout is None:
+                from licensee_tpu.ingest.sources import expanded_layout
+
+                with open(manifest, encoding="utf-8") as f:
+                    entries = [
+                        line.strip() for line in f if line.strip()
+                    ]
+                # metadata-only counting pass; every handle closed
+                # before returning (workers open their own)
+                container_layout = expanded_layout(entries)
+            # else: the caller already paid the expansion (the CLI's
+            # resume preflight probe) — don't rescan the archives
+            self.container_layout = container_layout
+            self.n_entries = self.container_layout["total"]
         if stripes > max(1, self.n_entries):
             if auto_clamp:
                 # `--stripes auto` sized from the HOST; a small manifest
@@ -718,9 +756,30 @@ class StripeRunner:
             # the merged output is a complete single-file run: carry
             # shard 0's config sidecar so a later single-process resume
             # of this output file sees the config that produced it
+            # (the expansion fingerprint inside it is span-independent,
+            # so it matches what a single-process run would record)
             shard0_meta = f"{self.handles[0].shard}.meta.json"
             if os.path.exists(shard0_meta):
                 os.replace(shard0_meta, f"{self.output}.meta.json")
+        if self.container_layout is not None and (
+            self.container_layout["spans"]
+            or self.container_layout["subsets"]
+        ):
+            # the blob-level JOIN: striped workers wrote per-blob rows
+            # only (a container may span shards), so the ONE container
+            # sidecar derives here from the merged output over the
+            # full-expansion groups — the license algebra re-runs over
+            # each container's merged row set and every container
+            # emits exactly one verdict row
+            from licensee_tpu.ingest.verdict import (
+                write_container_verdicts,
+            )
+
+            write_container_verdicts(
+                self.output,
+                self.container_layout["spans"],
+                self.container_layout["subsets"],
+            )
         stats_rows = []
         expositions: dict[str, str] = {}
         for handle in self.handles:
@@ -947,5 +1006,51 @@ def selftest(stream=None) -> int:
         say(
             "OK: tar-ingest bit-identical to loose files "
             f"(container license={containers[0].get('license')!r})"
+        )
+        # 2-stripe tar-ingest smoke: the SAME tarball striped by its
+        # EXPANDED blob count across 2 real worker subprocesses — the
+        # container's blobs span both stripes by construction — must
+        # merge bit-identical to the 1-process tar run, and the merged
+        # container sidecar must carry exactly one row per container
+        tar_manifest = os.path.join(tmpdir, "tar_manifest.txt")
+        with open(tar_manifest, "w", encoding="utf-8") as f:
+            f.write(f"{tar_path}::*\n")
+        tar2_out = os.path.join(tmpdir, "out-tar2.jsonl")
+        runner = StripeRunner(
+            tar_manifest, tar2_out, 2,
+            forward_args=forward,
+            base_env=base_env,
+            on_event=say,
+        )
+        summary = runner.run()
+        if runner.n_entries != len(paths):
+            say(
+                f"FAIL: expanded striping denominator "
+                f"{runner.n_entries}, want {len(paths)}"
+            )
+            return 1
+        if summary["rows_written"] != len(paths):
+            say(
+                f"FAIL: 2-stripe tar run wrote "
+                f"{summary['rows_written']} rows, want {len(paths)}"
+            )
+            return 1
+        with open(tar2_out, "rb") as f:
+            if f.read() != tar_bytes:
+                say("FAIL: 2-stripe tar merge != 1-process tar output")
+                return 1
+        with open(
+            f"{tar2_out}.containers.jsonl", encoding="utf-8"
+        ) as f:
+            striped_containers = [json.loads(line) for line in f]
+        if striped_containers != containers:
+            say(
+                "FAIL: striped container sidecar != 1-process sidecar: "
+                f"{striped_containers}"
+            )
+            return 1
+        say(
+            "OK: 2-stripe tar-ingest bit-identical to 1-process "
+            "(one container row, blobs spanned both stripes)"
         )
     return 0

@@ -130,11 +130,17 @@ class ResumeConfigError(ValueError):
 # attribution regex — up to 64 KiB each, trimmed in _produce_batch);
 # threads win whenever the native pipeline is up (its crossing releases
 # the GIL), processes win on the pure-Python pipeline beyond ~2 cores.
+#
+# Container manifests compose with --featurize-procs: each worker
+# re-opens the containers from the expansion's picklable descriptor
+# (_mp_init) — fresh per-process handles, never inherited fds — and
+# reads positionally by the chunk's span offset, so duplicate member
+# names across containers still cannot cross wires.
 
 _MP_STATE: dict = {}
 
 
-def _mp_init(corpus, mode, batch_size):
+def _mp_init(corpus, mode, batch_size, ingest_desc=None):
     from licensee_tpu.kernels.batch import BatchClassifier
 
     _MP_STATE["clf"] = BatchClassifier(
@@ -144,11 +150,32 @@ def _mp_init(corpus, mode, batch_size):
         mesh=None,
         device=False,
     )
+    # container manifests: the worker RE-OPENS the containers from the
+    # parent's picklable descriptor (entries + span + fingerprint) —
+    # container handles hold fds/odb objects that must never cross the
+    # spawn boundary, and the fingerprint check refuses if an archive
+    # changed between the parent's expansion and this worker's
+    if ingest_desc is not None:
+        from licensee_tpu.ingest.sources import ManifestExpansion
+
+        _MP_STATE["ingest"] = ManifestExpansion.from_descriptor(
+            ingest_desc
+        )
 
 
-def _mp_produce(chunk, mode, dedupe, attribution):
+def _mp_produce(chunk, mode, dedupe, attribution, start=None):
+    exp = _MP_STATE.get("ingest")
+    read = filenames = None
+    if exp is not None and start is not None:
+        # positional reads through the worker's OWN container handles:
+        # `start` is the chunk's offset into this rank's span, exactly
+        # the thread path's _read_hook contract
+        read_at = exp.read_at
+        read = lambda _path, i: read_at(start + i)  # noqa: E731
+        filenames = exp.filenames[start : start + len(chunk)]
     return (chunk, *_produce_batch(
-        _MP_STATE["clf"], chunk, mode, dedupe, attribution, cache=None
+        _MP_STATE["clf"], chunk, mode, dedupe, attribution, cache=None,
+        read=read, filenames=filenames,
     ))
 
 
@@ -257,13 +284,6 @@ class BatchProject:
         self.process_index = process_index
         self.process_count = process_count
         paths = list(manifest_paths)
-        if self.process_count > 1 and not already_striped:
-            from licensee_tpu.parallel.distributed import manifest_stripe
-
-            lo, hi = manifest_stripe(
-                len(paths), self.process_index, self.process_count
-            )
-            paths = paths[lo:hi]
         # -- streaming container ingestion (ingest/sources.py) --
         #
         # Manifest entries may address tar/zip/git containers
@@ -275,31 +295,51 @@ class BatchProject:
         # count == completed prefix) holds unchanged; the expansion
         # fingerprint joins the resume sidecar so a rewritten archive
         # refuses to resume instead of appending foreign rows.
+        #
+        # Striping over containers is denominated in EXPANDED blob
+        # counts: every rank expands the same full manifest (metadata
+        # only — member tables, central directories, git root trees)
+        # and restricts itself to its span of the expanded rows, so
+        # the supervisor (parallel/stripes.py expanded_layout) and the
+        # workers agree on span arithmetic by construction, and a
+        # single million-member tarball splits across stripes.
         self.ingest = None
         from licensee_tpu.ingest.sources import (
             expand_manifest,
             is_container_entry,
         )
 
-        if any(is_container_entry(p) for p in paths):
-            if self.process_count > 1:
-                # striping math is denominated in raw manifest ENTRIES;
-                # a container entry expands to many rows, so the
-                # supervisor and the workers would disagree about span
-                # arithmetic.  Future work — refuse loudly for now.
+        has_containers = any(is_container_entry(p) for p in paths)
+        if self.process_count > 1 and not already_striped and (
+            not has_containers
+        ):
+            from licensee_tpu.parallel.distributed import manifest_stripe
+
+            lo, hi = manifest_stripe(
+                len(paths), self.process_index, self.process_count
+            )
+            paths = paths[lo:hi]
+        if has_containers:
+            if already_striped and self.process_count > 1:
+                # the caller pre-sliced raw entries; expanded-count
+                # spans need the FULL manifest on every rank
                 raise ValueError(
-                    "container manifest entries ('::' forms) are not "
-                    "supported with manifest striping / multi-host "
-                    "runs yet; run single-process"
-                )
-            if featurize_procs:
-                # container readers hold open fds/odb handles that do
-                # not survive pickling into spawn workers
-                raise ValueError(
-                    "container manifest entries ('::' forms) cannot be "
-                    "combined with --featurize-procs"
+                    "container manifests stripe by expanded blob "
+                    "count; pass the full manifest to every rank "
+                    "(already_striped does not apply)"
                 )
             self.ingest = expand_manifest(paths)
+            if self.process_count > 1:
+                from licensee_tpu.parallel.distributed import (
+                    manifest_stripe,
+                )
+
+                lo, hi = manifest_stripe(
+                    self.ingest.total,
+                    self.process_index,
+                    self.process_count,
+                )
+                self.ingest.restrict(lo, hi)
             paths = self.ingest.paths
         self.paths = paths
         # a caller-supplied classifier (pad_batch_to must equal batch_size)
@@ -426,14 +466,32 @@ class BatchProject:
             except Exception:
                 process_count, process_index = 1, 0
         if process_count > 1:
+            from licensee_tpu.ingest.sources import is_container_entry
             from licensee_tpu.parallel.distributed import (
                 count_manifest_entries,
                 manifest_stripe,
             )
 
+            # container manifests stripe by EXPANDED blob counts: the
+            # constructor needs the FULL entry list on every rank to
+            # enumerate the container spans, so no raw-line slicing
+            # happens here (the expansion's metadata pass replaces it)
+            with open(manifest_file, encoding="utf-8") as f:
+                has_containers = any(
+                    is_container_entry(line.strip()) for line in f
+                )
+            if has_containers:
+                with open(manifest_file, encoding="utf-8") as f:
+                    paths = [
+                        line.strip() for line in f if line.strip()
+                    ]
+                kwargs["process_index"] = process_index
+                kwargs["process_count"] = process_count
+                return cls(paths, **kwargs)
             # the SHARED counter (also the stripe runner's span
-            # denominator): supervisor and worker must agree on what an
-            # entry is, or the merge's row-count check fails
+            # denominator for loose manifests): supervisor and worker
+            # must agree on what an entry is, or the merge's row-count
+            # check fails
             n = count_manifest_entries(manifest_file)
             lo, hi = manifest_stripe(n, process_index, process_count)
             paths = []
@@ -635,6 +693,11 @@ class BatchProject:
         done = 0
         if resume and os.path.exists(output):
             done = self._resume_point(output)
+        if done and self.ingest is not None:
+            # the completed prefix is never re-read: sequential-window
+            # containers skip it instead of caching it (and the procs
+            # descriptor below carries the same narrowing)
+            self.ingest.mark_done_prefix(done)
         mode = "a" if done else "w"
 
         starts = deque(range(done, len(self.paths), self.batch_size))
@@ -661,7 +724,14 @@ class BatchProject:
                 max_workers=self.featurize_procs,
                 mp_context=multiprocessing.get_context("spawn"),
                 initializer=_mp_init,
-                initargs=(self.classifier.corpus, self.mode, self.batch_size),
+                initargs=(
+                    self.classifier.corpus, self.mode, self.batch_size,
+                    # container manifests ride a picklable re-open
+                    # descriptor — never the parent's live handles
+                    self.ingest.descriptor()
+                    if self.ingest is not None
+                    else None,
+                ),
             )
         else:
             pool = ThreadPoolExecutor(max_workers=self.workers)
@@ -695,6 +765,7 @@ class BatchProject:
                             self.mode,
                             self.dedupe,
                             self.attribution,
+                            start,
                         )
                     )
                 else:
@@ -1067,17 +1138,27 @@ class BatchProject:
             if writer_err:
                 raise writer_err[0]
         self.stats.pipeline = lanes.occupancy()
-        if self.ingest is not None and self.ingest.spans:
+        if (
+            self.ingest is not None
+            and self.process_count == 1
+            and (self.ingest.spans or self.ingest.subsets)
+        ):
             # container-level verdicts (the reference's Project#license
             # algebra over this run's finished rows) — derived purely
             # from the completed per-blob output and replaced
             # atomically, so any interrupted run regenerates identical
             # rows on its resumed completion: resume safety at
-            # container granularity rides on the blob-level invariant
+            # container granularity rides on the blob-level invariant.
+            # Striped ranks (process_count > 1) skip this: a container
+            # may span shards, so the stripe runner derives the ONE
+            # sidecar from the merged output instead — exactly one row
+            # per container, never one per stripe fragment.
             from licensee_tpu.ingest.verdict import write_container_verdicts
 
             t0 = time.perf_counter()
-            write_container_verdicts(output, self.ingest.spans)
+            write_container_verdicts(
+                output, self.ingest.spans, self.ingest.subsets
+            )
             self.stats.add_stage("containers", time.perf_counter() - t0)
         self.stats.add_stage("elapsed", time.perf_counter() - t_run)
         return self.stats

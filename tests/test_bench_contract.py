@@ -200,6 +200,14 @@ def _fat_details() -> dict:
             "identical_output": True,
             "container_rows": 99_999_999,
             "container_license": "x" * 40,
+            "striped": {
+                "stripes": 2,
+                "tar_per_stripe_files_per_sec": 99_999_999.9,
+                "loose_per_stripe_files_per_sec": 99_999_999.9,
+                "vs_loose_striping": 99.999,
+                "identical_output": True,
+                "container_rows": 99_999_999,
+            },
         },
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
@@ -238,8 +246,9 @@ def test_headline_line_fits_driver_capture(bench_mod):
     # and inside the driver's ~2000-char tail even with the TPU-plugin
     # warning line sharing the tail window (the BENCH_r06.json file
     # artifact is the durable copy regardless); re-pinned 1700 -> 1800
-    # when the streaming-ingest block joined the headline
-    assert n <= 1800
+    # when the streaming-ingest block joined the headline, 1800 -> 1850
+    # when its striped_* keys joined (PR 15)
+    assert n <= 1850
 
 
 def test_headline_carries_the_headline_numbers(bench_mod):
@@ -289,6 +298,10 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["ingest"]["tar_files_per_sec"] == 99_999_999.9
     assert d["ingest"]["vs_loose"] == 99.999
     assert d["ingest"]["identical_output"] is True
+    # the expanded-count striping gate (PR 15): 2-stripe tar merge
+    # identical + per-stripe rate vs loose-file striping
+    assert d["ingest"]["striped_identical"] is True
+    assert d["ingest"]["striped_vs_loose"] == 99.999
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 

@@ -136,13 +136,180 @@ def test_empty_selector_refused(tmp_path):
         expand_manifest([f"{tar}::"])
 
 
-def test_compressed_tar_refused(tmp_path):
+def _gzip_of(plain: str, gz) -> str:
     import gzip
 
-    plain = _make_tar(tmp_path / "a.tar", {"LICENSE": b"x"})
-    gz = tmp_path / "a.tar.gz"
     with open(plain, "rb") as src, gzip.open(gz, "wb") as dst:
         dst.write(src.read())
+    return str(gz)
+
+
+def test_compressed_tar_streams(tmp_path):
+    """`archive.tar.gz::*` is a real path now: the sequential-window
+    reader answers the same members, bytes, caps, and spans as the
+    plain tar it wraps."""
+    files = {
+        "repo/LICENSE": _body("mit").encode(),
+        "repo/BIG": b"x" * (64 * 1024 + 1),
+        "repo/README": b"hello",
+    }
+    plain = _make_tar(tmp_path / "a.tar", files)
+    gz = _gzip_of(plain, tmp_path / "a.tar.gz")
+    ex = expand_manifest([f"{gz}::*"])
+    try:
+        assert ex.paths == list(files)
+        assert ex.read_at(0) == files["repo/LICENSE"]
+        big = ex.read_at(1)
+        assert isinstance(big, SkippedBlob) and big.error == OVERSIZED
+        assert ex.read_at(2) == b"hello"
+        assert ex.spans == [(f"{gz}::*", 0, 3)]
+    finally:
+        ex.close()
+
+
+def test_compressed_tar_window_reorder_never_rescans(tmp_path):
+    """The batch pipeline's bounded read reordering (inflight produce
+    batches) must pop the forward window's cache, never rescan the
+    stream from zero."""
+    files = {f"m{i}": f"blob {i}".encode() for i in range(6)}
+    plain = _make_tar(tmp_path / "a.tar", files)
+    gz = _gzip_of(plain, tmp_path / "a.tar.gz")
+    ex = expand_manifest([f"{gz}::*"])
+    try:
+        # read ahead, then behind (the laggard in-flight batch)
+        assert ex.read_at(4) == b"blob 4"
+        assert ex.read_at(0) == b"blob 0"
+        assert ex.read_at(2) == b"blob 2"
+        assert ex.read_at(1) == b"blob 1"
+        assert ex.read_at(3) == b"blob 3"
+        assert ex.read_at(5) == b"blob 5"
+        assert ex._containers[0].rescans == 0
+    finally:
+        ex.close()
+
+
+def test_compressed_tar_end_to_end_matches_plain(tmp_path):
+    """The golden gate for the .tar.gz path: byte-identical per-blob
+    JSONL and container sidecar to the plain-tar run of the same
+    blobs."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    files = {
+        f"r/LICENSE_{i:02d}": (
+            f"Copyright (c) {2000 + i}\n\n{_body('mit')}"
+        ).encode()
+        for i in range(12)
+    }
+    plain = _make_tar(tmp_path / "a.tar", files)
+    gz = _gzip_of(plain, tmp_path / "a.tar.gz")
+    outs = {}
+    for label, entry in (("tar", f"{plain}::*"), ("gz", f"{gz}::*")):
+        out = str(tmp_path / f"{label}.jsonl")
+        project = BatchProject([entry], batch_size=4, mesh=None)
+        try:
+            project.run(out, resume=False)
+        finally:
+            project.close()
+        with open(out, "rb") as f:
+            outs[label] = f.read()
+        with open(f"{out}.containers.jsonl", "rb") as f:
+            outs[f"{label}_containers"] = f.read()
+    assert outs["gz"] == outs["tar"]
+    assert outs["gz_containers"] == outs["tar_containers"].replace(
+        b".tar::", b".tar.gz::"
+    )
+
+
+def test_empty_container_still_emits_verdict_row(tmp_path):
+    """A container with zero regular members (directories only) gets
+    a {"files": 0, "license": null} row — never a does-not-cover
+    refusal after a complete run."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = str(tmp_path / "empty.tar")
+    with tarfile.open(tar, "w") as tf:
+        info = tarfile.TarInfo(name="only-a-dir/")
+        info.type = tarfile.DIRTYPE
+        tf.addfile(info)
+    loose = tmp_path / "LICENSE"
+    loose.write_bytes(_body("mit").encode())
+    out = str(tmp_path / "out.jsonl")
+    project = BatchProject(
+        [f"{tar}::*", str(loose)], batch_size=8, mesh=None
+    )
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    with open(f"{out}.containers.jsonl", encoding="utf-8") as f:
+        containers = [json.loads(line) for line in f]
+    assert containers == [
+        {
+            "container": f"{tar}::*",
+            "files": 0,
+            "license": None,
+            "licenses": [],
+            "matched_files": [],
+        }
+    ]
+
+
+def test_seq_tar_cache_hard_bound_degrades_to_rescan(tmp_path):
+    """The sequential window is byte-bounded: a read order that
+    strands entries (a procs pool's partial chunk view) evicts FIFO
+    and pays the counted rescan fallback instead of holding the
+    archive in memory."""
+    files = {f"m{i}": bytes([65 + i]) * 3000 for i in range(8)}
+    plain = _make_tar(tmp_path / "a.tar", files)
+    gz = _gzip_of(plain, tmp_path / "a.tar.gz")
+    ex = expand_manifest([f"{gz}::*"])
+    try:
+        c = ex._containers[0]
+        c.cache_bytes_max = 10_000  # fits ~3 members
+        assert ex.read_at(7) == files["m7"]  # walk caches 0..6, evicts
+        assert c._cache_bytes <= 10_000
+        # the evicted early ordinals still read correctly (one rescan)
+        assert ex.read_at(0) == files["m0"]
+        assert c.rescans >= 1
+        # and a cached-late ordinal pops without another rescan
+        before = c.rescans
+        assert ex.read_at(6) == files["m6"]
+        assert c.rescans >= before  # correctness either way
+    finally:
+        ex.close()
+
+
+def test_mark_done_prefix_skips_completed_rows(tmp_path):
+    """Resume: the completed prefix is dropped from the wants, so the
+    forward walk to the first unread row caches nothing from it (and
+    the descriptor carries the narrowing to procs workers)."""
+    files = {f"m{i}": f"blob {i}".encode() for i in range(6)}
+    plain = _make_tar(tmp_path / "a.tar", files)
+    gz = _gzip_of(plain, tmp_path / "a.tar.gz")
+    ex = expand_manifest([f"{gz}::*"])
+    try:
+        ex.mark_done_prefix(4)
+        assert ex.descriptor()["done_prefix"] == 4
+        c = ex._containers[0]
+        assert ex.read_at(4) == b"blob 4"
+        # the walk passed ordinals 0..3 without caching them
+        assert c._cache == {}
+        assert ex.read_at(5) == b"blob 5"
+        assert c.rescans == 0
+    finally:
+        ex.close()
+
+
+def test_torn_gzip_fails_closed(tmp_path):
+    """A truncated .tar.gz must refuse at EXPANSION (the metadata
+    pass decompresses the whole stream), before any row is written."""
+    plain = _make_tar(
+        tmp_path / "a.tar", {"LICENSE": _body("mit").encode() * 8}
+    )
+    gz = _gzip_of(plain, tmp_path / "a.tar.gz")
+    data = open(gz, "rb").read()
+    with open(gz, "wb") as f:
+        f.write(data[: len(data) // 2])
     with pytest.raises(IngestError, match="compressed tar"):
         expand_manifest([f"{gz}::*"])
 
@@ -175,7 +342,10 @@ def test_tar_reader_order_cap_and_missing(tmp_path):
     try:
         assert ex.paths == [f"{tar}::nope"]
         assert ex.read_at(0) is None
-        assert ex.spans == []  # single members get no container span
+        assert ex.spans == []  # no whole-container span...
+        # ...but the listed members form a SUBSET group: the sidecar
+        # emits a container row over exactly what was listed
+        assert ex.subsets == [(tar, [(0, "nope")])]
     finally:
         ex.close()
 
@@ -218,6 +388,9 @@ def test_mixed_manifest_spans(tmp_path):
         assert ex.read_at(0) == b"loose bytes"
         assert ex.read_at(1) == b"1"
         assert ex.spans == [(f"{tar}::*", 2, 2)]
+        # the explicit member forms its own subset group beside the
+        # whole-container span
+        assert ex.subsets == [(tar, [(1, "m1")])]
     finally:
         ex.close()
 
@@ -527,34 +700,373 @@ def test_rewritten_content_same_names_refuses_resume(tmp_path):
         project.close()
 
 
-# -- guardrails --
+# -- expanded-count striping (the PR 15 tentpole) --
 
 
-def test_containers_refuse_striping_and_procs(tmp_path):
+def _span_files(n: int, body_key: str = "mit") -> dict[str, bytes]:
+    return {
+        f"repo/LICENSE_{i:02d}": (
+            f"Copyright (c) {2000 + i}\n\n{_body(body_key)}"
+        ).encode()
+        for i in range(n)
+    }
+
+
+def test_expansion_restrict_is_one_stripes_view(tmp_path):
+    """restrict(lo, hi): span-local rows, clipped container groups,
+    closed handles for containers outside the span, and a
+    span-INDEPENDENT total + fingerprint."""
+    t1 = _make_tar(tmp_path / "one.tar", {"a": b"1", "b": b"2"})
+    t2 = _make_tar(tmp_path / "two.tar", {"c": b"3", "d": b"4"})
+    full = expand_manifest([f"{t1}::*", f"{t2}::*"])
+    try:
+        total, fp = full.total, full.fingerprint()
+        assert total == 4
+    finally:
+        full.close()
+    ex = expand_manifest([f"{t1}::*", f"{t2}::*"], span=(2, 4))
+    try:
+        # the second container's members only; the first tar's handle
+        # is closed (one live container)
+        assert ex.paths == ["c", "d"]
+        assert ex.read_at(0) == b"3" and ex.read_at(1) == b"4"
+        assert ex.spans == [(f"{t2}::*", 0, 2)]
+        assert len(ex._containers) == 1
+        # full-expansion values survive the restrict: every stripe's
+        # resume sidecar (and the merged output's) agree
+        assert ex.total == total
+        assert ex.fingerprint() == fp
+        assert ex.span == (2, 4)
+    finally:
+        ex.close()
+    # a mid-container span clips the group
+    ex = expand_manifest([f"{t1}::*", f"{t2}::*"], span=(1, 3))
+    try:
+        assert ex.paths == ["b", "c"]
+        assert ex.spans == [
+            (f"{t1}::*", 0, 1), (f"{t2}::*", 1, 1),
+        ]
+        assert len(ex._containers) == 2
+    finally:
+        ex.close()
+
+
+def test_striped_container_ranks_concat_to_one_process_run(tmp_path):
+    """Two ranks over a container manifest (the constructor's
+    process_index/count path — multi-host and stripe workers both ride
+    it) stripe by EXPANDED blob count; their shards concatenate
+    byte-identical to the 1-process run."""
     from licensee_tpu.projects.batch_project import BatchProject
 
-    tar = _make_tar(tmp_path / "a.tar", {"LICENSE": b"x"})
-    with pytest.raises(ValueError, match="striping"):
-        BatchProject(
-            [f"{tar}::*"], mesh=None,
-            process_index=0, process_count=2,
+    tar = _make_tar(tmp_path / "a.tar", _span_files(11))
+    entry = f"{tar}::*"
+    golden = str(tmp_path / "golden.jsonl")
+    project = BatchProject([entry], batch_size=4, mesh=None)
+    try:
+        project.run(golden, resume=False)
+    finally:
+        project.close()
+    out = str(tmp_path / "out.jsonl")
+    shard_bytes = []
+    for rank in (0, 1):
+        project = BatchProject(
+            [entry], batch_size=4, mesh=None,
+            process_index=rank, process_count=2,
         )
-    with pytest.raises(ValueError, match="featurize-procs"):
-        BatchProject([f"{tar}::*"], mesh=None, featurize_procs=2)
+        try:
+            assert len(project.paths) in (5, 6)  # expanded span, not 1
+            project.run(out, resume=False)
+        finally:
+            project.close()
+        shard = f"{out}.shard-{rank:05d}-of-00002"
+        with open(shard, "rb") as f:
+            shard_bytes.append(f.read())
+        # striped ranks write per-blob rows only: the container may
+        # span shards, so the sidecar is the MERGE's job
+        assert not os.path.exists(f"{shard}.containers.jsonl")
+    with open(golden, "rb") as f:
+        assert b"".join(shard_bytes) == f.read()
 
 
-def test_cli_stripes_refuses_containers(tmp_path, capsys):
-    from licensee_tpu.cli.main import main
+def test_stripe_runner_expanded_denominator_and_merged_sidecar(tmp_path):
+    """StripeRunner over a container manifest: the span denominator is
+    the EXPANDED blob count, and the merged output carries exactly one
+    container-verdict row even though the container's blobs spanned
+    both stripes (the blob-level join, parity with the 1-process
+    sidecar)."""
+    from licensee_tpu.parallel.stripes import StripeRunner
 
-    tar = _make_tar(tmp_path / "a.tar", {"LICENSE": b"x"})
+    tar = _make_tar(tmp_path / "a.tar", _span_files(9))
     manifest = tmp_path / "m.txt"
     manifest.write_text(f"{tar}::*\n")
+    runner = StripeRunner(
+        str(manifest), str(tmp_path / "o.jsonl"), 2,
+        argv_for=lambda i, n, resume=True: ["true"],
+    )
+    assert runner.n_entries == 9  # expanded blobs, not 1 raw entry
+    layout = runner.container_layout
+    assert layout["total"] == 9
+    assert layout["spans"] == [(f"{tar}::*", 0, 9)]
+    assert layout["fingerprint"]
+    # more stripes than expanded blobs still refuses
+    with pytest.raises(ValueError, match="more stripes"):
+        StripeRunner(
+            str(manifest), str(tmp_path / "o2.jsonl"), 10,
+            argv_for=lambda i, n, resume=True: ["true"],
+        )
+
+
+def test_resume_mid_container_under_two_stripes(tmp_path):
+    """The 2-stripe torn-tail drill: a stripe worker killed mid-
+    container (complete prefix + half a row in its shard) resumes to a
+    byte-identical shard, and the shards still concatenate to the
+    1-process output."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = _make_tar(tmp_path / "r.tar", _span_files(16))
+    entry = f"{tar}::*"
+    golden = str(tmp_path / "golden.jsonl")
+    project = BatchProject([entry], batch_size=4, mesh=None, dedupe=False)
+    try:
+        project.run(golden, resume=False)
+    finally:
+        project.close()
+    with open(golden, "rb") as f:
+        golden_bytes = f.read()
+
+    out = str(tmp_path / "out.jsonl")
+
+    def rank1() -> "BatchProject":
+        return BatchProject(
+            [entry], batch_size=4, mesh=None, dedupe=False,
+            process_index=1, process_count=2,
+        )
+
+    project = rank1()
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    shard = f"{out}.shard-00001-of-00002"
+    with open(shard, "rb") as f:
+        shard_golden = f.read()
+    # fabricate the crash artifact: 3 complete rows + a torn 4th,
+    # beside the sidecar the dead incarnation wrote at open
+    lines = shard_golden.split(b"\n")
+    with open(shard, "wb") as f:
+        f.write(
+            b"\n".join(lines[:3]) + b"\n" + lines[3][: len(lines[3]) // 2]
+        )
+    project = rank1()
+    try:
+        project.run(out, resume=True)
+    finally:
+        project.close()
+    with open(shard, "rb") as f:
+        assert f.read() == shard_golden
+    # rank 0's shard + the resumed rank 1 shard == the 1-process run
+    project = BatchProject(
+        [entry], batch_size=4, mesh=None, dedupe=False,
+        process_index=0, process_count=2,
+    )
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    with open(f"{out}.shard-00000-of-00002", "rb") as f:
+        assert f.read() + shard_golden == golden_bytes
+
+
+@pytest.mark.slow
+def test_cli_stripes_multi_container_end_to_end(tmp_path):
+    """The acceptance drill: `batch-detect --stripes 2` over a
+    MULTI-container manifest (a container's blobs spanning both
+    stripes by construction) — merged JSONL byte-identical to the
+    1-process run, container sidecar with exactly one row per
+    container."""
+    import subprocess
+    import sys
+
+    t1 = _make_tar(tmp_path / "one.tar", _span_files(7))
+    zp = _make_zip(
+        tmp_path / "two.zip",
+        {"LICENSE": _body("isc").encode(), "README": b"hi"},
+    )
+    loose = tmp_path / "LICENSE_LOOSE"
+    loose.write_bytes(_body("mit").encode())
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{t1}::*\n{loose}\n{zp}::*\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    outs = {}
+    for label, extra in (("one", []), ("two", ["--stripes", "2"])):
+        out = str(tmp_path / f"{label}.jsonl")
+        subprocess.run(
+            [
+                sys.executable, "-m", "licensee_tpu.cli.main",
+                "batch-detect", str(manifest), "--output", out,
+                "--mesh", "none", "--batch-size", "4", *extra,
+            ],
+            check=True, env=env, capture_output=True,
+        )
+        with open(out, "rb") as f:
+            outs[label] = f.read()
+        with open(f"{out}.containers.jsonl", "rb") as f:
+            outs[f"{label}_containers"] = f.read()
+    assert outs["two"] == outs["one"]
+    assert outs["two_containers"] == outs["one_containers"]
+    rows = [
+        json.loads(line)
+        for line in outs["two_containers"].decode().splitlines()
+    ]
+    # exactly one verdict row per container, in expansion order
+    assert [r["container"] for r in rows] == [f"{t1}::*", f"{zp}::*"]
+    assert [r["files"] for r in rows] == [7, 2]
+
+
+def test_rewritten_container_refuses_striped_resume(tmp_path):
+    """The expansion fingerprint is span-independent and rides every
+    shard's sidecar: a rewritten archive refuses a striped rank's
+    resume exactly like a single-process one."""
+    from licensee_tpu.projects.batch_project import (
+        BatchProject,
+        ResumeConfigError,
+    )
+
+    tar = str(tmp_path / "a.tar")
+    _make_tar(tar, _span_files(6))
+    out = str(tmp_path / "out.jsonl")
+    project = BatchProject(
+        [f"{tar}::*"], batch_size=4, mesh=None,
+        process_index=0, process_count=2,
+    )
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    _make_tar(tar, _span_files(6, "isc"))
+    project = BatchProject(
+        [f"{tar}::*"], batch_size=4, mesh=None,
+        process_index=0, process_count=2,
+    )
+    try:
+        with pytest.raises(ResumeConfigError, match="ingest"):
+            project.run(out, resume=True)
+    finally:
+        project.close()
+
+
+def test_cli_stripes_container_resume_preflight(tmp_path, capsys):
+    """The striped rerun preflight expands container manifests so the
+    expansion fingerprint compares: a complete output no-ops, a
+    rewritten archive refuses before any worker spawns."""
+    from licensee_tpu.cli.main import main
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = str(tmp_path / "a.tar")
+    _make_tar(tar, _span_files(4))
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{tar}::*\n")
+    output = str(tmp_path / "o.jsonl")
+    project = BatchProject([f"{tar}::*"], batch_size=8, mesh=None)
+    try:
+        project.run(output, resume=False)
+    finally:
+        project.close()
+
+    # complete output + unchanged archive: the runner no-ops
     rc = main([
-        "batch-detect", str(manifest), "--stripes", "2",
-        "--output", str(tmp_path / "o.jsonl"),
+        "batch-detect", str(manifest), "--stripes", "1",
+        "--output", output, "--mesh", "none", "--batch-size", "8",
     ])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "already complete" in err
+
+    # rewritten archive: refused at preflight, before any spawn
+    _make_tar(tar, _span_files(4, "isc"))
+    rc = main([
+        "batch-detect", str(manifest), "--stripes", "1",
+        "--output", output, "--mesh", "none", "--batch-size", "8",
+    ])
+    err = capsys.readouterr().err
     assert rc == 1
-    assert "not supported with --stripes" in capsys.readouterr().err
+    assert "ingest" in err and "configuration differs" in err
+
+
+# -- --featurize-procs over containers (per-process re-open) --
+
+
+def test_featurize_procs_descriptor_reopens_no_inherited_fds(tmp_path):
+    """The worker-process recipe is a PICKLABLE descriptor (entries +
+    span + fingerprint), never the parent's live handles: _mp_init
+    re-expands in the worker, opening its OWN container fds, and a
+    changed archive fails the fingerprint check instead of silently
+    reading different bytes."""
+    import pickle
+
+    from licensee_tpu.ingest.sources import ManifestExpansion
+    from licensee_tpu.projects import batch_project as bp
+
+    tar = _make_tar(tmp_path / "a.tar", {"LICENSE": _body("mit").encode()})
+    parent = expand_manifest([f"{tar}::*"])
+    try:
+        desc = parent.descriptor()
+        pickle.dumps(desc)  # the spawn crossing carries ONLY this
+        with pytest.raises(TypeError):
+            pickle.dumps(parent)  # live handles never cross
+        worker = ManifestExpansion.from_descriptor(desc)
+        try:
+            # a fresh fd in the "worker", not the parent's
+            assert worker._containers[0]._fd != parent._containers[0]._fd
+            assert worker.paths == parent.paths
+            assert worker.read_at(0) == parent.read_at(0)
+        finally:
+            worker.close()
+        # the worker-side fingerprint gate: archive rewritten between
+        # the parent's expansion and the worker's boot -> refuse
+        _make_tar(tar, {"LICENSE": _body("isc").encode()})
+        with pytest.raises(IngestError, match="changed"):
+            ManifestExpansion.from_descriptor(desc)
+    finally:
+        parent.close()
+        bp._MP_STATE.clear()
+
+
+@pytest.mark.slow
+def test_featurize_procs_containers_bit_identical(tmp_path):
+    """--featurize-procs over a container manifest: byte-identical to
+    the thread path, with positional dedup preserved (duplicate member
+    names across containers keep their own bytes)."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    t1 = _make_tar(
+        tmp_path / "one.tar", {"LICENSE": _body("mit").encode()}
+    )
+    t2 = _make_tar(
+        tmp_path / "two.tar", {"LICENSE": _body("isc").encode()}
+    )
+    manifest = [f"{t1}::*", f"{t2}::*"]
+    outs = {}
+    for label, procs in (("threads", 0), ("procs", 2)):
+        out = str(tmp_path / f"{label}.jsonl")
+        project = BatchProject(
+            manifest, batch_size=4, mesh=None, featurize_procs=procs
+        )
+        try:
+            project.run(out, resume=False)
+        finally:
+            project.close()
+        with open(out, "rb") as f:
+            outs[label] = f.read()
+    assert outs["procs"] == outs["threads"]
+    rows = [
+        json.loads(line)
+        for line in outs["procs"].decode().splitlines()
+    ]
+    # positional reads: same member NAME, each container's own verdict
+    assert [r["path"] for r in rows] == ["LICENSE", "LICENSE"]
+    assert rows[0]["key"] == "mit"
+    assert rows[1]["key"] == "isc"
 
 
 def test_cli_stdout_mode_prints_container_rows(tmp_path, capsys):
@@ -583,6 +1095,103 @@ def test_cli_stdout_mode_prints_container_rows(tmp_path, capsys):
     assert len(container_rows) == 1
     assert container_rows[0]["license"] == "other"
     assert container_rows[0]["spdx_expression"] == "MIT OR Apache-2.0"
+
+
+# -- explicitly-listed member subsets (the PR 15 satellite) --
+
+
+def test_subset_members_emit_container_row(tmp_path):
+    """`a.tar::LICENSE-MIT` + `a.tar::LICENSE-APACHE` in one manifest:
+    one container row over exactly the listed members (by MEMBER name,
+    not display string), instead of silently skipping the sidecar."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = _make_tar(
+        tmp_path / "a.tar",
+        {
+            "LICENSE-MIT": _body("mit").encode(),
+            "LICENSE-APACHE": _body("apache-2.0").encode(),
+            "UNLISTED": _body("isc").encode(),
+        },
+    )
+    out = str(tmp_path / "out.jsonl")
+    project = BatchProject(
+        [f"{tar}::LICENSE-MIT", f"{tar}::LICENSE-APACHE"],
+        batch_size=8, mesh=None,
+    )
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    with open(f"{out}.containers.jsonl", encoding="utf-8") as f:
+        containers = [json.loads(line) for line in f]
+    assert len(containers) == 1
+    row = containers[0]
+    assert row["container"] == tar
+    assert row["files"] == 2  # exactly the listed members, not 3
+    assert row["license"] == "other"
+    assert row["spdx_expression"] == "MIT OR Apache-2.0"
+    assert sorted(row["matched_files"]) == [
+        "LICENSE-APACHE", "LICENSE-MIT",
+    ]
+
+
+def test_subset_members_interleaved_with_other_entries(tmp_path):
+    """Subset members of one container may interleave other manifest
+    entries; the group still joins into one row, and an interleaved
+    loose file stays out of it."""
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    tar = _make_tar(
+        tmp_path / "a.tar",
+        {
+            "COPYING": _body("gpl-3.0").encode(),
+            "COPYING.lesser": _body("lgpl-3.0").encode(),
+        },
+    )
+    loose = tmp_path / "LICENSE"
+    loose.write_bytes(_body("mit").encode())
+    out = str(tmp_path / "out.jsonl")
+    project = BatchProject(
+        [f"{tar}::COPYING.lesser", str(loose), f"{tar}::COPYING"],
+        batch_size=8, mesh=None,
+    )
+    try:
+        project.run(out, resume=False)
+    finally:
+        project.close()
+    with open(f"{out}.containers.jsonl", encoding="utf-8") as f:
+        containers = [json.loads(line) for line in f]
+    assert len(containers) == 1
+    # the reference's LGPL dual-file exception over exactly the
+    # listed pair — the loose MIT row never joins the container
+    assert containers[0]["license"] == "lgpl-3.0"
+    assert containers[0]["files"] == 2
+
+
+def test_cli_stdout_mode_prints_subset_rows(tmp_path, capsys):
+    from licensee_tpu.cli.main import main
+
+    tar = _make_tar(
+        tmp_path / "a.tar",
+        {
+            "LICENSE": _body("mit").encode(),
+            "OTHER": b"not a license",
+        },
+    )
+    manifest = tmp_path / "m.txt"
+    manifest.write_text(f"{tar}::LICENSE\n")
+    rc = main(["batch-detect", str(manifest), "--mesh", "none"])
+    assert rc == 0
+    rows = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+    ]
+    container_rows = [r for r in rows if "container" in r]
+    assert len(container_rows) == 1
+    assert container_rows[0]["container"] == tar
+    assert container_rows[0]["files"] == 1
+    assert container_rows[0]["license"] == "mit"
 
 
 # -- the container verdict algebra (parity with projects/project.py) --
