@@ -60,6 +60,14 @@ ERROR_CODES: tuple[str, ...] = (
     "router_not_started",
     # the diff verb named a license key the corpus does not know
     "unknown_license",
+    # -- the jobs tier (fleet/http_edge.py /jobs routes) --
+    # the edge serves no jobs executor (fleet started without
+    # --jobs-dir), or the executor is draining for shutdown
+    "jobs_disabled",
+    # GET/DELETE named a job id the journal has never seen
+    "job_not_found",
+    # results/containers requested before the job completed
+    "job_not_done",
 )
 
 # response-row fields a client may read; every one must have at least
@@ -108,18 +116,28 @@ WATCHED_KEYS: frozenset[str] = frozenset(
 # ROUTES/STATUS_TEXT tables plus every request-line constant a client
 # harness sends (rules_protocol.check_http_drift).
 
-# (method, path) -> wire-level meaning
+# (method, path) -> wire-level meaning.  ``{id}`` paths are templates:
+# the edge parses the job id at runtime and serves the request under
+# the template's declared route (client harnesses therefore build
+# those request lines from variables, never literals).
 HTTP_ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/classify"): "content",
     ("GET", "/healthz"): "health",
     ("GET", "/metrics"): "prometheus",
+    ("POST", "/jobs"): "job_submit",
+    ("GET", "/jobs/{id}"): "job_status",
+    ("GET", "/jobs/{id}/results"): "job_results",
+    ("GET", "/jobs/{id}/containers"): "job_containers",
+    ("DELETE", "/jobs/{id}"): "job_cancel",
 }
 
 # every status code the edge may mint.  The backpressure contract maps
 # here: queue_full -> 429 (+ Retry-After), router shutdown / a fleet
-# with no dispatchable backend -> 503.
+# with no dispatchable backend -> 503.  The jobs tier adds 202 (a
+# submit/cancel accepted for async execution) and 409 (results asked
+# of a job that has not completed).
 HTTP_STATUS_CODES: tuple[int, ...] = (
-    200, 400, 401, 404, 405, 413, 429, 500, 503,
+    200, 202, 400, 401, 404, 405, 409, 413, 429, 500, 503,
 )
 
 # role detection, by path basename: the real worker transport, the

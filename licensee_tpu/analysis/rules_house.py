@@ -27,11 +27,13 @@ WALLCLOCK_DIRS = (
     "licensee_tpu/serve",
     "licensee_tpu/obs",
     "licensee_tpu/fleet",
+    "licensee_tpu/jobs",
     "licensee_tpu/parallel/stripes",
 )
 NO_PRINT_DIRS = (
     "licensee_tpu/obs",
     "licensee_tpu/fleet",
+    "licensee_tpu/jobs",
     "licensee_tpu/parallel/stripes",
 )
 PER_BLOB_DIRS = (

@@ -1364,6 +1364,155 @@ def cmd_slo(args) -> int:
     return 0 if slo.get("ok") else 1
 
 
+def _jobs_option_value(value: str):
+    # the jobs spec carries typed option values; the CLI gets strings.
+    # Numbers coerce, everything else rides as-is — the edge's
+    # validate_spec is the authority and answers 400 with a reason
+    for kind in (int, float):
+        try:
+            return kind(value)
+        except ValueError:
+            continue
+    return value
+
+
+def cmd_jobs(args) -> int:
+    """Client for the durable jobs tier: submit a manifest (or a local
+    archive) as an async striped batch-detect job over a fleet's HTTP
+    edge (jobs/executor.py behind POST /jobs), then poll its lifecycle,
+    fetch the merged results, or cancel it."""
+    from licensee_tpu.jobs.client import JobsClient, JobsClientError
+
+    if args.action != "submit" and not args.job_id:
+        print(f"error: jobs {args.action} needs a JOB_ID", file=sys.stderr)
+        return 1
+    try:
+        client = JobsClient(
+            args.edge, token=args.token, timeout_s=args.timeout
+        )
+    except OSError as exc:
+        print(f"error: cannot reach {args.edge!r}: {exc}", file=sys.stderr)
+        return 1
+    try:
+        if args.action == "submit":
+            spec: dict = {}
+            if args.manifest:
+                try:
+                    with open(args.manifest, encoding="utf-8") as fh:
+                        entries = [
+                            line.strip() for line in fh if line.strip()
+                        ]
+                except OSError as exc:
+                    print(
+                        f"error: cannot read manifest: {exc}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                spec["manifest"] = entries
+            if args.archive:
+                import base64
+
+                try:
+                    with open(args.archive, "rb") as fh:
+                        blob = fh.read()
+                except OSError as exc:
+                    print(
+                        f"error: cannot read archive: {exc}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                spec["archive_b64"] = base64.b64encode(blob).decode("ascii")
+                spec["archive_name"] = os.path.basename(args.archive)
+            if not spec:
+                print(
+                    "error: jobs submit needs --manifest FILE and/or "
+                    "--archive PATH",
+                    file=sys.stderr,
+                )
+                return 1
+            if args.stripes is not None:
+                spec["stripes"] = args.stripes
+            options: dict = {}
+            for kv in args.option or ():
+                key, sep, value = kv.partition("=")
+                if not sep or not key:
+                    print(
+                        f"error: bad --option {kv!r} (want KEY=VALUE)",
+                        file=sys.stderr,
+                    )
+                    return 1
+                options[key] = _jobs_option_value(value)
+            if options:
+                spec["options"] = options
+            if args.idempotency_key:
+                spec["idempotency_key"] = args.idempotency_key
+            code, row = client.submit(spec)
+            if code not in (200, 202):
+                print(
+                    f"error: submit answered {code}: {row}",
+                    file=sys.stderr,
+                )
+                return 1
+            if args.wait:
+                row = client.wait(
+                    row["job_id"], timeout_s=args.wait_timeout
+                )
+            print(json.dumps(row))
+            return 0 if row.get("state") != "failed" else 1
+        if args.action == "status":
+            code, row = client.status(args.job_id)
+            if code != 200:
+                print(
+                    f"error: status answered {code}: {row}",
+                    file=sys.stderr,
+                )
+                return 1
+            print(json.dumps(row))
+            return 0
+        if args.action == "wait":
+            row = client.wait(args.job_id, timeout_s=args.wait_timeout)
+            print(json.dumps(row))
+            return 0 if row.get("state") == "completed" else 1
+        if args.action in ("results", "containers"):
+            fetch = (
+                client.results
+                if args.action == "results"
+                else client.containers
+            )
+            code, payload = fetch(args.job_id)
+            if code != 200:
+                print(
+                    f"error: {args.action} answered {code}: "
+                    f"{payload[:200]!r}",
+                    file=sys.stderr,
+                )
+                return 1
+            if args.output:
+                with open(args.output, "wb") as fh:
+                    fh.write(payload)
+            else:
+                sys.stdout.buffer.write(payload)
+                sys.stdout.buffer.flush()
+            return 0
+        # cancel
+        code, row = client.cancel(args.job_id)
+        if code not in (200, 202):
+            print(
+                f"error: cancel answered {code}: {row}", file=sys.stderr
+            )
+            return 1
+        print(json.dumps(row))
+        return 0
+    except JobsClientError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    except OSError as exc:
+        print(f"error: edge connection failed: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+
+
 def cmd_fleet(args) -> int:
     """The fleet tier: supervise N serve worker processes (restart on
     crash/wedge with backoff, drain on rolling restart) behind one
@@ -1383,6 +1532,17 @@ def cmd_fleet(args) -> int:
         from licensee_tpu.fleet.selftest import selftest_tcp
 
         return selftest_tcp(stub=args.stub)
+    if args.selftest_jobs:
+        from licensee_tpu.jobs.selftest import selftest_jobs
+
+        return selftest_jobs(stub=args.stub)
+    if args.jobs_dir and not args.http:
+        print(
+            "error: --jobs-dir needs --http (jobs are submitted over "
+            "the HTTP edge)",
+            file=sys.stderr,
+        )
+        return 1
     if not args.socket and not args.http:
         print("error: need --socket PATH|HOST:PORT (the client-facing "
               "front door) and/or --http HOST:PORT, or --selftest",
@@ -1485,6 +1645,29 @@ def cmd_fleet(args) -> int:
             supervisor.stop()
             return 1
     router.start()
+    executor = None
+    if args.jobs_dir:
+        # the durable jobs tier: journal-backed executor sharing the
+        # router's metrics registry, its trace tail joined into the
+        # collector so edge submit -> executor -> stripe spans
+        # assemble under one trace ID
+        from licensee_tpu.jobs.executor import JobExecutor
+
+        executor = JobExecutor(
+            args.jobs_dir,
+            max_concurrent=args.jobs_concurrency,
+            registry=router.obs.registry,
+        )
+        executor.start()
+        router.collector.add_source("jobs", executor.trace_tail)
+        resumed = executor.resumed_jobs
+        print(
+            f"fleet: jobs executor over {args.jobs_dir} "
+            f"(concurrency {args.jobs_concurrency}"
+            + (f", resumed {resumed} job(s)" if resumed else "")
+            + ")",
+            file=sys.stderr,
+        )
     edge_tokens = None
     if args.edge_token:
         edge_tokens = {}
@@ -1506,6 +1689,7 @@ def cmd_fleet(args) -> int:
                 tokens=edge_tokens,
                 rate_per_client=args.edge_rate,
                 burst=args.edge_burst,
+                jobs=executor,
             )
             print(
                 f"fleet: HTTP edge on {args.http}"
@@ -1517,6 +1701,8 @@ def cmd_fleet(args) -> int:
         for srv in (server, edge):
             if srv is not None:
                 srv.server_close()
+        if executor is not None:
+            executor.close()
         router.close()
         if supervisor is not None:
             supervisor.stop()
@@ -1571,6 +1757,10 @@ def cmd_fleet(args) -> int:
                 os.unlink(args.socket)
             except OSError:
                 pass
+        if executor is not None:
+            # running jobs re-journal as queued and resume on the next
+            # --jobs-dir boot; the journal keeps the durable state
+            executor.close()
         router.close()
         if supervisor is not None:
             supervisor.stop()
@@ -1595,6 +1785,7 @@ COMMANDS = (
     ("slo", "Evaluate SLO burn rates from a worker/fleet scrape"),
     ("fleet", "Supervise N serve workers behind one routed socket"),
     ("corpus-build", "Compile a corpus into a fingerprinted artifact"),
+    ("jobs", "Submit and track durable striped jobs over the HTTP edge"),
 )
 _COMMAND_HELP = dict(COMMANDS)
 
@@ -2319,14 +2510,125 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     fleet.add_argument(
+        "--selftest-jobs", action="store_true",
+        help=(
+            "Run the durable-jobs selftest: a fleet with --jobs-dir "
+            "takes a tar-manifest job over POST /jobs, the whole "
+            "process tree is SIGKILLed mid-drain, a second boot on "
+            "the same jobs dir replays the journal and resumes from "
+            "the stripe shards, and the merged results must be "
+            "byte-identical to a direct batch-detect --stripes run "
+            "with zero client-visible errors and an assembled "
+            "edge+executor+stripe trace; exit 0/1"
+        ),
+    )
+    fleet.add_argument(
+        "--jobs-dir", default=None, metavar="DIR",
+        help=(
+            "Serve the durable jobs tier (POST /jobs on the HTTP "
+            "edge): an append-only journal plus per-job stripe shards "
+            "under DIR — a SIGKILLed fleet rebooted on the same DIR "
+            "replays the journal and resumes interrupted jobs from "
+            "their shards.  Needs --http"
+        ),
+    )
+    fleet.add_argument(
+        "--jobs-concurrency", type=bounded(int, 1), default=1,
+        metavar="N",
+        help=(
+            "How many jobs may run their stripe trees at once "
+            "(default 1; each job already fans out --stripes worker "
+            "processes)"
+        ),
+    )
+    fleet.add_argument(
         "--stub", action="store_true",
         help=(
-            "With --selftest/--selftest-reload/--selftest-tcp: use "
-            "protocol-faithful stub workers (no device path) — "
-            "seconds instead of a JAX boot per worker"
+            "With --selftest/--selftest-reload/--selftest-tcp/"
+            "--selftest-jobs: use protocol-faithful stub workers "
+            "(no device path) — seconds instead of a JAX boot per "
+            "worker"
         ),
     )
     fleet.set_defaults(func=cmd_fleet)
+
+    jobs = sub.add_parser("jobs", help=_COMMAND_HELP["jobs"])
+    jobs.add_argument(
+        "action",
+        choices=["submit", "status", "results", "containers", "cancel",
+                 "wait"],
+        help=(
+            "submit a job spec, poll one job's lifecycle status, "
+            "fetch its merged results JSONL / container-verdict "
+            "sidecar, cancel it, or block until it reaches a "
+            "terminal state"
+        ),
+    )
+    jobs.add_argument(
+        "job_id", nargs="?", default=None,
+        help="The job id every action but submit operates on",
+    )
+    jobs.add_argument(
+        "--edge", required=True, metavar="HOST:PORT",
+        help="The fleet's HTTP edge (a `fleet --http --jobs-dir` door)",
+    )
+    jobs.add_argument(
+        "--token", default=None,
+        help="Bearer token for an --edge-token protected edge",
+    )
+    jobs.add_argument(
+        "--manifest", default=None, metavar="FILE",
+        help=(
+            "Submit this manifest (one entry per line; plain paths or "
+            "the ingest grammar — tar::MEMBER, zip::MEMBER, "
+            "repo.git::REV, * globs)"
+        ),
+    )
+    jobs.add_argument(
+        "--archive", default=None, metavar="PATH",
+        help=(
+            "Upload this local tar/zip with the submit (base64 in the "
+            "spec body); without --manifest the job classifies every "
+            "member (ARCHIVE::*)"
+        ),
+    )
+    jobs.add_argument(
+        "--stripes", type=bounded(int, 1), default=None, metavar="N",
+        help="Worker processes the job's batch-detect fans out to",
+    )
+    jobs.add_argument(
+        "--option", action="append", default=None, metavar="KEY=VALUE",
+        help=(
+            "Forwarded batch-detect knob (repeatable): batch_size, "
+            "workers, mesh, mode, corpus, method, confidence"
+        ),
+    )
+    jobs.add_argument(
+        "--idempotency-key", default=None, metavar="KEY",
+        help=(
+            "Duplicate-submit fence: a resubmit carrying the same key "
+            "answers the original job id instead of minting a new job"
+        ),
+    )
+    jobs.add_argument(
+        "--wait", action="store_true",
+        help="With submit: block until the job is terminal",
+    )
+    jobs.add_argument(
+        "--wait-timeout", type=bounded(float, 0.001), default=600.0,
+        metavar="SECS",
+        help="How long wait/--wait polls before giving up (default 600)",
+    )
+    jobs.add_argument(
+        "--timeout", type=bounded(float, 0.001), default=30.0,
+        metavar="SECS",
+        help="Per-round-trip edge timeout in seconds (default 30)",
+    )
+    jobs.add_argument(
+        "--output", default=None, metavar="PATH",
+        help="Write results/containers bytes here instead of stdout",
+    )
+    jobs.set_defaults(func=cmd_jobs)
 
     corpus_build = sub.add_parser(
         "corpus-build", help=_COMMAND_HELP["corpus-build"]
@@ -2370,7 +2672,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "traces", "slo", "fleet", "corpus-build", "-h", "--help"}
+    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "traces", "slo", "fleet", "corpus-build", "jobs", "-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

@@ -68,11 +68,18 @@ from licensee_tpu.serve.eventloop import (
 
 # the declared HTTP surface: (method, path) -> the wire-level meaning.
 # The protocol checker holds this table equal to
-# protocol_schema.HTTP_ROUTES, both directions.
+# protocol_schema.HTTP_ROUTES, both directions.  ``{id}`` paths are
+# templates: runtime matching parses the job id out of the path
+# (_job_template) and answers under the template's declared route.
 ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/classify"): "content",
     ("GET", "/healthz"): "health",
     ("GET", "/metrics"): "prometheus",
+    ("POST", "/jobs"): "job_submit",
+    ("GET", "/jobs/{id}"): "job_status",
+    ("GET", "/jobs/{id}/results"): "job_results",
+    ("GET", "/jobs/{id}/containers"): "job_containers",
+    ("DELETE", "/jobs/{id}"): "job_cancel",
 }
 
 # every status the edge may mint; _respond looks codes up here, so an
@@ -80,14 +87,29 @@ ROUTES: dict[tuple[str, str], str] = {
 # Checked equal to protocol_schema.HTTP_STATUS_CODES.
 STATUS_TEXT: dict[int, str] = {
     200: "OK",
+    202: "Accepted",
     400: "Bad Request",
     401: "Unauthorized",
     404: "Not Found",
     405: "Method Not Allowed",
+    409: "Conflict",
     413: "Payload Too Large",
     429: "Too Many Requests",
     500: "Internal Server Error",
     503: "Service Unavailable",
+}
+
+# the jobs tier's error vocabulary, spelled as module-level dict
+# literals so every mint site is one the wire-protocol contract
+# checker reads (an f-string body would hide the code)
+_JOBS_DISABLED = {
+    "error": "jobs_disabled: this edge serves no jobs tier "
+             "(start the fleet with --jobs-dir)",
+}
+_JOB_NOT_FOUND = {"error": "job_not_found: no such job id"}
+_JOB_NOT_DONE = {
+    "error": "job_not_done: the job has not completed; poll its "
+             "status first",
 }
 
 # error-code prefixes (the JSONL "error" field) -> HTTP status classes;
@@ -103,6 +125,28 @@ _EDGE_HIGH = 256
 _EDGE_LOW = 64
 
 _MAX_HEADERS = 64
+
+
+def _job_template(path: str) -> tuple[str, str] | None:
+    """Parse a ``/jobs/<id>[...]`` path into its declared route
+    template + the job id, or None when the shape is not a job path.
+    Ids are the executor's lowercase-hex mints; refusing anything
+    else keeps arbitrary client bytes out of filesystem joins."""
+    if not path.startswith("/jobs/"):
+        return None
+    rest = path[len("/jobs/"):]
+    job_id, _, tail = rest.partition("/")
+    if not job_id or not all(
+        c in "0123456789abcdef" for c in job_id
+    ) or len(job_id) > 32:
+        return None
+    if tail == "":
+        return "/jobs/{id}", job_id
+    if tail == "results":
+        return "/jobs/{id}/results", job_id
+    if tail == "containers":
+        return "/jobs/{id}/containers", job_id
+    return None
 
 
 class _TokenBucket:
@@ -268,7 +312,14 @@ class _EdgeSession:
         slot["method"] = self.method
         slot["path"] = self.path
         slot["keep_alive"] = self.keep_alive
-        if length > self.server.max_body_bytes:
+        # job submissions may carry an uploaded archive: they get the
+        # jobs body budget, every other route keeps the wire-row one
+        limit = (
+            self.server.max_job_body_bytes
+            if (self.method, self.path) == ("POST", "/jobs")
+            else self.server.max_body_bytes
+        )
+        if length > limit:
             # refusing to READ the body breaks framing by definition:
             # answer and burn
             self._respond(
@@ -276,7 +327,7 @@ class _EdgeSession:
                 _err_body(
                     "bad_request",
                     f"body {length} bytes over the "
-                    f"{self.server.max_body_bytes}-byte limit",
+                    f"{limit}-byte limit",
                 ),
                 burn=True,
             )
@@ -307,19 +358,28 @@ class _EdgeSession:
     # -- routing --
 
     def _route_verdict(self, slot: dict) -> tuple:
-        """("dispatch"|"health"|"metrics", client) or ("error", responder
-        args) — decided at end-of-headers, delivered at end-of-body."""
+        """("dispatch"|"health"|"metrics", client), ("jobs", client,
+        route, job_id), or ("error", responder args) — decided at
+        end-of-headers, delivered at end-of-body."""
         server = self.server
         method, path = slot["method"], slot["path"]
+        job_id = None
         route = ROUTES.get((method, path))
         if route is None:
-            known_path = any(p == path for _m, p in ROUTES)
-            if known_path:
-                return ("error", 405,
-                        _err_body("bad_request",
-                                  f"method {method} not allowed"))
-            return ("error", 404,
-                    _err_body("bad_request", f"no route {path}"))
+            template = _job_template(path)
+            if template is not None:
+                route = ROUTES.get((method, template[0]))
+                job_id = template[1]
+            if route is None:
+                known_path = any(
+                    p == path for _m, p in ROUTES
+                ) or template is not None
+                if known_path:
+                    return ("error", 405,
+                            _err_body("bad_request",
+                                      f"method {method} not allowed"))
+                return ("error", 404,
+                        _err_body("bad_request", f"no route {path}"))
         if route == "health":
             return ("health", None)
         client = self.peer
@@ -342,6 +402,11 @@ class _EdgeSession:
                     _err_body("queue_full",
                               "client over its request rate"),
                     [("Retry-After", str(max(1, math.ceil(wait))))])
+        if route.startswith("job_"):
+            if server.jobs is None:
+                return ("error", 503,
+                        json.dumps(_JOBS_DISABLED).encode("utf-8"))
+            return ("jobs", client, route, job_id)
         return ("dispatch", client)
 
     def _finish_request(self, slot: dict, body: bytes) -> None:
@@ -357,6 +422,9 @@ class _EdgeSession:
             return
         if kind == "metrics":
             self._defer_metrics(slot)
+            return
+        if kind == "jobs":
+            self._defer_job(slot, verdict[2], verdict[3], body)
             return
         line = body.decode("utf-8", errors="replace").strip()
         if not line or "\n" in line:
@@ -412,6 +480,34 @@ class _EdgeSession:
                     self._respond(slot, 200, payload, ctype=ctype)
                 else:
                     self._respond(slot, 500, payload)
+
+            loop.call_soon_threadsafe(fill)
+
+        server.router._ops.submit(run)
+
+    def _defer_job(self, slot: dict, route: str, job_id: str | None,
+                   body: bytes) -> None:
+        """Every jobs verb blocks (journal fsync, manifest/result file
+        I/O) — ops executor, never the loop, same contract as the
+        metrics scrape."""
+        server = self.server
+        loop = server.router.loop
+
+        def run() -> None:
+            try:
+                resp = _job_response(server, route, job_id, body)
+            except Exception as exc:  # noqa: BLE001 — session containment
+                resp = (
+                    500,
+                    _err_body("internal_error", str(exc)[:200]),
+                    (), "application/json",
+                )
+
+            def fill() -> None:
+                code, payload, extra, ctype = resp
+                self._respond(
+                    slot, code, payload, extra_headers=extra, ctype=ctype
+                )
 
             loop.call_soon_threadsafe(fill)
 
@@ -521,6 +617,140 @@ def _err_body(code: str, detail: str) -> bytes:
     return json.dumps({"error": f"{code}: {detail}"}).encode("utf-8")
 
 
+def _bad_spec(detail: str) -> tuple:
+    return (400, _err_body("bad_request", detail), (), "application/json")
+
+
+def _job_submit(server: "HttpEdgeServer", body: bytes) -> tuple:
+    """POST /jobs on an ops thread: decode the spec, stage an uploaded
+    archive into the jobs dir (the manifest then references it through
+    the ingest ``::*`` container grammar), validate, submit.  The edge
+    records its submit span under the SAME trace id the job adopts, so
+    the assembled tree runs edge -> executor -> stripes."""
+    import base64
+    import binascii
+
+    from licensee_tpu.jobs.executor import validate_spec
+
+    jobs = server.jobs
+    try:
+        row = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        return _bad_spec("body must be a JSON job spec")
+    if not isinstance(row, dict):
+        return _bad_spec("job spec must be a JSON object")
+    archive_b64 = row.get("archive_b64")
+    if archive_b64 is not None:
+        if not isinstance(archive_b64, str):
+            return _bad_spec("archive_b64 must be a base64 string")
+        try:
+            blob = base64.b64decode(archive_b64, validate=True)
+        except (binascii.Error, ValueError):
+            return _bad_spec("archive_b64 does not decode")
+        name = row.get("archive_name")
+        if not isinstance(name, str) or not name.strip():
+            name = "archive.tar"
+        saved = jobs.save_upload(name, blob)
+        if "manifest" not in row:
+            row = {**row, "manifest": [f"{saved}::*"]}
+    spec, problem = validate_spec(row)
+    if spec is None:
+        return _bad_spec(problem)
+    trace_in = row.get("trace")
+    tracer = server.router.obs.tracer
+    trace = tracer.start(
+        None,
+        trace_id=(
+            trace_in if isinstance(trace_in, str) and trace_in else None
+        ),
+    )
+    try:
+        job, created = jobs.submit(spec, trace_id=trace.trace_id)
+    except RuntimeError as exc:
+        tracer.finish(trace, "error")
+        return (
+            503, _err_body("jobs_disabled", str(exc)[:200]),
+            (), "application/json",
+        )
+    trace.add_span(
+        "edge.job_submit",
+        time.perf_counter() - trace.t_start,
+        t0=trace.t_start,
+    )
+    tracer.finish(trace, "ok" if created else "duplicate")
+    resp = {
+        "job_id": job.job_id,
+        "state": job.state,
+        "duplicate": not created,
+    }
+    extra = []
+    if job.trace_id:
+        resp["trace"] = job.trace_id
+        extra.append(("X-Trace-Id", str(job.trace_id)))
+    return (
+        202 if created else 200,
+        json.dumps(resp).encode("utf-8"),
+        extra, "application/json",
+    )
+
+
+def _job_response(server: "HttpEdgeServer", route: str,
+                  job_id: str | None, body: bytes) -> tuple:
+    """One jobs verb on an ops thread -> (code, payload, headers,
+    content type).  Unknown ids answer 404; results before completion
+    answer 409 (poll the status verb); the merged JSONL and the
+    container sidecar serve as raw bytes — the byte-identity contract
+    with a direct ``batch-detect --stripes`` run is the whole point."""
+    jobs = server.jobs
+    if route == "job_submit":
+        return _job_submit(server, body)
+    status = jobs.status(job_id)
+    if status is None:
+        return (
+            404, json.dumps(_JOB_NOT_FOUND).encode("utf-8"),
+            (), "application/json",
+        )
+    extra = []
+    trace = status.get("trace")
+    if trace:
+        extra.append(("X-Trace-Id", str(trace)))
+    if route == "job_status":
+        return (
+            200, json.dumps(status).encode("utf-8"),
+            extra, "application/json",
+        )
+    if route == "job_cancel":
+        row = jobs.cancel(job_id) or status
+        return (
+            202, json.dumps(row).encode("utf-8"),
+            extra, "application/json",
+        )
+    if status.get("state") != "completed":
+        row = dict(_JOB_NOT_DONE)
+        row["state"] = status.get("state")
+        return (
+            409, json.dumps(row).encode("utf-8"),
+            extra, "application/json",
+        )
+    results = jobs.results_path(job_id)
+    if route == "job_containers":
+        try:
+            with open(f"{results}.containers.jsonl", "rb") as f:
+                payload = f.read()
+        except OSError:
+            payload = b""  # loose-file jobs have no container sidecar
+        return (200, payload, extra, "application/jsonl")
+    try:
+        with open(results, "rb") as f:
+            payload = f.read()
+    except OSError as exc:
+        return (
+            500, _err_body("internal_error", str(exc)[:200]),
+            (), "application/json",
+        )
+    return (200, payload, extra, "application/jsonl")
+
+
 def _echo_headers(text: str) -> list[tuple[str, str]]:
     out = []
     trace = _field_from_line(text, "trace")
@@ -558,9 +788,14 @@ class HttpEdgeServer(LoopJsonlServer):
         quantum_bytes: int = 8192,
         max_inflight: int = 1024,
         max_body_bytes: int = 1 << 20,
+        max_job_body_bytes: int = 32 << 20,
         stall_timeout_s: float = 30.0,
+        jobs=None,
     ):
         self.router = router
+        # the jobs tier (licensee_tpu.jobs.JobExecutor), or None: the
+        # /jobs routes then answer 503 jobs_disabled
+        self.jobs = jobs
         router.loop.start()  # idempotent; the loop must carry accepts
         super().__init__(
             target, loop=router.loop, stall_timeout_s=stall_timeout_s
@@ -573,6 +808,7 @@ class HttpEdgeServer(LoopJsonlServer):
         self.quantum_bytes = int(quantum_bytes)
         self.max_inflight = int(max_inflight)
         self.max_body_bytes = int(max_body_bytes)
+        self.max_job_body_bytes = int(max_job_body_bytes)
         # DRR state (loop-thread only)
         self._queues: dict[str, deque[_EdgeRequest]] = {}
         self._ring: deque[str] = deque()

@@ -1,0 +1,37 @@
+"""The async jobs tier: durable batch jobs through the HTTP edge.
+
+The repo grew both halves of the paper's "scanning service" shape —
+the resume-safe striped batch engine (parallel/stripes.py) and the
+authenticated HTTP/1.1 edge (fleet/http_edge.py) — and this package is
+where they meet: ``POST /jobs`` accepts a manifest (or an uploaded
+archive routed through the ``ingest`` container grammar), a durable
+append-only journal makes the submission crash-proof, and a
+:class:`JobExecutor` drains accepted jobs through the exact
+StripeRunner machinery the CLI uses, resuming in-flight jobs from
+their stripe shards after a SIGKILL.
+
+House rules (script/lint): monotonic clocks only, no prints — job
+ordering is journal order, progress surfaces through callbacks and
+the HTTP status verb.
+"""
+
+from __future__ import annotations
+
+from licensee_tpu.jobs.executor import (
+    JOB_STATES,
+    TERMINAL_STATES,
+    Job,
+    JobExecutor,
+    validate_spec,
+)
+from licensee_tpu.jobs.journal import JobJournal, JournalError
+
+__all__ = [
+    "JOB_STATES",
+    "Job",
+    "JobExecutor",
+    "JobJournal",
+    "JournalError",
+    "TERMINAL_STATES",
+    "validate_spec",
+]

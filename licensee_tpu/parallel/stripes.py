@@ -52,6 +52,17 @@ House rules (script/lint): monotonic clocks only, and nothing is ever
 printed from this module — progress surfaces through the ``on_event``
 callback (the CLI points it at stderr), so the runner can never corrupt
 a pipeline that shares its stdout.
+
+The runner is a LIBRARY first and a CLI second: ``run()`` raises
+(``StripeError`` / ``StripeStopped``) instead of exiting, touches no
+terminal, and reports machine-readable lifecycle through the optional
+``on_progress(kind, info)`` callback (``spawn`` / ``stripe_done`` /
+``restart`` / ``progress`` / ``merged``) so an embedding parent — the
+jobs executor (licensee_tpu/jobs) is the first — can mirror stripe
+lifecycle into its own telemetry without parsing the human strings
+``on_event`` carries.  ``request_stop()`` stays signal-handler safe,
+and a stop surfaces as ``StripeStopped`` so parents can tell an
+operator cancel from a permanent failure.
 """
 
 from __future__ import annotations
@@ -78,6 +89,7 @@ from licensee_tpu.parallel.distributed import (
 __all__ = [
     "StripeError",
     "StripeRunner",
+    "StripeStopped",
     "auto_stripe_count",
     "count_manifest_entries",
     "load_scaling_model",
@@ -99,6 +111,14 @@ AUTO_STRIPE_CAP = 16
 class StripeError(RuntimeError):
     """A stripe failed permanently (restart budget exhausted), a shard
     failed verification at merge time, or the runner was stopped."""
+
+
+class StripeStopped(StripeError):
+    """The runner drained because ``request_stop()`` was called — not a
+    failure: the shards are resume-safe and a rerun continues.  A
+    subclass so existing ``except StripeError`` callers keep working
+    while an embedding parent (the jobs executor) can tell a cancel
+    from a crash."""
 
 
 def load_scaling_model(details_path: str | None = None) -> dict | None:
@@ -310,6 +330,7 @@ class StripeRunner:
         sigterm_timeout_s: float = 10.0,
         progress_every: float = 0,
         on_event=None,
+        on_progress=None,
         container_layout: dict | None = None,
     ):
         if stripes < 1:
@@ -386,6 +407,7 @@ class StripeRunner:
                 f"progress_every must be >= 0, got {progress_every!r}"
             )
         self._on_event = on_event
+        self._on_progress = on_progress
         self._stop_requested = False
         self.handles: list[_StripeHandle] = []
         for i in range(self.stripes):
@@ -421,6 +443,16 @@ class StripeRunner:
     def _event(self, message: str) -> None:
         if self._on_event is not None:
             self._on_event(message)
+
+    def _notify(self, kind: str, **info) -> None:
+        """Machine-readable lifecycle for embedding parents: ``kind``
+        is one of ``spawn`` / ``stripe_done`` / ``restart`` /
+        ``progress`` / ``merged``; ``info`` carries the stripe index
+        and whatever the event measured.  Runs on the supervising
+        thread — a callback that blocks stalls the poll loop, so
+        parents should only snapshot state here."""
+        if self._on_progress is not None:
+            self._on_progress(kind, info)
 
     # -- lifecycle primitives --
 
@@ -460,6 +492,9 @@ class StripeRunner:
             f"stripe {handle.index}: {why}; restart "
             f"{handle.restarts}/{self.max_restarts} in {delay:.2f}s "
             "(resuming from its shard's completed prefix)"
+        )
+        self._notify(
+            "restart", stripe=handle.index, why=why, delay_s=delay
         )
 
     def request_stop(self) -> None:
@@ -550,11 +585,14 @@ class StripeRunner:
                 f"stripe {handle.index}/{self.stripes}: pid "
                 f"{handle.pid} -> {os.path.basename(handle.shard)}"
             )
+            self._notify(
+                "spawn", stripe=handle.index, pid=handle.pid, first=True
+            )
         t_progress = t0
         while not all(h.done for h in self.handles):
             if self._stop_requested:
                 self._drain()
-                raise StripeError(
+                raise StripeStopped(
                     "stopped by operator before completion; shards are "
                     "resume-safe — rerun the same command to continue"
                 )
@@ -576,6 +614,10 @@ class StripeRunner:
                             f"stripe {handle.index}: respawned as pid "
                             f"{handle.pid}"
                         )
+                        self._notify(
+                            "spawn", stripe=handle.index,
+                            pid=handle.pid, first=False,
+                        )
                     continue
                 rc = proc.poll()
                 if rc is not None:
@@ -586,6 +628,7 @@ class StripeRunner:
                         self._event(
                             f"stripe {handle.index}: complete"
                         )
+                        self._notify("stripe_done", stripe=handle.index)
                         continue
                     changed = (
                         handle.changed_since_spawn
@@ -680,14 +723,22 @@ class StripeRunner:
                 and now - t_progress >= self.progress_every
             ):
                 t_progress = now
+                shard_bytes = [
+                    max(0, self._shard_size(h)) for h in self.handles
+                ]
+                done = sum(h.done for h in self.handles)
                 sizes = " ".join(
-                    f"{h.index}:{max(0, self._shard_size(h))}B"
+                    f"{h.index}:{shard_bytes[h.index]}B"
                     + ("(done)" if h.done else "")
                     for h in self.handles
                 )
                 self._event(
-                    f"progress: {sum(h.done for h in self.handles)}/"
+                    f"progress: {done}/"
                     f"{self.stripes} stripes done; shards {sizes}"
+                )
+                self._notify(
+                    "progress", done=done, stripes=self.stripes,
+                    shard_bytes=shard_bytes,
                 )
             time.sleep(self.poll_interval_s)
         summary = self._merge()
@@ -821,6 +872,7 @@ class StripeRunner:
             f"merged {self.stripes} shard(s) -> {self.output} "
             f"({total} rows)"
         )
+        self._notify("merged", rows=total, stripes=self.stripes)
         return {
             "stripes": self.stripes,
             "files": self.n_entries,

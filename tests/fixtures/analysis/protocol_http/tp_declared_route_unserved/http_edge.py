@@ -4,14 +4,21 @@ still declares it, and the client next door still asks for it."""
 ROUTES = {  # BAD
     ("POST", "/classify"): "content",
     ("GET", "/healthz"): "health",
+    ("POST", "/jobs"): "job_submit",
+    ("GET", "/jobs/{id}"): "job_status",
+    ("GET", "/jobs/{id}/results"): "job_results",
+    ("GET", "/jobs/{id}/containers"): "job_containers",
+    ("DELETE", "/jobs/{id}"): "job_cancel",
 }
 
 STATUS_TEXT = {
     200: "OK",
+    202: "Accepted",
     400: "Bad Request",
     401: "Unauthorized",
     404: "Not Found",
     405: "Method Not Allowed",
+    409: "Conflict",
     413: "Payload Too Large",
     429: "Too Many Requests",
     500: "Internal Server Error",

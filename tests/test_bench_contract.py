@@ -209,6 +209,19 @@ def _fat_details() -> dict:
                 "container_rows": 99_999_999,
             },
         },
+        "jobs": {
+            "files": 1_000_000,
+            "stripes": 64,
+            "direct_wall_s": 99999.999,
+            "direct_files_per_sec": 99_999_999.9,
+            "job_wall_s": 99999.999,
+            "job_files_per_sec": 99_999_999.9,
+            "vs_direct": 99.999,
+            "edge_overhead_frac": 99.999,
+            "overhead_under_10pct": True,
+            "submit_to_first_progress_s": 99999.999,
+            "identical_output": True,
+        },
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
         "scalar_agreement": {
@@ -247,8 +260,9 @@ def test_headline_line_fits_driver_capture(bench_mod):
     # warning line sharing the tail window (the BENCH_r06.json file
     # artifact is the durable copy regardless); re-pinned 1700 -> 1800
     # when the streaming-ingest block joined the headline, 1800 -> 1850
-    # when its striped_* keys joined (PR 15)
-    assert n <= 1850
+    # when its striped_* keys joined (PR 15), 1850 -> 1980 when the
+    # durable-jobs block joined (PR 16)
+    assert n <= 1980
 
 
 def test_headline_carries_the_headline_numbers(bench_mod):
@@ -302,6 +316,13 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     # identical + per-stripe rate vs loose-file striping
     assert d["ingest"]["striped_identical"] is True
     assert d["ingest"]["striped_vs_loose"] == 99.999
+    # the durable-jobs scalars (PR 16): edge-submitted job throughput
+    # vs the direct striped run, submit->first-progress latency, and
+    # the sha256-identical merged-output gate
+    assert d["jobs"]["job_files_per_sec"] == 99_999_999.9
+    assert d["jobs"]["vs_direct"] == 99.999
+    assert d["jobs"]["first_progress_s"] == 99999.999
+    assert d["jobs"]["identical_output"] is True
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 
@@ -311,10 +332,12 @@ def test_headline_survives_missing_rows(bench_mod):
     details = _fat_details()
     for k in ("end_to_end_1m", "end_to_end_1m_auto", "scalar_agreement",
               "end_to_end_readme", "serve_path", "fleet", "stripes",
-              "ingest"):
+              "ingest", "jobs"):
         details[k] = None
     headline = bench_mod.make_headline("m", 1.0, 1.0, details)
     assert headline["details"]["ingest"]["tar_files_per_sec"] is None
+    assert headline["details"]["jobs"]["job_files_per_sec"] is None
+    assert headline["details"]["jobs"]["identical_output"] is None
     assert headline["details"]["ingest"]["identical_output"] is None
     assert headline["details"]["at_scale_license"]["resume_ok"] is None
     assert headline["details"]["e2e_files_per_sec"]["readme"] is None
@@ -357,6 +380,19 @@ def test_fast_mode_ingest_keys_say_skipped(bench_mod):
     ingest = headline["details"]["ingest"]
     assert set(ingest) == set(bench_mod.INGEST_HEADLINE_KEYS)
     assert all(v == "skipped" for v in ingest.values()), ingest
+    line = json.dumps(headline, separators=(",", ":"))
+    assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
+
+
+def test_fast_mode_jobs_keys_say_skipped(bench_mod):
+    """The PR 16 satellite: fast mode stamps the details.jobs
+    headline keys "skipped" — not-run must never read as broken."""
+    details = _fat_details()
+    details["jobs"] = "skipped"
+    headline = bench_mod.make_headline("m", 1.0, 1.0, details)
+    jobs = headline["details"]["jobs"]
+    assert set(jobs) == set(bench_mod.JOBS_HEADLINE_KEYS)
+    assert all(v == "skipped" for v in jobs.values()), jobs
     line = json.dumps(headline, separators=(",", ":"))
     assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
 

@@ -1,0 +1,659 @@
+"""The durable jobs tier (PR 16): journal torn-tail replay, idempotent
+duplicate submits, cancel-mid-run, crash-resume via journal replay, and
+the HTTP jobs API end-to-end against a live stub fleet — all over stub
+runners (the real StripeRunner path is drilled by ``fleet
+--selftest-jobs`` and test_stripes.py)."""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from licensee_tpu.fleet.http_edge import HttpEdgeServer
+from licensee_tpu.fleet.router import Router
+from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+from licensee_tpu.jobs.client import JobsClient
+from licensee_tpu.jobs.executor import (
+    TERMINAL_STATES,
+    JobExecutor,
+    forward_args_for,
+    validate_spec,
+)
+from licensee_tpu.jobs.journal import JobJournal, JournalError
+from licensee_tpu.parallel.stripes import StripeStopped
+
+TOKEN = "test-jobs-token"
+
+
+# -- journal durability ------------------------------------------------
+
+
+def _journal(tmpdir):
+    return JobJournal(os.path.join(tmpdir, "journal.jsonl"))
+
+
+def test_journal_roundtrip_in_order():
+    with tempfile.TemporaryDirectory() as tmp:
+        j = _journal(tmp)
+        records = [
+            {"rec": "submit", "job": "aa", "spec": {"stripes": 1}},
+            {"rec": "state", "job": "aa", "state": "running"},
+            {"rec": "state", "job": "aa", "state": "completed"},
+        ]
+        for r in records:
+            j.append(r)
+        j.close()
+        assert _journal(tmp).replay() == records
+
+
+def test_journal_survives_reopen_and_appends():
+    with tempfile.TemporaryDirectory() as tmp:
+        j = _journal(tmp)
+        j.append({"rec": "submit", "job": "aa"})
+        j.close()
+        j2 = _journal(tmp)
+        j2.append({"rec": "state", "job": "aa", "state": "running"})
+        j2.close()
+        assert [r["rec"] for r in _journal(tmp).replay()] == [
+            "submit", "state",
+        ]
+
+
+def test_journal_torn_tail_without_newline_is_dropped():
+    with tempfile.TemporaryDirectory() as tmp:
+        j = _journal(tmp)
+        j.append({"rec": "submit", "job": "aa"})
+        j.append({"rec": "state", "job": "aa", "state": "running"})
+        j.close()
+        # a crash mid-append: the final line never got its newline
+        with open(j.path, "ab") as f:
+            f.write(b'{"rec":"state","job":"aa","sta')
+        replay = _journal(tmp).replay()
+        assert [r["rec"] for r in replay] == ["submit", "state"]
+
+
+def test_journal_torn_final_line_with_newline_is_dropped():
+    with tempfile.TemporaryDirectory() as tmp:
+        j = _journal(tmp)
+        j.append({"rec": "submit", "job": "aa"})
+        j.close()
+        # the newline page made it to disk but the line body is cut
+        with open(j.path, "ab") as f:
+            f.write(b'{"rec":"state","jo\n')
+        replay = _journal(tmp).replay()
+        assert [r["rec"] for r in replay] == ["submit"]
+
+
+def test_journal_corrupt_mid_file_refuses():
+    with tempfile.TemporaryDirectory() as tmp:
+        j = _journal(tmp)
+        j.append({"rec": "submit", "job": "aa"})
+        with open(j.path, "ab") as f:
+            f.write(b"not json\n")
+        j.append({"rec": "state", "job": "aa", "state": "running"})
+        j.close()
+        with pytest.raises(JournalError):
+            _journal(tmp).replay()
+
+
+def test_journal_missing_file_replays_empty():
+    with tempfile.TemporaryDirectory() as tmp:
+        assert _journal(tmp).replay() == []
+
+
+def test_journal_newline_in_values_stays_one_line():
+    # json escapes control characters, so a newline INSIDE a value can
+    # never tear the line framing — it must round-trip intact
+    with tempfile.TemporaryDirectory() as tmp:
+        j = _journal(tmp)
+        j.append({"rec": "submit", "note": "a\nb"})
+        j.close()
+        (rec,) = _journal(tmp).replay()
+        assert rec["note"] == "a\nb"
+
+
+# -- spec validation ---------------------------------------------------
+
+
+def test_validate_spec_normalizes():
+    spec, reason = validate_spec({
+        "manifest": ["  /a/b  ", "t.tar::*"],
+        "stripes": 2,
+        "options": {"batch_size": 16, "confidence": 1},
+        "idempotency_key": "k1",
+    })
+    assert reason is None
+    assert spec["manifest"] == ["/a/b", "t.tar::*"]
+    assert spec["options"]["confidence"] == 1.0  # int -> float coercion
+    assert forward_args_for(spec["options"]) == (
+        "--batch-size", "16", "--confidence", "1.0",
+    )
+
+
+@pytest.mark.parametrize("bad,why", [
+    ("nope", "object"),
+    ({}, "manifest"),
+    ({"manifest": []}, "manifest"),
+    ({"manifest": ["a\nb"]}, "newline"),
+    ({"manifest": ["a"], "stripes": 0}, "stripes"),
+    ({"manifest": ["a"], "stripes": True}, "stripes"),
+    ({"manifest": ["a"], "stripes": 999}, "stripes"),
+    ({"manifest": ["a"], "options": {"argv": ["rm"]}}, "option"),
+    ({"manifest": ["a"], "options": {"batch_size": "big"}}, "batch_size"),
+    ({"manifest": ["a"], "idempotency_key": "x" * 300}, "idempotency"),
+])
+def test_validate_spec_refuses(bad, why):
+    spec, reason = validate_spec(bad)
+    assert spec is None
+    assert why in reason
+
+
+# -- stub runners ------------------------------------------------------
+
+
+class _QuickRunner:
+    """Completes instantly: one deterministic output row per manifest
+    entry, plus the per-stripe stats artifact the status verb reads."""
+
+    def __init__(self, job, on_progress):
+        self.job = job
+        self.cb = on_progress
+        self._stop = False
+
+    def request_stop(self):
+        self._stop = True
+
+    def run(self):
+        self.cb("spawn", {"stripe": 0, "pid": os.getpid(), "first": True})
+        if self._stop:
+            raise StripeStopped("operator stop")
+        with open(self.job.manifest_path, encoding="utf-8") as f:
+            entries = [line.strip() for line in f if line.strip()]
+        with open(self.job.output_path, "w", encoding="utf-8") as f:
+            for e in entries:
+                f.write(json.dumps({"path": e, "key": "mit"}) + "\n")
+        with open(
+            f"{self.job.output_path}.stats.json", "w", encoding="utf-8"
+        ) as f:
+            json.dump({"total": len(entries)}, f)
+        self.cb("stripe_done", {"stripe": 0})
+        return {
+            "stripes": 1,
+            "rows_written": len(entries),
+            "elapsed_s": 0.01,
+            "files_per_sec": 1.0,
+            "already_complete": False,
+        }
+
+
+class _GateRunner(_QuickRunner):
+    """Blocks mid-run on an event; ``request_stop`` (cancel, close)
+    wakes it into StripeStopped — the resume-safe interruption."""
+
+    def __init__(self, job, on_progress, gate, poison):
+        super().__init__(job, on_progress)
+        self.gate = gate
+        self.poison = poison
+
+    def request_stop(self):
+        self._stop = True
+        self.gate.set()
+
+    def run(self):
+        self.cb("spawn", {"stripe": 0, "pid": os.getpid(), "first": True})
+        self.gate.wait(timeout=30.0)
+        if self._stop or self.poison.is_set():
+            raise StripeStopped("operator stop")
+        return super().run()
+
+
+def _wait_state(executor, job_id, states, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        row = executor.status(job_id)
+        if row and row["state"] in states:
+            return row
+        time.sleep(0.01)
+    raise AssertionError(
+        f"job {job_id} never reached {states}: {executor.status(job_id)}"
+    )
+
+
+def _spec(entries, key=None):
+    spec, reason = validate_spec({
+        "manifest": list(entries),
+        "stripes": 1,
+        "idempotency_key": key,
+    })
+    assert reason is None, reason
+    return spec
+
+
+# -- executor lifecycle ------------------------------------------------
+
+
+def test_executor_submit_runs_to_completed():
+    with tempfile.TemporaryDirectory() as tmp:
+        ex = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _QuickRunner(j, cb)
+        )
+        ex.start()
+        try:
+            job, created = ex.submit(_spec(["/a", "/b"], key="k1"))
+            assert created
+            row = _wait_state(ex, job.job_id, ("completed",))
+            assert row["rows_written"] == 2
+            assert row["files_classified"] == 2
+            assert row["stripes_done"] == 1
+            path = ex.results_path(job.job_id)
+            assert path and os.path.exists(path)
+            with open(path, encoding="utf-8") as f:
+                assert len(f.readlines()) == 2
+        finally:
+            ex.close()
+
+
+def test_executor_duplicate_key_returns_original_job():
+    with tempfile.TemporaryDirectory() as tmp:
+        ex = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _QuickRunner(j, cb)
+        )
+        ex.start()
+        try:
+            job, created = ex.submit(_spec(["/a"], key="dup"))
+            twin, twin_created = ex.submit(_spec(["/a"], key="dup"))
+            assert created and not twin_created
+            assert twin.job_id == job.job_id
+        finally:
+            ex.close()
+
+
+def test_executor_cancel_queued_job():
+    gate, poison = threading.Event(), threading.Event()
+
+    def factory(job, cb):
+        # first job blocks the single runner slot; later jobs queue
+        if job.spec.get("idempotency_key") == "blocker":
+            return _GateRunner(job, cb, gate, poison)
+        return _QuickRunner(job, cb)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ex = JobExecutor(tmp, max_concurrent=1, runner_factory=factory)
+        ex.start()
+        try:
+            blocker, _ = ex.submit(_spec(["/a"], key="blocker"))
+            _wait_state(ex, blocker.job_id, ("running",))
+            queued, _ = ex.submit(_spec(["/b"], key="victim"))
+            row = ex.cancel(queued.job_id)
+            assert row["state"] == "cancelled"
+            gate.set()
+            _wait_state(ex, blocker.job_id, ("completed",))
+            # the cancelled job never ran
+            assert ex.status(queued.job_id)["state"] == "cancelled"
+            assert ex.results_path(queued.job_id) is None
+        finally:
+            poison.set()
+            gate.set()
+            ex.close()
+
+
+def test_executor_cancel_running_job_is_terminal_across_restart():
+    gate, poison = threading.Event(), threading.Event()
+    with tempfile.TemporaryDirectory() as tmp:
+        ex = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _GateRunner(
+                j, cb, gate, poison
+            ),
+        )
+        ex.start()
+        job, _ = ex.submit(_spec(["/a"], key="k1"))
+        _wait_state(ex, job.job_id, ("running",))
+        ex.cancel(job.job_id)
+        row = _wait_state(ex, job.job_id, TERMINAL_STATES)
+        assert row["state"] == "cancelled"
+        ex.close()
+        # a terminal job is NOT re-enqueued by replay
+        ex2 = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _QuickRunner(j, cb)
+        )
+        ex2.start()
+        try:
+            assert ex2.status(job.job_id)["state"] == "cancelled"
+            assert ex2.resumed_jobs == 0
+        finally:
+            ex2.close()
+
+
+def test_executor_close_requeues_running_job_for_next_boot():
+    gate, poison = threading.Event(), threading.Event()
+    with tempfile.TemporaryDirectory() as tmp:
+        ex = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _GateRunner(
+                j, cb, gate, poison
+            ),
+        )
+        ex.start()
+        job, _ = ex.submit(_spec(["/a", "/b"], key="k1"))
+        _wait_state(ex, job.job_id, ("running",))
+        ex.close()  # drains: request_stop -> StripeStopped -> queued
+        ex2 = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _QuickRunner(j, cb)
+        )
+        ex2.start()
+        try:
+            row = _wait_state(ex2, job.job_id, ("completed",))
+            assert row["rows_written"] == 2
+        finally:
+            ex2.close()
+
+
+def test_executor_sigkill_replay_resumes_and_output_matches():
+    """The crash contract, simulated in-process: executor A dies with
+    the journal saying "running" (no close, no requeue record); B's
+    replay must resume the job and the output must be byte-identical
+    to an uninterrupted run of the same spec."""
+    gate, poison = threading.Event(), threading.Event()
+    entries = ["/a", "/b", "/c"]
+    with tempfile.TemporaryDirectory() as tmp_ref:
+        ref_ex = JobExecutor(
+            tmp_ref, runner_factory=lambda j, cb: _QuickRunner(j, cb)
+        )
+        ref_ex.start()
+        ref_job, _ = ref_ex.submit(_spec(entries, key="k1"))
+        _wait_state(ref_ex, ref_job.job_id, ("completed",))
+        with open(ref_ex.results_path(ref_job.job_id), "rb") as f:
+            ref_bytes = f.read()
+        ref_ex.close()
+    with tempfile.TemporaryDirectory() as tmp:
+        ex_a = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _GateRunner(
+                j, cb, gate, poison
+            ),
+        )
+        ex_a.start()
+        job, _ = ex_a.submit(_spec(entries, key="k1"))
+        _wait_state(ex_a, job.job_id, ("running",))
+        # "SIGKILL": abandon A mid-run — journal last record: running
+        ex_b = JobExecutor(
+            tmp, runner_factory=lambda j, cb: _QuickRunner(j, cb)
+        )
+        ex_b.start()
+        try:
+            assert ex_b.resumed_jobs == 1
+            row = _wait_state(ex_b, job.job_id, ("completed",))
+            assert row["resumed"] is True
+            with open(ex_b.results_path(job.job_id), "rb") as f:
+                assert f.read() == ref_bytes
+            # the idempotency key replayed too
+            twin, created = ex_b.submit(_spec(entries, key="k1"))
+            assert not created and twin.job_id == job.job_id
+        finally:
+            ex_b.close()
+            poison.set()
+            gate.set()
+            # join A's abandoned worker thread before the tempdir goes:
+            # its StripeStopped unwind still appends a requeue record
+            ex_a.close()
+
+
+def test_executor_failed_runner_lands_failed_with_error():
+    class _Boom(_QuickRunner):
+        def run(self):
+            raise ValueError("manifest exploded")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ex = JobExecutor(tmp, runner_factory=lambda j, cb: _Boom(j, cb))
+        ex.start()
+        try:
+            job, _ = ex.submit(_spec(["/a"]))
+            row = _wait_state(ex, job.job_id, TERMINAL_STATES)
+            assert row["state"] == "failed"
+            assert "manifest exploded" in row["error"]
+            assert ex.results_path(job.job_id) is None
+        finally:
+            ex.close()
+
+
+def test_executor_save_upload_is_content_addressed():
+    with tempfile.TemporaryDirectory() as tmp:
+        ex = JobExecutor(tmp, runner_factory=lambda j, cb: None)
+        p1 = ex.save_upload("x.tar", b"same bytes")
+        p2 = ex.save_upload("../evil/x.tar", b"same bytes")
+        p3 = ex.save_upload("x.tar", b"other bytes")
+        assert p1 == p2  # content-addressed, path traversal stripped
+        assert p1 != p3
+        assert os.path.dirname(p1) == os.path.join(tmp, "uploads")
+        with open(p1, "rb") as f:
+            assert f.read() == b"same bytes"
+        ex.journal.close()
+
+
+# -- the HTTP jobs API against a live stub fleet -----------------------
+
+
+def _stub_argv(name, sock):
+    return [
+        sys.executable, "-m", "licensee_tpu.fleet.faults",
+        "--socket", sock, "--name", name, "--service-ms", "1",
+    ]
+
+
+class _JobsFleet:
+    """Stub fleet + router + HTTP edge + a stub-runner JobExecutor."""
+
+    def __init__(self, runner_factory=None, jobs=True):
+        self.tmp = tempfile.mkdtemp(prefix="licensee-jobs-test-")
+        sockets = {"w0": os.path.join(self.tmp, "w0.sock")}
+        self.supervisor = Supervisor(
+            sockets, argv_for=_stub_argv,
+            env_for=lambda name, chips: worker_env(None, None),
+            probe_interval_s=0.1, backoff_base_s=0.1, backoff_max_s=1.0,
+        )
+        self.supervisor.start()
+        assert self.supervisor.wait_healthy(30.0)
+        self.router = Router(
+            sockets, supervisor=self.supervisor,
+            probe_interval_s=0.1, request_timeout_s=10.0,
+            dispatch_wait_s=5.0, trace_sample=1.0,
+        )
+        self.router.start()
+        self.executor = None
+        if jobs:
+            factory = runner_factory or (
+                lambda j, cb: _QuickRunner(j, cb)
+            )
+            self.executor = JobExecutor(
+                os.path.join(self.tmp, "jobs"),
+                max_concurrent=1,
+                registry=self.router.obs.registry,
+                runner_factory=factory,
+            )
+            self.executor.start()
+            self.router.collector.add_source(
+                "jobs", self.executor.trace_tail
+            )
+        self.edge = HttpEdgeServer(
+            "127.0.0.1:0", self.router,
+            tokens={TOKEN: "tester"}, rate_per_client=10000.0,
+            stall_timeout_s=1.0, jobs=self.executor,
+        )
+        self.port = self.edge.bound_port
+        self.thread = threading.Thread(
+            target=self.edge.serve_forever,
+            kwargs={"poll_interval": 0.05}, daemon=True,
+        )
+        self.thread.start()
+
+    def client(self, token=TOKEN):
+        return JobsClient(f"127.0.0.1:{self.port}", token=token)
+
+    def close(self):
+        self.edge.shutdown()
+        self.edge.server_close()
+        self.thread.join(timeout=5.0)
+        if self.executor is not None:
+            self.executor.close()
+        self.router.close()
+        self.supervisor.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def test_edge_jobs_api_full_flow():
+    with _JobsFleet() as fleet:
+        client = fleet.client()
+        try:
+            code, row = client.submit({
+                "manifest": ["/a", "/b"],
+                "stripes": 1,
+                "idempotency_key": "flow",
+            })
+            assert code == 202 and row["state"] == "queued"
+            assert not row["duplicate"]
+            job_id = row["job_id"]
+            assert row.get("trace")  # the edge minted a submit trace
+            final = client.wait(job_id, timeout_s=15.0)
+            assert final["state"] == "completed"
+            assert final["rows_written"] == 2
+            # duplicate POST, same key: the ORIGINAL id, 200 not 202
+            code, dup = client.submit({
+                "manifest": ["/a", "/b"],
+                "stripes": 1,
+                "idempotency_key": "flow",
+            })
+            assert code == 200 and dup["job_id"] == job_id
+            assert dup["duplicate"]
+            code, payload = client.results(job_id)
+            assert code == 200
+            rows = [json.loads(l) for l in payload.splitlines()]
+            assert [r["path"] for r in rows] == ["/a", "/b"]
+            # no container sidecar for a loose-path job: empty 200
+            code, payload = client.containers(job_id)
+            assert code == 200 and payload == b""
+        finally:
+            client.close()
+
+
+def test_edge_jobs_error_codes():
+    gate, poison = threading.Event(), threading.Event()
+
+    def factory(job, cb):
+        return _GateRunner(job, cb, gate, poison)
+
+    with _JobsFleet(runner_factory=factory) as fleet:
+        client = fleet.client()
+        try:
+            # unknown id -> 404 job_not_found
+            code, row = client.status("deadbeefdead")
+            assert code == 404 and row["error"].startswith("job_not_found")
+            # an id that is not lowercase hex never reaches the jobs
+            # tier: unknown route -> 404
+            code, _hdrs, _body = client.request("GET", "/jobs/NOPE!")
+            assert code == 404
+            # malformed body -> 400 bad_request
+            code, _hdrs, body = client.request(
+                "POST", "/jobs", b"{nope"
+            )
+            assert code == 400
+            assert json.loads(body)["error"].startswith("bad_request")
+            # a valid submit against the gated runner...
+            code, row = client.submit({"manifest": ["/a"], "stripes": 1})
+            assert code == 202
+            job_id = row["job_id"]
+            # ...results before completion -> 409 job_not_done
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                code, srow = client.status(job_id)
+                if srow.get("state") == "running":
+                    break
+                time.sleep(0.01)
+            code, payload = client.results(job_id)
+            assert code == 409
+            assert json.loads(payload)["error"].startswith("job_not_done")
+            # cancel -> 202, terminal state cancelled
+            code, row = client.cancel(job_id)
+            assert code == 202
+            final = client.wait(job_id, timeout_s=15.0)
+            assert final["state"] == "cancelled"
+            # wrong bearer token -> 401 before any jobs logic
+            bad = fleet.client(token="wrong")
+            try:
+                code, _row = bad.submit({"manifest": ["/a"]})
+                assert code == 401
+            finally:
+                bad.close()
+        finally:
+            poison.set()
+            gate.set()
+            client.close()
+
+
+def test_edge_jobs_disabled_answers_503():
+    with _JobsFleet(jobs=False) as fleet:
+        client = fleet.client()
+        try:
+            code, row = client.submit({"manifest": ["/a"]})
+            assert code == 503
+            assert row["error"].startswith("jobs_disabled")
+        finally:
+            client.close()
+
+
+def test_edge_job_archive_upload_submit():
+    import base64
+    import io
+    import tarfile
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        data = b"MIT License\n"
+        info = tarfile.TarInfo(name="pkg/LICENSE")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    with _JobsFleet() as fleet:
+        client = fleet.client()
+        try:
+            code, row = client.submit({
+                "archive_b64": base64.b64encode(buf.getvalue()).decode(),
+                "archive_name": "up.tar",
+                "stripes": 1,
+            })
+            assert code == 202, row
+            final = client.wait(row["job_id"], timeout_s=15.0)
+            assert final["state"] == "completed"
+            # the staged upload became the job's one manifest entry
+            job = fleet.executor.job(row["job_id"])
+            (entry,) = job.spec["manifest"]
+            assert entry.endswith("-up.tar::*")
+            assert os.path.exists(entry.split("::", 1)[0])
+        finally:
+            client.close()
+
+
+def test_edge_jobs_metrics_ride_the_fleet_exposition():
+    with _JobsFleet() as fleet:
+        client = fleet.client()
+        try:
+            code, row = client.submit({"manifest": ["/a"], "stripes": 1})
+            assert code == 202
+            client.wait(row["job_id"], timeout_s=15.0)
+        finally:
+            client.close()
+        # the fleet exposition injects worker="router" onto the
+        # router-registry series the executor registered into
+        import re
+
+        exposition = fleet.router.prometheus()
+        for series in ("jobs_submitted_total", "jobs_completed_total"):
+            assert re.search(
+                rf'{series}\{{[^}}]*\}} 1(\.0)?$', exposition, re.M
+            ), f"{series} missing from the fleet exposition"
+        assert "jobs_queue_depth" in exposition
