@@ -306,6 +306,58 @@ def _atomic_write(path: str, text: str) -> None:
     os.replace(tmp, path)
 
 
+class _PromHeartbeat:
+    """Periodic atomic ``--prom-file`` dumps while a batch run is in
+    flight, each stamped with a monotonically increasing
+    ``stripe_scrape_epoch`` gauge.  The elastic stripe supervisor
+    scrapes these for the live ``pipeline_*_busy`` lane gauges; the
+    epoch is its freshness proof — a just-killed stripe's last dump
+    stops advancing and reads as stale, never as a live lane snapshot.
+    The final end-of-run dump (_dump_run_artifacts) then overwrites
+    the heartbeat with the complete exposition the merge consumes."""
+
+    def __init__(self, path: str, interval_s: float = 1.0):
+        import threading
+
+        from licensee_tpu.obs import get_registry, render_prometheus
+
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._epoch = 0
+        self._render = render_prometheus
+        self._registry = get_registry()
+        self._registry.gauge(
+            "stripe_scrape_epoch",
+            "Monotonic heartbeat counter stamped into every periodic "
+            "--prom-file dump; an autoscaler accepts the exposition "
+            "only while this advances",
+        ).set_fn(lambda: self._epoch)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="prom-heartbeat", daemon=True
+        )
+
+    def _beat(self) -> None:
+        self._epoch += 1
+        try:
+            _atomic_write(self.path, self._render(self._registry))
+        except OSError:
+            pass  # a torn disk must not kill the run; the merge retries
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._beat()
+
+    def start(self) -> "_PromHeartbeat":
+        self._beat()  # first exposition lands before the first batch
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
 def _check_output_dir(output: str) -> str | None:
     """Preflight the one --output misconfiguration we can name
     precisely; returns the error message, or None when fine.  Shared by
@@ -369,6 +421,24 @@ def _run_striped(args) -> int:
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
+    # --stripes elastic: start at the lower bound and let the runner's
+    # autoscaler walk the stripe count against the measured per-stripe
+    # featurize-lane occupancy (scraped from each worker's --prom-file
+    # heartbeat); every scale event is a drain + resume-safe respawn
+    elastic = None
+    if n_stripes == "elastic":
+        from licensee_tpu.parallel.autoscale import AutoscaleConfig
+
+        try:
+            elastic = AutoscaleConfig(
+                min_units=args.autoscale_min,
+                max_units=args.autoscale_max,
+                cooldown_s=args.autoscale_cooldown,
+            )
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        n_stripes = elastic.min_units
     # preflight the cheap misconfigurations here instead of paying one
     # restart-backoff cycle per stripe for them
     dir_err = _check_output_dir(args.output)
@@ -518,6 +588,7 @@ def _run_striped(args) -> int:
             progress_every=args.progress,
             on_event=event,
             container_layout=probe_layout,
+            elastic=elastic,
         )
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
@@ -577,6 +648,13 @@ def _run_striped(args) -> int:
             )
     if args.stats and summary.get("stats") is not None:
         print(json.dumps(summary["stats"]), file=sys.stderr)
+    if summary.get("autoscale"):
+        auto = summary["autoscale"]
+        event(
+            f"autoscale: {auto['initial_stripes']} -> "
+            f"{auto['final_stripes']} stripes over "
+            f"{auto['scale_events']} rescale(s)"
+        )
     event(
         f"done: {summary['rows_written']} rows in "
         f"{summary.get('elapsed_s', 0.0)}s"
@@ -644,6 +722,10 @@ def cmd_batch_detect(args) -> int:
         from licensee_tpu.parallel.stripes import selftest
 
         return selftest()
+    if args.selftest_autoscale:
+        from licensee_tpu.parallel.stripes import selftest_autoscale
+
+        return selftest_autoscale()
     if not args.manifest:
         print(
             "error: need a manifest (one path per line), or --selftest",
@@ -784,6 +866,12 @@ def cmd_batch_detect(args) -> int:
 
         jax.profiler.start_trace(args.profile)
         profiler = args.profile
+    # live --prom-file heartbeat (epoch-stamped): what the elastic
+    # stripe supervisor scrapes mid-run for the lane gauges; the final
+    # _dump_run_artifacts exposition overwrites it at exit
+    heartbeat = None
+    if args.prom_file and args.output:
+        heartbeat = _PromHeartbeat(args.prom_file).start()
     try:
         if args.output:
             # preflight the one misconfiguration we can name precisely;
@@ -870,6 +958,8 @@ def cmd_batch_detect(args) -> int:
                     )
             stats = project.stats
     finally:
+        if heartbeat is not None:
+            heartbeat.stop()
         project.close()
         if profiler:
             import jax
@@ -2001,7 +2091,7 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     batch.add_argument(
-        "--stripes", default=None, metavar="N|auto",
+        "--stripes", default=None, metavar="N|auto|elastic",
         help=(
             "Scale out across N co-located worker processes, each "
             "classifying a contiguous manifest stripe into its own "
@@ -2010,7 +2100,32 @@ def build_parser() -> argparse.ArgumentParser:
             "merge shards/stats/metrics deterministically — the merged "
             "output is bit-identical to a 1-process run.  'auto' sizes "
             "from the host core count and the bench scaling model "
-            "(BENCH_DETAILS.json).  Needs --output"
+            "(BENCH_DETAILS.json).  'elastic' starts at --autoscale-min "
+            "and grows/shrinks the stripe count live against each "
+            "worker's measured featurize-lane occupancy (scraped from "
+            "its --prom-file heartbeat); every scale event is a drain + "
+            "resume-safe respawn.  Needs --output"
+        ),
+    )
+    batch.add_argument(
+        "--autoscale-min", type=bounded(int, 1), default=1, metavar="N",
+        help="With --stripes elastic: lower capacity bound (default 1)",
+    )
+    batch.add_argument(
+        "--autoscale-max", type=bounded(int, 1), default=8, metavar="N",
+        help=(
+            "With --stripes elastic: upper capacity bound (default 8); "
+            "units beyond the host's useful stripe count become "
+            "per-stripe --featurize-procs"
+        ),
+    )
+    batch.add_argument(
+        "--autoscale-cooldown", type=nonneg(float), default=30.0,
+        metavar="SECS",
+        help=(
+            "With --stripes elastic: minimum seconds between scale "
+            "events (default 30) — the new capacity needs time to show "
+            "up in the signal it is judged by"
         ),
     )
     batch.add_argument(
@@ -2055,6 +2170,15 @@ def build_parser() -> argparse.ArgumentParser:
             "Run the 2-stripe CPU smoke (real worker subprocesses over "
             "a synthetic corpus; merged output must be bit-identical "
             "to a 1-stripe run) and exit 0/1 — the CI smoke"
+        ),
+    )
+    batch.add_argument(
+        "--selftest-autoscale", action="store_true",
+        help=(
+            "Run the elastic-stripes drill (stub workers under the real "
+            "drain/respawn/resume machinery: a saturated featurize lane "
+            "must scale up, an idle one back down, and the merged "
+            "output must stay bit-identical) and exit 0/1"
         ),
     )
     batch.add_argument("--stats", action="store_true",

@@ -386,14 +386,24 @@ class License(NormalizedContent):
 
 
 @functools.cache
-def global_title_regex() -> re.Pattern:
-    """The corpus-wide title-strip regex (content_helper.rb:199-215):
-    any license title (or unversioned name), optionally parenthesized or
-    preceded by 'the', through end of line."""
+def global_title_parts() -> tuple[str, ...]:
+    """The alternatives of the corpus-wide title union, in union order.
+
+    Shared by :func:`global_title_regex` and the native pipeline's
+    literal-prefix gate derivation (licensee_tpu/native/pipeline.py), so
+    the gate can never drift from the pattern it fronts."""
     licenses = License.all(hidden=True, pseudo=False)
     parts = [lic.title_regex_pattern for lic in licenses]
     for lic in licenses:
         if lic.title != lic.name_without_version:
             parts.append(f"(?i:{regexp_escape(lic.name_without_version)})")
-    union = "|".join(parts)
+    return tuple(parts)
+
+
+@functools.cache
+def global_title_regex() -> re.Pattern:
+    """The corpus-wide title-strip regex (content_helper.rb:199-215):
+    any license title (or unversioned name), optionally parenthesized or
+    preceded by 'the', through end of line."""
+    union = "|".join(global_title_parts())
     return rb(r"\A\s*\(?(?:the )?(?:" + union + r").*?$", i=True)

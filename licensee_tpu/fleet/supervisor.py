@@ -234,6 +234,14 @@ class Supervisor:
         # the router attaches itself here (fleet CLI): drain then also
         # waits for the router's outstanding count to hit zero
         self.router = None
+        # spawn ingredients, kept so add_worker can grow the fleet at
+        # runtime from the same spec the seed workers got
+        self._argv_for = argv_for
+        self._env_for = env_for
+        self._serve_args = tuple(serve_args)
+        self._base_env = base_env
+        self._chips_per_worker = chips_per_worker
+        self._next_chip_index = len(workers)
         self.workers: dict[str, WorkerHandle] = {}
         for i, (name, sock) in enumerate(workers.items()):
             chips = None
@@ -322,6 +330,68 @@ class Supervisor:
 
     def __exit__(self, *exc):
         self.stop()
+
+    # -- elastic membership (the FleetAutoscaler's two levers) --
+
+    def add_worker(self, name: str, socket_path: str) -> WorkerHandle:
+        """Grow the fleet by one worker at runtime: build its spec from
+        the same ingredients the seed fleet used (``argv_for`` /
+        ``env_for`` / chip striping continue where the seed stopped),
+        spawn it immediately, and register its probe pool.  The monitor
+        thread picks it up on its next pass."""
+        with self._lock:
+            if name in self.workers:
+                raise ValueError(f"worker {name!r} already exists")
+            chips = None
+            if self._chips_per_worker is not None:
+                chips = chips_for_worker(
+                    self._next_chip_index, self._chips_per_worker
+                )
+            self._next_chip_index += 1
+            env = (
+                self._env_for(name, chips)
+                if self._env_for is not None
+                else worker_env(self._base_env, chips)
+            )
+            argv = (
+                self._argv_for(name, socket_path)
+                if self._argv_for is not None
+                else default_worker_argv(socket_path, self._serve_args)
+            )
+            handle = WorkerHandle(name, socket_path, argv, env)
+            self.workers[name] = handle
+            self._probe_pools[name] = ConnectionPool(
+                socket_path, max_idle=1,
+                connect_timeout=self.probe_timeout_s,
+            )
+            self._spawn(handle)
+        return handle
+
+    def remove_worker(
+        self,
+        name: str,
+        *,
+        timeout_s: float = 30.0,
+        sigterm_timeout_s: float = 5.0,
+    ) -> bool:
+        """Retire one worker at runtime: drain it (stop dispatch, wait
+        for in-flight work, SIGTERM — no respawn), then drop it from
+        the fleet and close its probe pool.  Returns the drain's clean
+        flag.  The drain marks the handle STOPPED before membership
+        changes, so a monitor pass that already snapshotted the handle
+        skips it instead of respawning a ghost."""
+        if name not in self.workers:
+            raise KeyError(f"no worker named {name!r}")
+        clean = self.drain(
+            name, timeout_s=timeout_s, restart=False,
+            sigterm_timeout_s=sigterm_timeout_s,
+        )
+        with self._lock:
+            self.workers.pop(name, None)
+        pool = self._probe_pools.pop(name, None)
+        if pool is not None:
+            pool.close()
+        return clean
 
     # -- spawn / kill primitives (lock held by callers where noted) --
 
@@ -504,14 +574,20 @@ class Supervisor:
     def probe(self, name: str) -> dict | None:
         """One ``{"op": "stats"}`` round trip to a worker; the stats
         dict, or None when the worker cannot answer."""
-        pool = self._probe_pools.get(name)
-        if pool is None:  # dynamically added worker (tests)
-            handle = self.workers[name]
-            pool = ConnectionPool(
-                handle.socket_path, max_idle=1,
-                connect_timeout=self.probe_timeout_s,
-            )
-            self._probe_pools[name] = pool
+        with self._lock:
+            pool = self._probe_pools.get(name)
+            if pool is None:  # dynamically added worker (tests)
+                handle = self.workers.get(name)
+                if handle is None:
+                    # removed concurrently (remove_worker): not an
+                    # error — a monitor pass that raced the removal
+                    # just moves on
+                    return None
+                pool = ConnectionPool(
+                    handle.socket_path, max_idle=1,
+                    connect_timeout=self.probe_timeout_s,
+                )
+                self._probe_pools[name] = pool
         try:
             row = pool.request({"op": "stats"}, self.probe_timeout_s)
         except WireError:

@@ -72,6 +72,16 @@ _OPTION_FORWARD: dict[str, tuple[type, str]] = {
     "confidence": (float, "--confidence"),
 }
 
+# runner-level options, typed like the forwarded ones but consumed by
+# the SUPERVISOR (the elastic autoscaler's bounds/cooldown) — they
+# never reach child argv, and they only make sense with
+# spec.stripes == "elastic"
+_RUNNER_OPTIONS: dict[str, type] = {
+    "autoscale_min": int,
+    "autoscale_max": int,
+    "autoscale_cooldown_s": float,
+}
+
 _MAX_MANIFEST_ENTRIES = 1_000_000
 _MAX_STRIPES = 64
 
@@ -100,10 +110,14 @@ def validate_spec(spec) -> tuple[dict | None, str | None]:
             return None, "spec.manifest entries must not embed newlines"
         entries.append(entry.strip())
     stripes = spec.get("stripes", 1)
-    if not isinstance(stripes, int) or isinstance(stripes, bool) or not (
-        1 <= stripes <= _MAX_STRIPES
+    if stripes != "elastic" and (
+        not isinstance(stripes, int) or isinstance(stripes, bool)
+        or not (1 <= stripes <= _MAX_STRIPES)
     ):
-        return None, f"spec.stripes must be an int in [1, {_MAX_STRIPES}]"
+        return None, (
+            f"spec.stripes must be an int in [1, {_MAX_STRIPES}] or "
+            "'elastic'"
+        )
     options = spec.get("options", {})
     if not isinstance(options, dict):
         return None, "spec.options must be an object"
@@ -111,13 +125,30 @@ def validate_spec(spec) -> tuple[dict | None, str | None]:
     for name, value in options.items():
         typed = _OPTION_FORWARD.get(name)
         if typed is None:
-            return None, f"unknown option {name!r}"
-        want, _flag = typed
+            want = _RUNNER_OPTIONS.get(name)
+            if want is None:
+                return None, f"unknown option {name!r}"
+            if stripes != "elastic":
+                return None, (
+                    f"option {name!r} needs spec.stripes = 'elastic'"
+                )
+        else:
+            want, _flag = typed
         if want is float and isinstance(value, int):
             value = float(value)
         if not isinstance(value, want) or isinstance(value, bool):
             return None, f"option {name!r} must be {want.__name__}"
         normalized_options[name] = value
+    if stripes == "elastic":
+        lo = normalized_options.get("autoscale_min", 1)
+        hi = normalized_options.get("autoscale_max", 8)
+        if not 1 <= lo <= hi <= _MAX_STRIPES:
+            return None, (
+                "need 1 <= autoscale_min <= autoscale_max <= "
+                f"{_MAX_STRIPES}, got [{lo}, {hi}]"
+            )
+        if normalized_options.get("autoscale_cooldown_s", 30.0) < 0:
+            return None, "autoscale_cooldown_s must be >= 0"
     key = spec.get("idempotency_key")
     if key is not None and (
         not isinstance(key, str) or not key or len(key) > 200
@@ -135,7 +166,10 @@ def forward_args_for(options: dict) -> tuple[str, ...]:
     """The child argv fragment a normalized options dict forwards."""
     forward: list[str] = []
     for name, value in sorted(options.items()):
-        _want, flag = _OPTION_FORWARD[name]
+        typed = _OPTION_FORWARD.get(name)
+        if typed is None:
+            continue  # runner-level option (autoscale_*): never argv
+        _want, flag = typed
         forward += [flag, str(value)]
     return tuple(forward)
 
@@ -500,16 +534,29 @@ class JobExecutor:
     def _build_runner(self, job: Job, on_progress) -> StripeRunner:
         spec = job.spec
         forward = forward_args_for(spec["options"])
+        stripes = spec["stripes"]
+        elastic = None
+        if stripes == "elastic":
+            from licensee_tpu.parallel.autoscale import AutoscaleConfig
+
+            opts = spec["options"]
+            elastic = AutoscaleConfig(
+                min_units=opts.get("autoscale_min", 1),
+                max_units=opts.get("autoscale_max", 8),
+                cooldown_s=opts.get("autoscale_cooldown_s", 30.0),
+            )
+            stripes = elastic.min_units
         return StripeRunner(
             job.manifest_path,
             job.output_path,
-            spec["stripes"],
+            stripes,
             forward_args=forward,
             resume=True,
             auto_clamp=True,
             base_env=self.base_env,
             progress_every=0.25,
             on_progress=on_progress,
+            elastic=elastic,
         )
 
     def _run_job(self, job: Job) -> None:
@@ -595,9 +642,15 @@ class JobExecutor:
     def _read_stripe_stats(self, job: Job, index: int) -> dict | None:
         """The per-stripe ``--stats-file`` artifact, once that stripe's
         child exited clean — the progress the status verb reports."""
-        shard = shard_output_path(
-            job.output_path, index, job.spec["stripes"]
-        )
+        stripes = job.spec["stripes"]
+        if not isinstance(stripes, int):
+            # elastic: the shard layout is whatever the runner is
+            # currently at (an autoscale rescale renames the shards)
+            runner = job.runner
+            if runner is None:
+                return None
+            stripes = runner.stripes
+        shard = shard_output_path(job.output_path, index, stripes)
         try:
             with open(f"{shard}.stats.json", encoding="utf-8") as f:
                 row = json.load(f)

@@ -58,6 +58,215 @@ def _pcre_pattern(pattern: re.Pattern) -> bytes:
     return pattern.pattern.replace("\\Z", "\\z").encode("utf-8")
 
 
+_TITLE_PREFIX_LEN = 6
+
+
+def _title_prefixes_for(part: str, k: int = _TITLE_PREFIX_LEN) -> set[str] | None:
+    """Lowercase literal prefixes covering every caseless match of one
+    title-union alternative, or None when underivable.
+
+    A conservative mini-parser over the pattern strings
+    ``License.title_regex_pattern`` actually constructs (literals,
+    escapes, ``(?i:``/``(?:...)?`` groups, small char classes): each
+    returned prefix is a run of characters every match MUST start with,
+    so a text matching none of them provably cannot match the
+    alternative.  Anything the parser cannot bound returns the prefix
+    accumulated so far (still sound — those characters are mandatory)
+    or None when no character is guaranteed at all; the caller disables
+    the native gate entirely on any None."""
+    out: set[str] = set()
+    budget = [256]
+
+    def lc(ch: str) -> str:
+        # ASCII-only fold: PCRE2 runs the union caseless in 8-bit byte
+        # mode, where non-ASCII bytes never case-fold
+        return ch.lower() if "A" <= ch <= "Z" else ch
+
+    def stop(acc: str) -> bool:
+        if not acc:
+            return False
+        out.add(acc)
+        return True
+
+    def group_end(s: str, i: int) -> int | None:
+        depth = 0
+        while i < len(s):
+            c = s[i]
+            if c == "\\":
+                i += 2
+                continue
+            if c == "[":
+                j = s.find("]", i + 1)
+                if j < 0:
+                    return None
+                i = j + 1
+                continue
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    return i + 1
+            i += 1
+        return None
+
+    def split_alts(s: str) -> list[str] | None:
+        parts, depth, cur, i = [], 0, "", 0
+        while i < len(s):
+            c = s[i]
+            if c == "\\":
+                cur += s[i:i + 2]
+                i += 2
+                continue
+            if c == "[":
+                j = s.find("]", i + 1)
+                if j < 0:
+                    return None
+                cur += s[i:j + 1]
+                i = j + 1
+                continue
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+            elif c == "|" and depth == 0:
+                parts.append(cur)
+                cur = ""
+                i += 1
+                continue
+            cur += c
+            i += 1
+        parts.append(cur)
+        return parts
+
+    def lit_step(ch: str, rest: str, acc: str) -> bool:
+        quant = rest[0] if rest else ""
+        if quant == "?":
+            return walk(rest[1:], acc + lc(ch)) and walk(rest[1:], acc)
+        if quant == "+":
+            # at least one occurrence is guaranteed, then repetition is
+            # unbounded: stop extending here
+            return stop(acc + lc(ch))
+        if quant and quant in "*{":
+            return stop(acc)
+        return walk(rest, acc + lc(ch))
+
+    def walk(s: str, acc: str) -> bool:
+        budget[0] -= 1
+        if budget[0] < 0:
+            return False
+        if len(acc) >= k:
+            out.add(acc[:k])
+            return True
+        if not s:
+            return stop(acc)
+        c = s[0]
+        if c == "(":
+            if s.startswith("(?:"):
+                body_start = 3
+            elif s.startswith("(?i:"):
+                body_start = 4
+            elif s.startswith("(?"):
+                return stop(acc)  # lookaround/flags: out of scope
+            else:
+                body_start = 1
+            e = group_end(s, 0)
+            if e is None:
+                return stop(acc)
+            body = s[body_start:e - 1]
+            rest = s[e:]
+            quant = rest[0] if rest else ""
+            alts = split_alts(body)
+            if alts is None:
+                return stop(acc)
+            if quant == "?":
+                rest = rest[1:]
+                if not walk(rest, acc):
+                    return False
+                return all(walk(a + rest, acc) for a in alts)
+            if quant and quant in "*+{":
+                return stop(acc)
+            return all(walk(a + rest, acc) for a in alts)
+        if c == "[":
+            j = s.find("]")
+            if j <= 1:
+                return stop(acc)
+            body = s[1:j]
+            rest = s[j + 1:]
+            if body.startswith("^"):
+                return stop(acc)
+            chars: list[str] = []
+            t = 0
+            while t < len(body):
+                bc = body[t]
+                if bc == "\\":
+                    if t + 1 < len(body) and not body[t + 1].isalnum():
+                        chars.append(body[t + 1])
+                        t += 2
+                        continue
+                    return stop(acc)  # \d/\s/... class inside: unbounded
+                if bc == "-" and 0 < t < len(body) - 1:
+                    return stop(acc)  # range: out of scope
+                chars.append(bc)
+                t += 1
+            if not chars or len(chars) > 6:
+                return stop(acc)
+            quant = rest[0] if rest else ""
+            if quant == "?":
+                rest = rest[1:]
+                if not walk(rest, acc):
+                    return False
+                return all(walk(rest, acc + lc(bc)) for bc in chars)
+            if quant and quant in "*+{":
+                return stop(acc)
+            return all(walk(rest, acc + lc(bc)) for bc in chars)
+        if c == "\\":
+            if len(s) < 2 or s[1].isalnum():
+                return stop(acc)  # \d \s \w \b ...: classes/anchors
+            return lit_step(s[1], s[2:], acc)
+        if c == "|":
+            # a bare alternation reached mid-walk can't be folded into a
+            # single mandatory prefix; top-level '|' is pre-split below,
+            # so hitting one here means the pattern is out of scope
+            return False
+        if c in ".^$?*+{)":
+            return stop(acc)
+        return lit_step(c, s[1:], acc)
+
+    top_alts = split_alts(part)
+    if top_alts is None:
+        return None
+    if not all(walk(a, "") for a in top_alts):
+        return None
+    return out
+
+
+def _derive_title_prefixes() -> list[str] | None:
+    """The '\\n'-joined payload of the ``title_prefixes`` config record:
+    minimal lowercase literal prefixes for the whole title union, or
+    None (record omitted, native gate disabled) when any alternative is
+    underivable."""
+    from licensee_tpu.corpus.license import global_title_parts
+
+    all_prefixes: set[str] = set()
+    for part in global_title_parts():
+        got = _title_prefixes_for(part)
+        if not got:
+            return None
+        all_prefixes.update(got)
+    if any("\n" in p or "\0" in p or not p for p in all_prefixes):
+        return None
+    # minimality: a prefix subsumed by a shorter one never changes the
+    # gate's answer, so drop it
+    keep = [
+        p for p in all_prefixes
+        if not any(p != q and p.startswith(q) for q in all_prefixes)
+    ]
+    if not keep or len(keep) > 1024:
+        return None
+    return sorted(keep)
+
+
 def _build_config() -> bytes:
     from licensee_tpu.corpus.license import global_title_regex
     from licensee_tpu.normalize import pipeline as pl
@@ -96,12 +305,21 @@ def _build_config() -> bytes:
         + _pcre_pattern(p) + b"\0"
         for name, p in named.items()
     )
+    # optional title-union gate record (before spelling_table, which
+    # must stay last); omitted when the derivation declines
+    prefixes = _derive_title_prefixes()
+    gate = b""
+    if prefixes:
+        gate = (
+            b"title_prefixes\0\0"
+            + "\n".join(prefixes).encode("utf-8") + b"\0"
+        )
     # spelling_table must be last: its payload contains '\0' separators
     table = b"".join(
         k.encode() + b"\0" + v.encode() + b"\0"
         for k, v in pl.VARIETAL_WORDS.items()
     )
-    return records + b"spelling_table\0\0" + table
+    return records + gate + b"spelling_table\0\0" + table
 
 
 class VocabHandle:

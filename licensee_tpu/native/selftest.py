@@ -174,6 +174,81 @@ def bench_crossing(classifier=None, n: int = 256, reps: int = 3) -> float:
     return round(best, 1)
 
 
+def _profile_main(n: int) -> int:
+    """The ``--profile-json`` child: one featurize_batch over the bench
+    blobs with the native pass profiler live, stage split as JSON on
+    stdout.  Must run in its OWN process — PassProf caches the
+    ``LICENSEE_TPU_PIPE_PROFILE`` env at its first call, so the parent
+    cannot flip profiling on after the fact."""
+    import json
+
+    from licensee_tpu.kernels.batch import BatchClassifier
+
+    clf = BatchClassifier(mesh=None, device=False)
+    if clf._nat is None:
+        print(json.dumps({"skipped": "native pipeline unavailable"}))
+        return 0
+    seeds = [
+        b
+        for b in corpus_blobs()
+        if len(b) > 512 and all(x < 0x80 for x in b)
+    ][:16] or [b"some license words " * 64]
+    blobs = [
+        (
+            seeds[i % len(seeds)]
+            * (1 + 10000 // max(1, len(seeds[i % len(seeds)])))
+        )[:10000]
+        for i in range(n)
+    ]
+    W = clf.corpus.n_lanes
+    bits = np.zeros((n, W), dtype=np.uint32)
+    meta = np.zeros((n, 3), dtype=np.int32)
+    hashes = np.zeros((n, 16), dtype=np.uint8)
+    clf._nat.profile_reset()
+    clf._nat.featurize_batch(clf._nat_vocab, blobs, bits, meta, hashes)
+    dump = clf._nat.profile_dump()
+    us = {
+        key: round(seconds / n * 1e6, 2)
+        for key, seconds in dump.items()
+        if not key.startswith("count.")
+    }
+    print(json.dumps({"n": n, "us_per_blob": us}))
+    return 0
+
+
+def profile_split(n: int = 256) -> dict | None:
+    """Per-stage us/blob from a profile-enabled CHILD process (the env
+    gate must be set at process start), or None when the child cannot
+    produce one.  Keys of interest: ``stage.tokenize_only`` (the
+    tokenize-vs-normalize split), ``s2.title_strips`` and
+    ``s2.fold_spell`` (the round-2 fused passes)."""
+    import json
+    import os
+    import subprocess
+
+    env = {
+        **os.environ,
+        "LICENSEE_TPU_PIPE_PROFILE": "1",
+        "JAX_PLATFORMS": "cpu",
+    }
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "licensee_tpu.native.selftest",
+                "--profile-json", str(n),
+            ],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        if proc.returncode != 0:
+            return None
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (OSError, subprocess.TimeoutExpired, ValueError, IndexError):
+        return None
+    if not isinstance(row, dict) or "us_per_blob" not in row:
+        return None
+    return row
+
+
 def main() -> int:
     from licensee_tpu.kernels.batch import BatchClassifier
 
@@ -191,8 +266,23 @@ def main() -> int:
         f"native selftest: parity OK over {stats['blobs']} blobs; "
         f"featurize crossing {us} us/blob"
     )
+    split = profile_split()
+    if split is not None and split.get("us_per_blob"):
+        stages = split["us_per_blob"]
+        shown = ", ".join(
+            f"{key.split('.', 1)[-1]} {stages[key]}"
+            for key in (
+                "stage.tokenize_only", "s2.title_strips", "s2.fold_spell"
+            )
+            if key in stages
+        )
+        if shown:
+            print(f"native selftest: stage split (us/blob): {shown}")
     return 0
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--profile-json":
+        n_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+        sys.exit(_profile_main(n_arg))
     sys.exit(main())

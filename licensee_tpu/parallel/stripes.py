@@ -96,6 +96,7 @@ __all__ = [
     "merge_stats",
     "parse_stripes_arg",
     "selftest",
+    "selftest_autoscale",
     "stripe_argv",
 ]
 
@@ -171,15 +172,21 @@ def auto_stripe_count(
     return n
 
 
-def parse_stripes_arg(value: str) -> int:
-    """CLI ``--stripes`` value: a positive int, or ``auto``."""
+def parse_stripes_arg(value: str) -> int | str:
+    """CLI ``--stripes`` value: a positive int, ``auto`` (sized once
+    from the host + bench scaling model), or ``elastic`` (start small
+    and let the runner's autoscaler grow/shrink against the measured
+    per-stripe lane gauges — returned as the literal string)."""
     if value == "auto":
         return auto_stripe_count(scaling_model=load_scaling_model())
+    if value == "elastic":
+        return "elastic"
     try:
         n = int(value)
     except ValueError:
         raise ValueError(
-            f"--stripes wants a positive integer or 'auto', got {value!r}"
+            f"--stripes wants a positive integer, 'auto' or 'elastic', "
+            f"got {value!r}"
         ) from None
     if n < 1:
         raise ValueError(f"--stripes must be >= 1, got {n}")
@@ -248,6 +255,18 @@ def merge_stats(stats_list: list[dict]) -> dict:
         merged["routed"] = routed
     merged["stage_seconds"] = stages
     return merged
+
+
+def _forwarded_int(forward: tuple[str, ...], flag: str) -> int | None:
+    """The int value a forward-args tuple carries for ``flag``, or
+    None (absent or malformed — the child argv parser owns erroring)."""
+    for i, arg in enumerate(forward):
+        if arg == flag and i + 1 < len(forward):
+            try:
+                return int(forward[i + 1])
+            except ValueError:
+                return None
+    return None
 
 
 class _StripeHandle:
@@ -332,6 +351,9 @@ class StripeRunner:
         on_event=None,
         on_progress=None,
         container_layout: dict | None = None,
+        elastic=None,
+        elastic_interval_s: float = 2.0,
+        elastic_stale_after_s: float = 10.0,
     ):
         if stripes < 1:
             raise ValueError(f"stripes must be >= 1, got {stripes!r}")
@@ -409,34 +431,119 @@ class StripeRunner:
         self._on_event = on_event
         self._on_progress = on_progress
         self._stop_requested = False
-        self.handles: list[_StripeHandle] = []
-        for i in range(self.stripes):
-            shard = shard_output_path(output, i, self.stripes)
+        # spawn ingredients, kept so an elastic rescale can rebuild the
+        # handle set at a different stripe count / featurize-procs
+        self._forward_args = tuple(forward_args)
+        self._argv_for = argv_for
+        self._env_for = env_for
+        self._base_env = base_env
+        self._chips_per_stripe = chips_per_stripe
+        # --stripes elastic (parallel/autoscale.py): ``elastic`` is an
+        # AutoscaleConfig; the runner scrapes each live stripe's
+        # --prom-file heartbeat for pipeline_featurize_busy, feeds the
+        # decider, and a proposal becomes a DRAIN + RESPAWN at the new
+        # plan (each worker exits resume-safe; shard names embed the
+        # stripe count, so a revisit of an earlier count resumes its
+        # own shards and the final merge's cleanup sweeps the rest)
+        self.elastic = elastic
+        self._scale_events = 0
+        self._decider = None
+        self._scraper = None
+        self._featurize_procs = _forwarded_int(
+            self._forward_args, "--featurize-procs"
+        )
+        if elastic is not None:
+            from licensee_tpu.parallel.autoscale import (
+                AutoscaleDecider,
+                ExpositionScraper,
+            )
+
+            if elastic_interval_s <= 0:
+                raise ValueError(
+                    "elastic_interval_s must be > 0, got "
+                    f"{elastic_interval_s!r}"
+                )
+            self.elastic_interval_s = float(elastic_interval_s)
+            # stripes beyond this become per-stripe featurize procs
+            # (capacity_plan): a stripe needs CORES_PER_STRIPE_MIN
+            # cores to be worth its serial section
+            self._elastic_max_stripes = max(1, min(
+                elastic.max_units, AUTO_STRIPE_CAP, self.n_entries
+            ))
+            self._decider = AutoscaleDecider(
+                elastic, elastic.clamp(self.stripes)
+            )
+            self.stripes = min(self._decider.units,
+                               self._elastic_max_stripes)
+            self._scraper = ExpositionScraper(
+                stale_after_s=elastic_stale_after_s
+            )
+            self._last_autoscale_t: float | None = None
+            self._tp_last: tuple[float, int] | None = None
+        self._initial_stripes = self.stripes
+        self.handles: list[_StripeHandle] = self._build_handles(
+            self.stripes, self._featurize_procs
+        )
+        # shard paths THIS RUN has already started: a --no-resume
+        # elastic rescale clears a count's stale shards only on the
+        # first visit (revisits resume this run's own work)
+        self._counts_started = {h.shard for h in self.handles}
+
+    def _forward_with_procs(self, procs: int | None) -> tuple[str, ...]:
+        """The forward args with ``--featurize-procs`` swapped to
+        ``procs`` (dropped when falsy) — the elastic rescale's second
+        lever rides the respawn argv."""
+        out: list[str] = []
+        skip = False
+        for arg in self._forward_args:
+            if skip:
+                skip = False
+                continue
+            if arg == "--featurize-procs":
+                skip = True
+                continue
+            out.append(arg)
+        if procs:
+            out += ["--featurize-procs", str(procs)]
+        return tuple(out)
+
+    def _build_handles(
+        self, stripes: int, featurize_procs: int | None = None
+    ) -> list:
+        forward = (
+            self._forward_with_procs(featurize_procs)
+            if self.elastic is not None
+            else self._forward_args
+        )
+        handles = []
+        for i in range(stripes):
+            shard = shard_output_path(self.output, i, stripes)
             chips = (
-                chips_for_worker(i, chips_per_stripe)
-                if chips_per_stripe is not None
+                chips_for_worker(i, self._chips_per_stripe)
+                if self._chips_per_stripe is not None
                 else None
             )
             env = (
-                env_for(i, chips)
-                if env_for is not None
-                else worker_env(base_env, chips)
+                self._env_for(i, chips)
+                if self._env_for is not None
+                else worker_env(self._base_env, chips)
             )
-            if argv_for is not None:
-                argv_first = argv_for(i, self.stripes, resume=self.resume)
-                argv_resume = argv_for(i, self.stripes, resume=True)
+            if self._argv_for is not None:
+                argv_first = self._argv_for(i, stripes, resume=self.resume)
+                argv_resume = self._argv_for(i, stripes, resume=True)
             else:
                 argv_first = stripe_argv(
-                    manifest, output, i, self.stripes, forward_args,
+                    self.manifest, self.output, i, stripes, forward,
                     resume=self.resume,
                 )
                 argv_resume = stripe_argv(
-                    manifest, output, i, self.stripes, forward_args,
+                    self.manifest, self.output, i, stripes, forward,
                     resume=True,
                 )
-            self.handles.append(
+            handles.append(
                 _StripeHandle(i, shard, argv_first, argv_resume, env)
             )
+        return handles
 
     # -- events --
 
@@ -536,6 +643,129 @@ class StripeRunner:
                 return f.read().decode("utf-8", "replace")
         except OSError:
             return ""
+
+    # -- elastic autoscaling (--stripes elastic) --
+
+    def _throughput(self, now: float) -> float | None:
+        """Aggregate shard growth in bytes/s since the previous tick —
+        the payoff signal the decider judges a grow step by.  Reset at
+        every rescale (shard sets change; the first post-rescale tick
+        re-baselines instead of comparing across shard generations)."""
+        total = sum(max(0, self._shard_size(h)) for h in self.handles)
+        last = self._tp_last
+        self._tp_last = (now, total)
+        if last is None or now - last[0] <= 0:
+            return None
+        return (total - last[1]) / (now - last[0])
+
+    def _autoscale_tick(self, now: float) -> None:
+        if (
+            self._last_autoscale_t is not None
+            and now - self._last_autoscale_t < self.elastic_interval_s
+        ):
+            return
+        self._last_autoscale_t = now
+        live = [
+            h for h in self.handles if not h.done and h.proc is not None
+        ]
+        if not live:
+            return
+        pressures = []
+        for handle in live:
+            gauges = self._scraper.sample(
+                handle.shard, f"{handle.shard}.prom", now
+            )
+            if gauges is None:
+                continue  # stale/absent heartbeat: not a live signal
+            busy = gauges.get("pipeline_featurize_busy")
+            if busy is not None:
+                pressures.append(busy)
+        pressure = (
+            sum(pressures) / len(pressures) if pressures else None
+        )
+        proposal = self._decider.observe(
+            now, pressure, self._throughput(now)
+        )
+        if proposal is None:
+            return
+        from licensee_tpu.parallel.autoscale import capacity_plan
+
+        stripes, procs = capacity_plan(
+            proposal, max_stripes=self._elastic_max_stripes,
+            base_featurize_procs=self._featurize_procs or 0,
+        )
+        if stripes == self.stripes and (procs or None) == (
+            self._current_procs()
+        ):
+            return
+        self._rescale(stripes, procs or None, proposal)
+
+    def _current_procs(self) -> int | None:
+        return getattr(self, "_live_procs", self._featurize_procs)
+
+    def _rescale(
+        self, stripes: int, procs: int | None, units: int
+    ) -> None:
+        """One scale event: drain every worker (SIGTERM, resume-safe
+        exit), rebuild the handle set at the new plan, respawn.  Shard
+        names embed the stripe count, so workers at the new count never
+        resume another count's rows; partial shards from the old count
+        stay on disk — a later return to that count resumes them, and
+        the final merge's cleanup glob sweeps whatever never merged."""
+        self._event(
+            f"autoscale: {self.stripes} -> {stripes} stripes"
+            + (f" (+{procs} featurize-procs)" if procs else "")
+            + f" [units {units}]; draining for resume-safe respawn"
+        )
+        self._notify(
+            "rescale", from_stripes=self.stripes, to_stripes=stripes,
+            featurize_procs=procs, units=units,
+        )
+        self._drain()
+        for handle in self.handles:
+            self._scraper.forget(handle.shard)
+        self.stripes = int(stripes)
+        self._live_procs = procs
+        self._scale_events += 1
+        self._tp_last = None
+        self.handles = self._build_handles(stripes, procs)
+        for handle in self.handles:
+            if not self.resume:
+                # a --no-resume run must not adopt a stale same-count
+                # shard from an EARLIER run: the first visit to each
+                # count starts it clean (revisits within this run
+                # resume — that is this run's own work)
+                if handle.shard not in self._counts_started:
+                    try:
+                        os.remove(handle.shard)
+                    except OSError:
+                        pass
+            self._counts_started.add(handle.shard)
+            if (
+                self.resume
+                and self._count_complete_rows(handle.shard)
+                == self._stripe_span(handle.index, stripes)
+            ):
+                # this span finished on an earlier visit to this count:
+                # nothing to respawn (a worker would exit 0 instantly,
+                # but not spawning keeps the event log honest)
+                handle.done = True
+                continue
+            try:
+                self._spawn(handle, first=False)
+            except OSError as exc:
+                self._abort(
+                    f"stripe {handle.index}: respawn after rescale "
+                    f"failed: {exc}"
+                )
+            self._notify(
+                "spawn", stripe=handle.index, pid=handle.pid,
+                first=False,
+            )
+
+    def _stripe_span(self, index: int, stripes: int) -> int:
+        lo, hi = manifest_stripe(self.n_entries, index, stripes)
+        return hi - lo
 
     # -- the run loop --
 
@@ -740,6 +970,8 @@ class StripeRunner:
                     "progress", done=done, stripes=self.stripes,
                     shard_bytes=shard_bytes,
                 )
+            if self._decider is not None:
+                self._autoscale_tick(now)
             time.sleep(self.poll_interval_s)
         summary = self._merge()
         summary["elapsed_s"] = round(time.perf_counter() - t0, 3)
@@ -748,6 +980,15 @@ class StripeRunner:
             summary["files_per_sec"] = round(
                 files / summary["elapsed_s"], 1
             )
+        if self._decider is not None:
+            summary["autoscale"] = {
+                "initial_stripes": self._initial_stripes,
+                "final_stripes": self.stripes,
+                "featurize_procs": self._current_procs(),
+                "units": self._decider.units,
+                "scale_events": self._scale_events,
+                "events": list(self._decider.events),
+            }
         return summary
 
     # -- completion + merge --
@@ -1104,5 +1345,180 @@ def selftest(stream=None) -> int:
         say(
             "OK: 2-stripe tar-ingest bit-identical to 1-process "
             "(one container row, blobs spanned both stripes)"
+        )
+    return 0
+
+
+_AUTOSCALE_STUB = '''\
+import json
+import os
+import sys
+import time
+
+from licensee_tpu.parallel.distributed import (
+    manifest_stripe,
+    shard_output_path,
+)
+
+output, index, count, n_entries, pfile, delay = sys.argv[1:7]
+index, count, n_entries = int(index), int(count), int(n_entries)
+delay = float(delay)
+resume = "--no-resume" not in sys.argv[7:]
+shard = shard_output_path(output, index, count)
+lo, hi = manifest_stripe(n_entries, index, count)
+data = b""
+if resume:
+    try:
+        with open(shard, "rb") as f:
+            data = f.read()
+    except OSError:
+        data = b""
+    data = data[: data.rfind(b"\\n") + 1]  # torn-tail truncation
+done = data.count(b"\\n")
+epoch = 0
+with open(shard, "wb") as f:
+    f.write(data)
+    f.flush()
+    for j in range(lo + done, hi):
+        epoch += 1
+        try:
+            with open(pfile, encoding="utf-8") as pf:
+                busy = pf.read().strip() or "0"
+        except OSError:
+            busy = "0"
+        tmp = f"{shard}.prom.tmp"
+        with open(tmp, "w", encoding="utf-8") as mf:
+            mf.write("# TYPE stripe_scrape_epoch gauge\\n")
+            mf.write(f"stripe_scrape_epoch {epoch}\\n")
+            mf.write("# TYPE pipeline_featurize_busy gauge\\n")
+            mf.write(f"pipeline_featurize_busy {busy}\\n")
+        os.replace(tmp, f"{shard}.prom")
+        row = json.dumps({"path": f"f{j:05d}", "row": j})
+        f.write(row.encode() + b"\\n")
+        f.flush()
+        time.sleep(delay)
+'''
+
+
+def selftest_autoscale(stream=None) -> int:
+    """The ``--selftest-autoscale`` drill for script/cibuild: an
+    elastic run over deterministic stub stripes whose ``--prom-file``
+    heartbeat reports a featurize-lane pressure the drill controls.
+    Pressure starts saturated (1.0) -> the runner must scale up; at the
+    first up-rescale the drill flips pressure idle (0.05) -> the runner
+    must scale back down; the merged output must be bit-identical to
+    what a static single stripe writes, scale events must respect the
+    cooldown, and the stripe count must respect the bounds.  Exercises
+    the REAL drain/respawn/resume machinery — only the workers are
+    stubs.  Returns 0/1."""
+    import tempfile
+
+    stream = stream if stream is not None else sys.stderr
+
+    def say(msg: str) -> None:
+        stream.write(f"autoscale-selftest: {msg}\n")
+        stream.flush()
+
+    from licensee_tpu.parallel.autoscale import AutoscaleConfig
+
+    n = 150
+    delay = 0.05
+    cooldown_s = 0.6
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    with tempfile.TemporaryDirectory(
+        prefix="licensee-autoscale-"
+    ) as tmpdir:
+        stub = os.path.join(tmpdir, "stub_worker.py")
+        with open(stub, "w", encoding="utf-8") as f:
+            f.write(_AUTOSCALE_STUB)
+        manifest = os.path.join(tmpdir, "manifest.txt")
+        with open(manifest, "w", encoding="utf-8") as f:
+            f.write("\n".join(f"f{j:05d}" for j in range(n)) + "\n")
+        pfile = os.path.join(tmpdir, "pressure.txt")
+        with open(pfile, "w", encoding="utf-8") as f:
+            f.write("1.0\n")
+        out = os.path.join(tmpdir, "out.jsonl")
+        pythonpath = os.environ.get("PYTHONPATH", "")
+        env = {
+            **os.environ,
+            "PYTHONPATH": (
+                f"{repo_root}{os.pathsep}{pythonpath}"
+                if pythonpath else repo_root
+            ),
+        }
+
+        def argv_for(i, count, resume=True):
+            argv = [
+                sys.executable, stub, out, str(i), str(count), str(n),
+                pfile, str(delay),
+            ]
+            if not resume:
+                argv.append("--no-resume")
+            return argv
+
+        def on_progress(kind, info):
+            if kind == "rescale" and (
+                info["to_stripes"] > info["from_stripes"]
+            ):
+                # saturation answered: the drill goes idle so the
+                # decider must walk capacity back down
+                tmp = f"{pfile}.tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write("0.05\n")
+                os.replace(tmp, pfile)
+
+        runner = StripeRunner(
+            manifest, out, 1,
+            elastic=AutoscaleConfig(
+                min_units=1, max_units=2,
+                up_at=0.8, down_at=0.3,
+                confirm_ticks=2, cooldown_s=cooldown_s,
+                payoff_min=0.0,
+            ),
+            elastic_interval_s=0.25,
+            elastic_stale_after_s=5.0,
+            poll_interval_s=0.05,
+            sigterm_timeout_s=5.0,
+            argv_for=argv_for,
+            env_for=lambda i, chips: env,
+            on_event=say,
+            on_progress=on_progress,
+        )
+        summary = runner.run()
+        if summary["rows_written"] != n:
+            say(f"FAIL: wrote {summary['rows_written']} rows, want {n}")
+            return 1
+        expected = b"".join(
+            json.dumps({"path": f"f{j:05d}", "row": j}).encode() + b"\n"
+            for j in range(n)
+        )
+        with open(out, "rb") as f:
+            got = f.read()
+        if got != expected:
+            say("FAIL: elastic merged output != static 1-stripe bytes")
+            return 1
+        auto = summary.get("autoscale") or {}
+        events = auto.get("events") or []
+        ups = [e for e in events if e["to"] > e["from"]]
+        downs = [e for e in events if e["to"] < e["from"]]
+        if not ups:
+            say(f"FAIL: saturated lane never scaled up: {events}")
+            return 1
+        if not downs:
+            say(f"FAIL: idle lane never scaled down: {events}")
+            return 1
+        if any(e["to"] > 2 or e["to"] < 1 for e in events):
+            say(f"FAIL: bounds violated: {events}")
+            return 1
+        for a, b in zip(events, events[1:]):
+            if b["t"] - a["t"] < cooldown_s:
+                say(f"FAIL: cooldown violated: {events}")
+                return 1
+        say(
+            f"OK: scaled up then down ({len(ups)} up / {len(downs)} "
+            f"down over {auto.get('scale_events')} rescales), merged "
+            f"output bit-identical, cooldown {cooldown_s}s respected"
         )
     return 0

@@ -373,11 +373,73 @@ inline uint64_t now_ns() {
 }
 
 // ---------------------------------------------------------------------------
+// Title-union literal gate (round 2).  The corpus title regex is
+// \A\s*\(?(?:the )?(?:<~50-part union>).*?$ — Python derives, from the
+// union's own construction, a set of lowercase literal prefixes such
+// that EVERY caseless match of the union starts with one of them
+// (conservatively: any unparseable alternative disables the gate and
+// the record is simply absent).  The gate mirrors the pattern head —
+// skip \s*, optionally '(' and "the " — and probes the prefix table at
+// each of the up-to-4 candidate start positions, dispatched on the
+// first byte; a miss at all of them proves the PCRE2 attempt cannot
+// match, which is the common case for every peel loop's final
+// iteration (and most blobs' first).
+struct TitleGate {
+  bool enabled = false;
+  std::vector<std::string> prefixes;  // lowercase, sorted by first byte
+  uint16_t lo[256] = {}, hi[256] = {};
+
+  void load(const char *data, size_t len) {
+    size_t start = 0;
+    for (size_t i = 0; i <= len; ++i) {
+      if (i == len || data[i] == '\n') {
+        if (i > start) prefixes.emplace_back(data + start, i - start);
+        start = i + 1;
+      }
+    }
+    std::sort(prefixes.begin(), prefixes.end());
+    for (size_t k = 0; k < prefixes.size(); ++k) {
+      unsigned char f = static_cast<unsigned char>(prefixes[k][0]);
+      if (hi[f] == 0) lo[f] = static_cast<uint16_t>(k);
+      hi[f] = static_cast<uint16_t>(k + 1);
+    }
+    enabled = !prefixes.empty();
+  }
+
+  bool hit_at(const char *d, size_t len, size_t p) const {
+    if (p >= len) return false;
+    unsigned char f =
+        static_cast<unsigned char>(sc::lower_ascii(d[p]));
+    for (uint16_t k = lo[f]; k < hi[f]; ++k) {
+      const std::string &pf = prefixes[k];
+      if (sc::starts_ci(d + p, d + len, pf.data(), pf.size())) return true;
+    }
+    return false;
+  }
+
+  bool might_match(const char *d, size_t len) const {
+    if (!enabled) return true;
+    size_t i = 0;
+    while (i < len && sc::is_space(static_cast<unsigned char>(d[i]))) ++i;
+    for (int paren = 0; paren < 2; ++paren) {
+      if (paren && (i >= len || d[i] != '(')) break;
+      size_t p = i + static_cast<size_t>(paren);
+      if (hit_at(d, len, p)) return true;
+      if (sc::starts_ci(d + p, d + len, "the ", 4) &&
+          hit_at(d, len, p + 4))
+        return true;
+    }
+    return false;
+  }
+};
+
+// ---------------------------------------------------------------------------
 // Pipeline handle
 
 struct Pipeline {
   std::map<std::string, Pat> pats;
   sc::Spelling spelling;
+  TitleGate title_gate;
   std::string error;
 
   const Pat *pat(const char *name) const {
@@ -423,6 +485,21 @@ struct Pipeline {
     view_pass(v, [&](std::string s) {
       return plain_strip(p, std::move(s), scr, clean);
     });
+  }
+
+  // peel_loop for the corpus title union, with the literal-prefix gate
+  // in front of every PCRE2 attempt: a gate miss proves no match, so
+  // most iterations (and most blobs) never pay the union at all.
+  void peel_title_loop(TextView &v, Scratch &scr, bool *clean) const {
+    const Pat &p = *pat("title");
+    if (!p.anchored) {  // defensive: global_title_regex is \A-anchored
+      peel_loop(p, v, scr, clean);
+      return;
+    }
+    for (int guard = 0; guard < 1000; ++guard) {
+      if (!title_gate.might_match(v.data(), v.size())) return;
+      if (!peel_anchored(p, v, scr, clean)) return;
+    }
   }
 
   void ensure_clean(TextView &v, bool *clean) const {
@@ -477,7 +554,7 @@ struct Pipeline {
       c = gsub_pass(*pat("link_markup"), std::move(c), "$1", scr, &clean);
     TextView v(std::move(c));
     ensure_clean(v, &clean);
-    peel_loop(*pat("title"), v, scr, &clean);
+    peel_title_loop(v, scr, &clean);
     peel_once(*pat("version"), v, scr, &clean);
     return v.take();
   }
@@ -489,26 +566,44 @@ struct Pipeline {
   std::string stage2(std::string c, Scratch &scr,
                      bool downcase = false) const {
     bool clean;
-    {
-      // fused single-pass head: downcase + lists + http:/& + dashes +
-      // quotes in ONE scan (see fold_scan's soundness note) — formerly
-      // five full-text passes, two of them PCRE2
+    bool hyph_cand = false, spell_matched = false;
+    if (PassProf::enabled()) {
+      // profile split, same trick as stage.tokenize_only: a timed
+      // fold-only re-scan so s2.fold attributes the fold share of the
+      // fused loop (spelling share ~= s2.fold_spell - s2.fold)
       PassTimer t("s2.fold");
+      bool lf;
+      std::string split = sc::fold_scan(c.data(), c.size(), downcase, &lf);
+      if (split.size() == static_cast<size_t>(-1))
+        std::fputc(0, stderr);  // defeat DCE
+    }
+    {
+      // fused single-pass head (round 2): downcase + lists + http:/& +
+      // dashes + quotes + the SPDX spelling folds in ONE scan, with the
+      // hyphenated pass skipped unless the scan itself proves it could
+      // match (see fold_spell_scan's soundness note) — formerly seven
+      // full-text passes, two of them PCRE2
+      PassTimer t("s2.fold_spell");
       bool pre_clean = sc::is_squeezed_clean(c.data(), c.size());
       bool lists_fired = false;
-      c = sc::fold_scan(c.data(), c.size(), downcase, &lists_fired);
+      c = sc::fold_spell_scan(c.data(), c.size(), downcase, &lists_fired,
+                              &spelling, &hyph_cand, &spell_matched);
       // only the lists replacement can introduce double spaces or edge
       // strippables (e.g. "- " + a captured space); the literal/dash/
-      // quote folds replace non-space with non-space
+      // quote/spelling folds replace non-space with non-space
       clean = pre_clean && !lists_fired;
     }
-    {
-      PassTimer t("s2.sc.hyphenated");
-      if (has_byte(c, '-')) c = sc::hyphenated(c.data(), c.size());
-    }
-    {
+    if (hyph_cand) {
+      // rare: a real hard-wrapped-hyphenation candidate came back
+      // spelling-unprocessed — run the exact sequential passes
+      {
+        PassTimer t("s2.sc.hyphenated");
+        if (has_byte(c, '-')) c = sc::hyphenated(c.data(), c.size());
+      }
       PassTimer t("s2.sc.spelling");
-      c = spelling.run(c.data(), c.size());
+      std::string sp_out;
+      if (spelling.run_into(c.data(), c.size(), sp_out))
+        c = std::move(sp_out);
     }
     // span_markup needs one of [_*~] somewhere (same gate rationale as
     // stage1: skipping a pass that cannot match is behavior-identical)
@@ -579,12 +674,12 @@ struct Pipeline {
       // peel is a pointer advance instead of a substitute + squeeze copy
       PassTimer t("s2.title_strips");
       ensure_clean(v, &clean);
-      peel_loop(*pat("title"), v, scr, &clean);
+      peel_title_loop(v, scr, &clean);
       peel_once(*pat("version"), v, scr, &clean);
       if (url_gate(v.data(), v.size()))
         peel_once(*pat("url"), v, scr, &clean);
       peel_loop(*pat("strip_copyright"), v, scr, &clean);
-      peel_loop(*pat("title"), v, scr, &clean);
+      peel_title_loop(v, scr, &clean);
     }
     if (memchr(v.data(), '>', v.size())) {
       PassTimer t("s2.block_markup");
@@ -983,6 +1078,12 @@ void *pipe_new(const char *config, size_t config_len) {
     const char *pattern = config + i;
     size_t plen = std::strlen(pattern);
     i += plen + 1;
+    if (std::strcmp(name, "title_prefixes") == 0) {
+      // optional record: '\n'-joined lowercase literal prefixes for the
+      // title-union gate.  Absent (derivation declined) == gate off.
+      pl->title_gate.load(pattern, plen);
+      continue;
+    }
     Pat &p = pl->pats[name];
     if (!p.compile(std::string(pattern, plen), std::string(flags, fl),
                    &pl->error)) {

@@ -661,6 +661,7 @@ struct Spelling {
   std::vector<MGroup> multis;
   uint64_t single_lens = 0;  // bit l set: some single variant has len l
   uint64_t first_lens = 0;   // bit l set: some multi first-word has len l
+  size_t max_from = 0;       // longest variant, the fused-feed defer bound
 
   static uint32_t tri_hash(unsigned char a, unsigned char b,
                            unsigned char c) {
@@ -678,6 +679,7 @@ struct Spelling {
       i += tl + 1;
       from.emplace_back(f, fl);
       to.emplace_back(t, tl);
+      if (fl > max_from) max_from = fl;
     }
     size_t cap = 16;
     while (cap < from.size() * 4) cap <<= 1;
@@ -801,57 +803,115 @@ struct Spelling {
     return best_end;
   }
 
-  std::string run(const char *data, size_t len) const {
-    // A match can only begin at a word boundary followed by a word char.
-    // The block scan computes one 16-lane word mask per block and pulls
-    // word-START positions out of it with bit ops — word starts bits are
-    // wm & ~(wm << 1) — so the common block (no candidate) costs a
-    // handful of instructions instead of a byte walk.  Gate misses need
-    // NO skip-to-word-end: other start bits are already boundaries.
-    std::string out;
-    size_t emitted = 0;  // everything before this input index is in `out`
-    size_t i = 0;
+  // Incremental-scan state for the fused fold+spelling pass (round 2).
+  // The caller feeds monotonically growing prefixes of a buffer whose
+  // absorbed bytes never change afterwards; replacements divert into
+  // `sout` lazily, exactly like run() — a blob with no variant (the
+  // overwhelming majority) allocates and copies nothing.
+  struct Feed {
+    std::string sout;     // diverged output, valid only when `matched`
+    size_t emitted = 0;   // buffer bytes below this index are in `sout`
+    size_t done = 0;      // scan frontier: word starts below it resolved
+    bool carry = false;   // buffer[done-1] is word-class (the frontier
+                          // sits inside/right after an already-handled
+                          // run, never at an unseen word start)
+    bool matched = false;
+  };
+
+  // Absorb buffer bytes [st.done, upTo).  When !final_, a word start
+  // within `max_from` of the frontier is DEFERRED to the next feed: the
+  // run (or a separator-spanning variant) could extend past upTo, and
+  // both the exact-run-equality probe and the multi memcmp must see the
+  // true run end to stay byte-identical with the sequential pass.  A
+  // start farther back than max_from is safe: no variant is long enough
+  // to reach upTo from it, and a truncated run longer than max_from
+  // fails every length bitmask just as its full-length run would.
+  void feed(Feed &st, const char *d, size_t upTo, bool final_) const {
+    size_t i = st.done;
+    bool carry = st.carry;
 #if defined(__SSE2__)
-    unsigned carry = 0;  // 1 if data[i-1] is word-class
-    while (i + 16 <= len) {
-      unsigned wm = static_cast<unsigned>(word_mask16(data + i));
-      unsigned starts = wm & ~((wm << 1) | carry);
-      carry = (wm >> 15) & 1u;
+    // same block scan as the round-5 run(): one 16-lane word mask per
+    // block, word-START bits = wm & ~((wm << 1) | carry), so a block
+    // with no candidate costs a handful of instructions.  st.carry maps
+    // directly onto the block carry bit, so a resumed feed realigns for
+    // free.
+    unsigned c16 = carry ? 1u : 0u;
+    while (i + 16 <= upTo) {
+      unsigned wm = static_cast<unsigned>(word_mask16(d + i));
+      unsigned starts = wm & ~((wm << 1) | c16);
+      c16 = (wm >> 15) & 1u;
       bool jumped = false;
       while (starts) {
         int k = __builtin_ctz(starts);
         starts &= starts - 1;
-        if (!gates_pass(data, len, i + k)) continue;
-        size_t next = try_match(data, len, i + k, emitted, out);
+        size_t p = i + k;
+        if (!final_ && upTo - p <= max_from) {
+          st.done = p;  // a word START: carry=false resumes exactly here
+          st.carry = false;
+          return;
+        }
+        if (!gates_pass(d, upTo, p)) continue;
+        size_t next = try_match(d, upTo, p, st.emitted, st.sout);
         if (next != SIZE_MAX) {
           // the match may span separators ("sub license"): later start
-          // bits inside it are consumed, so realign the block scan just
-          // past the match (data[next] is non-word or EOS; the previous
-          // byte is a word char, so carry = 1)
+          // bits inside it are consumed, so realign just past the match
+          // (d[next] is non-word — processed starts end short of upTo —
+          // and d[next-1] is word-class, so carry = 1)
+          st.matched = true;
           i = next;
-          carry = 1;
+          c16 = 1;
           jumped = true;
           break;
         }
       }
       if (!jumped) i += 16;
     }
-    if (carry && i < len)  // mid-word at the tail boundary: finish it
-      i = find_nonword(data + i, data + len) - data;
+    carry = c16 != 0;
 #endif
-    while (i < len) {
-      i = find_wordbyte(data + i, data + len) - data;
-      if (i >= len) break;
-      size_t next = gates_pass(data, len, i)
-                        ? try_match(data, len, i, emitted, out)
-                        : SIZE_MAX;
-      i = (next != SIZE_MAX)
-              ? next
-              : static_cast<size_t>(find_nonword(data + i, data + len) -
-                                    data);
+    if (carry && i < upTo) {
+      i = static_cast<size_t>(find_nonword(d + i, d + upTo) - d);
+      if (i >= upTo) {
+        st.done = upTo;
+        st.carry = true;
+        return;  // still mid-run at the frontier
+      }
     }
-    if (emitted == 0) return std::string(data, len);
-    out.append(data + emitted, len - emitted);
+    while (i < upTo) {
+      i = static_cast<size_t>(find_wordbyte(d + i, d + upTo) - d);
+      if (i >= upTo) break;
+      if (!final_ && upTo - i <= max_from) {
+        st.done = i;  // a word START: carry=false resumes exactly here
+        st.carry = false;
+        return;
+      }
+      size_t next = gates_pass(d, upTo, i)
+                        ? try_match(d, upTo, i, st.emitted, st.sout)
+                        : SIZE_MAX;
+      if (next != SIZE_MAX) {
+        st.matched = true;
+        i = next;  // d[next] is non-word (the \b-after check)
+      } else {
+        i = static_cast<size_t>(find_nonword(d + i, d + upTo) - d);
+      }
+    }
+    st.done = upTo;
+    st.carry = upTo > 0 && is_word(static_cast<unsigned char>(d[upTo - 1]));
+  }
+
+  // run() without the no-match copy: true + the substituted text in
+  // `out` when any variant matched, false (out untouched) otherwise.
+  bool run_into(const char *data, size_t len, std::string &out) const {
+    Feed fd;
+    feed(fd, data, len, /*final_=*/true);
+    if (!fd.matched) return false;
+    fd.sout.append(data + fd.emitted, len - fd.emitted);
+    out = std::move(fd.sout);
+    return true;
+  }
+
+  std::string run(const char *data, size_t len) const {
+    std::string out;
+    if (!run_into(data, len, out)) return std::string(data, len);
     return out;
   }
 };
@@ -977,11 +1037,39 @@ inline unsigned fold_cand_mask16(const char *p, bool dc) {
 }
 #endif
 
-inline std::string fold_scan(const char *d, size_t len, bool dc,
-                             bool *lists_fired) {
+// Round-2 fusion: the SPDX spelling folds ride the same scan.  The
+// spelling pass's subject is fold_scan's OUTPUT (after the deferred
+// downcase), so the fused loop downcases incrementally and feeds the
+// grown output prefix to Spelling::feed in L1-resident chunks — the
+// separate spelling pass's full re-read, its no-match copy, and the
+// whole hyphenated pass disappear from the hot path.
+//
+// Ordering soundness: sequentially, hyphenated runs BETWEEN fold and
+// spelling.  Every '-' in fold output comes from the dash handler or
+// the lists "- " replacement (dash bytes are fold candidates, so none
+// ride a bulk copy; no other replacement text contains '-').  A lists
+// '-' is preceded by '\n'/BOS — never hyphenated-eligible.  So the
+// dash handler can detect, conservatively and on the spot, whether
+// hyphenated could match ANYWHERE in the output: previous OUTPUT byte
+// word-class, and the INPUT after the dash run is a space run holding
+// a '\n' followed by a word char ('&' counts: it folds to "and", whose
+// 'a' is word-class in the output).  No candidate -> hyphenated is
+// provably the identity and fused spelling is order-exact.  Candidate
+// (rare: a hard-wrapped hyphenation) -> the sink is abandoned and
+// *hyph_cand tells the caller to run the exact sequential passes on
+// the fold output.  `sp` == nullptr runs the fold alone (old behavior).
+inline std::string fold_spell_scan(const char *d, size_t len, bool dc,
+                                   bool *lists_fired, const Spelling *sp,
+                                   bool *hyph_cand, bool *spell_matched) {
   std::string out;
   out.reserve(len + (len >> 4) + 16);
   *lists_fired = false;
+  *hyph_cand = false;
+  *spell_matched = false;
+  bool fuse = sp != nullptr;
+  Spelling::Feed fd;
+  size_t dc_done = 0;       // downcase frontier (incremental when fusing)
+  size_t next_feed = 4096;  // absorb in L1-resident chunks
   size_t i = 0;
   // memo: first-non-space position of a FAILED lists attempt — every
   // line start inside the same leading-whitespace run shares the failure
@@ -1003,6 +1091,14 @@ inline std::string fold_scan(const char *d, size_t len, bool dc,
   // the lists pattern actually fires at the line start it opens — prose
   // lines (the overwhelming majority) stay on the span-copy path
   auto lists_at = [&](size_t ls) -> bool {
+    if (ls < len) {
+      // fast-fail: ^\s*(?:\d\.|[*-]) needs the first line byte to be
+      // space-class, a digit, '*' or '-' — prose lines (a letter) skip
+      // the attempt and the memo bookkeeping entirely
+      unsigned char f = static_cast<unsigned char>(d[ls]);
+      if (!kBT.space[f] && !(f >= '0' && f <= '9') && f != '*' && f != '-')
+        return false;
+    }
     if (fail_fns != SIZE_MAX && ls < fail_fns) return false;
     size_t cap, fns;
     if (lists_try(d, len, ls, dc, &cap, &fns)) {
@@ -1074,6 +1170,18 @@ inline std::string fold_scan(const char *d, size_t len, bool dc,
     i = pending_cap;
   }
   while (i < len) {
+    if (fuse && out.size() >= next_feed) {
+      // absorbed bytes are final: appends only ever extend the buffer,
+      // and the incremental downcase below runs before the feed sees
+      // them — so the sink scans exactly the bytes the sequential
+      // spelling pass would
+      if (dc) {
+        downcase_ascii(out.data() + dc_done, out.size() - dc_done);
+        dc_done = out.size();
+      }
+      sp->feed(fd, out.data(), out.size(), /*final_=*/false);
+      next_feed = out.size() + 4096;
+    }
     // bulk-copy the run of uninteresting bytes
     {
       size_t j = next_cand(i);
@@ -1119,6 +1227,23 @@ inline std::string fold_scan(const char *d, size_t len, bool dc,
         q += tt;
       }
       bool followed = (q < len) && (d[q] != '\n');
+      if (fuse && !*hyph_cand && !out.empty() &&
+          is_word(static_cast<unsigned char>(out.back()))) {
+        // hyphenated-candidate probe (see the soundness note): word
+        // char behind, newline-bearing space run + word char (or '&')
+        // ahead.  False positives only cost the sequential fallback.
+        size_t z = q;
+        bool nl = false;
+        while (z < len && is_space(static_cast<unsigned char>(d[z]))) {
+          nl |= d[z] == '\n';
+          ++z;
+        }
+        if (nl && z < len &&
+            (is_word(static_cast<unsigned char>(d[z])) || d[z] == '&')) {
+          *hyph_cand = true;
+          fuse = false;  // abandon the sink; caller reruns sequentially
+        }
+      }
       size_t start_tok = prev_nl ? 1 : 0;
       if (start_tok >= ntok) {
         out.append(d + i, q - i);
@@ -1145,9 +1270,25 @@ inline std::string fold_scan(const char *d, size_t len, bool dc,
   }
   // deferred downcase: one vectorized in-place pass (see the candidate
   // mask note — every fold decision above is case-blind or lowers on
-  // the fly, so folding case last is byte-identical to lowering first)
-  if (dc) downcase_ascii(out.data(), out.size());
+  // the fly, so folding case last is byte-identical to lowering first).
+  // When fusing, only the not-yet-fed tail is left to fold.
+  if (dc) downcase_ascii(out.data() + dc_done, out.size() - dc_done);
+  if (fuse) {
+    sp->feed(fd, out.data(), out.size(), /*final_=*/true);
+    if (fd.matched) {
+      *spell_matched = true;
+      fd.sout.append(out.data() + fd.emitted, out.size() - fd.emitted);
+      return fd.sout;
+    }
+  }
   return out;
+}
+
+inline std::string fold_scan(const char *d, size_t len, bool dc,
+                             bool *lists_fired) {
+  bool hyph_cand, spell_matched;
+  return fold_spell_scan(d, len, dc, lists_fired, nullptr, &hyph_cand,
+                         &spell_matched);
 }
 
 // ---------------------------------------------------------------------------

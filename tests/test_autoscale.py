@@ -1,0 +1,552 @@
+"""Elastic autoscaling (parallel/autoscale.py + the --stripes elastic
+runner path + the fleet wiring).
+
+The decider is a pure state machine, so its three production rules
+(hysteresis, cooldown, bounds) and the grow payoff check are pinned as
+plain unit tests over a synthetic clock.  The process mechanics run
+over the deterministic stub stripes from ``selftest_autoscale`` (the
+cibuild drill — saturate, grow, idle, shrink, bit-identical merge) and
+a SIGKILL-the-runner-mid-rescale drill whose rerun must still merge
+byte-exactly.  Fleet-side policy (queue pressure, SLO burn floors, the
+static-seed floor) runs against a fake supervisor; the real
+``Supervisor.add_worker``/``remove_worker`` path is covered in
+tests/test_fleet.py with live stub workers.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from licensee_tpu.parallel.autoscale import (
+    AutoscaleConfig,
+    AutoscaleDecider,
+    ExpositionScraper,
+    FleetAutoscaler,
+    capacity_plan,
+    parse_exposition_gauges,
+)
+
+pytestmark = pytest.mark.usefixtures("lock_order_sanitizer")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    kw.setdefault("min_units", 1)
+    kw.setdefault("max_units", 8)
+    kw.setdefault("up_at", 0.8)
+    kw.setdefault("down_at", 0.3)
+    kw.setdefault("confirm_ticks", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("payoff_min", 0.0)
+    min_units = kw.pop("min_units")
+    max_units = kw.pop("max_units")
+    return AutoscaleConfig(min_units, max_units, **kw)
+
+
+# -- config validation --
+
+
+def test_config_rejects_bad_values():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(0, 8)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(4, 2)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(1, 8, up_at=0.3, down_at=0.8)  # inverted band
+    with pytest.raises(ValueError):
+        AutoscaleConfig(1, 8, up_at=1.5)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(1, 8, confirm_ticks=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(1, 8, cooldown_s=-1)
+
+
+def test_config_clamp():
+    cfg = AutoscaleConfig(2, 5)
+    assert cfg.clamp(1) == 2
+    assert cfg.clamp(3) == 3
+    assert cfg.clamp(99) == 5
+
+
+# -- the decider: hysteresis / cooldown / bounds --
+
+
+def test_hysteresis_needs_confirm_ticks():
+    d = AutoscaleDecider(_cfg(confirm_ticks=3), 1)
+    assert d.observe(1.0, 1.0) is None
+    assert d.observe(2.0, 1.0) is None
+    assert d.observe(3.0, 1.0) == 2  # third consecutive crossing
+    assert d.units == 2
+
+
+def test_streak_resets_in_the_hold_band():
+    d = AutoscaleDecider(_cfg(confirm_ticks=2), 1)
+    assert d.observe(1.0, 1.0) is None
+    assert d.observe(2.0, 0.5) is None  # hold band: streak gone
+    assert d.observe(3.0, 1.0) is None  # back to streak 1
+    assert d.observe(4.0, 1.0) == 2
+
+
+def test_stale_signal_resets_streaks():
+    d = AutoscaleDecider(_cfg(confirm_ticks=2), 1)
+    assert d.observe(1.0, 1.0) is None
+    assert d.observe(2.0, None) is None  # every exposition was stale
+    assert d.observe(3.0, 1.0) is None  # staleness never accumulates
+    assert d.observe(4.0, 1.0) == 2
+
+
+def test_cooldown_holds_and_resets_streaks():
+    d = AutoscaleDecider(_cfg(confirm_ticks=1, cooldown_s=10.0), 1)
+    assert d.observe(1.0, 1.0) == 2
+    # observations inside the cooldown window: held, streaks quiet
+    assert d.observe(5.0, 1.0) is None
+    assert d.observe(10.9, 1.0) is None
+    # first post-cooldown crossing counts from streak zero
+    assert d.observe(11.5, 1.0) == 3
+    assert [e["to"] for e in d.events] == [2, 3]
+
+
+def test_bounds_clamp_both_directions():
+    d = AutoscaleDecider(_cfg(max_units=2, confirm_ticks=1,
+                              cooldown_s=0.0), 2)
+    assert d.observe(1.0, 1.0) is None  # already at max
+    down = AutoscaleDecider(_cfg(confirm_ticks=1, cooldown_s=0.0), 1)
+    assert down.observe(1.0, 0.0) is None  # already at min
+    assert down.units == 1
+
+
+def test_scale_down_on_sustained_low_pressure():
+    d = AutoscaleDecider(_cfg(confirm_ticks=2, cooldown_s=0.0), 3)
+    assert d.observe(1.0, 0.1) is None
+    assert d.observe(2.0, 0.1) == 2
+    assert d.events[-1]["why"] == "pressure low"
+
+
+def test_pressure_clamped_to_unit_interval():
+    d = AutoscaleDecider(_cfg(confirm_ticks=1, cooldown_s=0.0), 1)
+    assert d.observe(1.0, 7.5) == 2  # clamps to 1.0, still "high"
+    assert d._last_pressure == 1.0
+
+
+# -- the grow payoff check --
+
+
+def test_grow_without_payoff_steps_back_and_pins_ceiling():
+    d = AutoscaleDecider(
+        _cfg(confirm_ticks=1, cooldown_s=0.0, payoff_min=0.05), 1
+    )
+    assert d.observe(1.0, 1.0, throughput=100.0) == 2
+    # next throughput sample shows no improvement: step back, pin
+    assert d.observe(2.0, 1.0, throughput=101.0) == 1
+    assert d.events[-1]["why"] == "grow did not pay; stepping back"
+    # pinned: sustained saturation can re-grow only up to the ceiling
+    assert d.observe(3.0, 1.0, throughput=101.0) is None
+    assert d.units == 1
+    # low pressure says the workload changed: the ceiling unpins
+    d.observe(4.0, 0.1)
+    assert d._ceiling is None
+
+
+def test_grow_with_payoff_keeps_climbing():
+    d = AutoscaleDecider(
+        _cfg(confirm_ticks=1, cooldown_s=0.0, payoff_min=0.05), 1
+    )
+    assert d.observe(1.0, 1.0, throughput=100.0) == 2
+    assert d.observe(2.0, 1.0, throughput=200.0) == 3  # paid: climb on
+    assert d.units == 3
+
+
+def test_register_publishes_gauges_and_event_counter():
+    from licensee_tpu.obs.registry import MetricsRegistry
+
+    registry = MetricsRegistry()
+    d = AutoscaleDecider(_cfg(confirm_ticks=1, cooldown_s=0.0), 1)
+    d.register(registry)
+    d.observe(1.0, 1.0)
+    d.observe(2.0, 0.0)
+    d.observe(3.0, 0.0)
+    from licensee_tpu.obs.export import render_prometheus
+
+    text = render_prometheus(registry)
+    gauges = parse_exposition_gauges(text)
+    assert gauges["autoscale_capacity_units"] == 1.0  # up then down
+    assert gauges["autoscale_pressure"] == 0.0
+    assert 'autoscale_scale_events_total{direction="up"} 1' in text
+    assert 'autoscale_scale_events_total{direction="down"} 1' in text
+
+
+# -- capacity_plan --
+
+
+def test_capacity_plan_maps_units_to_stripes_then_procs():
+    assert capacity_plan(1, max_stripes=4) == (1, 0)
+    assert capacity_plan(4, max_stripes=4) == (4, 0)
+    # spillover past the stripe cap becomes per-stripe featurize-procs
+    assert capacity_plan(6, max_stripes=4) == (4, 2)
+    assert capacity_plan(6, max_stripes=4, base_featurize_procs=2) == (
+        4, 4
+    )
+    assert capacity_plan(2, max_stripes=4, base_featurize_procs=3) == (
+        2, 3
+    )
+    with pytest.raises(ValueError):
+        capacity_plan(0, max_stripes=4)
+
+
+# -- exposition parsing + the freshness scraper --
+
+
+def test_parse_exposition_gauges_skips_noise():
+    text = (
+        "# HELP x y\n"
+        "# TYPE stripe_scrape_epoch gauge\n"
+        "stripe_scrape_epoch 7\n"
+        "pipeline_featurize_busy 0.93\n"
+        "labeled_series{worker=\"w0\"} 1\n"
+        "malformed line here\n"
+        "pipeline_featurize_busy 0.95\n"  # last sample wins
+    )
+    gauges = parse_exposition_gauges(text)
+    assert gauges == {
+        "stripe_scrape_epoch": 7.0,
+        "pipeline_featurize_busy": 0.95,
+    }
+
+
+def _write_prom(path, epoch, busy=0.5):
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"stripe_scrape_epoch {epoch}\n")
+        f.write(f"pipeline_featurize_busy {busy}\n")
+
+
+def test_scraper_accepts_advancing_epoch(tmp_path):
+    prom = str(tmp_path / "s.prom")
+    scraper = ExpositionScraper(stale_after_s=1.0)
+    _write_prom(prom, 1)
+    assert scraper.sample("k", prom, now=0.0) is not None
+    _write_prom(prom, 2)
+    assert scraper.sample("k", prom, now=10.0) is not None
+
+
+def test_scraper_rejects_frozen_epoch_after_window(tmp_path):
+    prom = str(tmp_path / "s.prom")
+    scraper = ExpositionScraper(stale_after_s=1.0)
+    _write_prom(prom, 5, busy=1.0)
+    assert scraper.sample("k", prom, now=0.0) is not None
+    # same epoch inside the window: still considered live
+    assert scraper.sample("k", prom, now=0.5) is not None
+    # past the window with no advance: a dead stripe's last exposition
+    # must never read as a live lane snapshot
+    assert scraper.sample("k", prom, now=1.6) is None
+    # the epoch moving again revives the key
+    _write_prom(prom, 6)
+    assert scraper.sample("k", prom, now=2.0) is not None
+
+
+def test_scraper_forget_restarts_the_freshness_clock(tmp_path):
+    prom = str(tmp_path / "s.prom")
+    scraper = ExpositionScraper(stale_after_s=1.0)
+    _write_prom(prom, 5)
+    assert scraper.sample("k", prom, now=0.0) is not None
+    assert scraper.sample("k", prom, now=2.0) is None
+    scraper.forget("k")  # the worker was retired and respawned
+    assert scraper.sample("k", prom, now=3.0) is not None
+
+
+def test_scraper_rejects_missing_file_and_missing_epoch(tmp_path):
+    scraper = ExpositionScraper(stale_after_s=1.0)
+    assert scraper.sample("k", str(tmp_path / "nope.prom"), 0.0) is None
+    bare = tmp_path / "bare.prom"
+    bare.write_text("pipeline_featurize_busy 0.5\n")
+    # a final merge-input dump has no heartbeat stamp: not scrapable
+    assert scraper.sample("k", str(bare), 0.0) is None
+    with pytest.raises(ValueError):
+        ExpositionScraper(stale_after_s=0)
+
+
+# -- fleet policy: queue pressure, SLO floors, seed floor --
+
+
+class _FakeHandle:
+    def __init__(self, stats):
+        self.last_stats = stats
+
+
+class _FakeSupervisor:
+    def __init__(self, depths):
+        self.workers = {
+            f"w{i}": _FakeHandle(
+                {"scheduler": {"queue_depth": d, "in_flight": 0}}
+                if d is not None else {}
+            )
+            for i, d in enumerate(depths)
+        }
+        self.added: list = []
+        self.removed: list = []
+
+    def add_worker(self, name, socket_path):
+        self.added.append((name, socket_path))
+        self.workers[name] = _FakeHandle(
+            {"scheduler": {"queue_depth": 0, "in_flight": 0}}
+        )
+
+    def remove_worker(self, name, **kw):
+        self.removed.append(name)
+        del self.workers[name]
+
+
+def _fleet(depths, slo=None, **cfg_kw):
+    sup = _FakeSupervisor(depths)
+    auto = FleetAutoscaler(
+        sup,
+        _cfg(**cfg_kw),
+        socket_for=lambda name: f"/tmp/{name}.sock",
+        target_inflight_per_worker=8,
+        slo_snapshot=(lambda: slo) if slo is not None else None,
+    )
+    return sup, auto
+
+
+def test_fleet_pressure_is_mean_outstanding_over_target():
+    _sup, auto = _fleet([8, 16])
+    assert auto.pressure() == pytest.approx(1.0)  # 12/8 clamps to 1
+    _sup, auto = _fleet([2, 2])
+    assert auto.pressure() == pytest.approx(0.25)
+    _sup, auto = _fleet([None, None])
+    assert auto.pressure() is None  # no worker has probed yet
+
+
+def test_fleet_slo_burn_floors_pressure():
+    fast = {"objectives": {"avail": {"fast_burn_alert": True}}}
+    _sup, auto = _fleet([0], slo=fast)
+    assert auto.pressure() == 1.0  # page-rate burn IS saturation
+    slow = {"objectives": {"avail": {"slow_burn_alert": True}}}
+    _sup, auto = _fleet([0], slo=slow)
+    assert auto.pressure() == pytest.approx(auto.decider.config.up_at)
+
+
+def test_fleet_tick_adds_then_removes_elastic_workers():
+    sup, auto = _fleet(
+        [16], confirm_ticks=1, cooldown_s=0.0, max_units=3
+    )
+    assert auto.tick(now=1.0) == 2
+    assert sup.added == [("auto0", "/tmp/auto0.sock")]
+    # the new worker reports idle; mean pressure collapses below
+    # down_at and the elastic worker retires newest-first
+    sup.workers["w0"].last_stats = {
+        "scheduler": {"queue_depth": 0, "in_flight": 0}
+    }
+    assert auto.tick(now=2.0) == 1
+    assert sup.removed == ["auto0"]
+    assert "w0" in sup.workers  # the static seed survives
+
+
+def test_fleet_never_removes_static_seed_workers():
+    sup, auto = _fleet(
+        [0, 0], confirm_ticks=1, cooldown_s=0.0, min_units=1
+    )
+    # min_units floors at the seed fleet size (2), so low pressure
+    # proposes nothing — and even a forced proposal below the seed
+    # count would find no elastic worker to retire
+    assert auto.decider.config.min_units == 2
+    assert auto.tick(now=1.0) is None
+    assert auto.tick(now=2.0) is None
+    assert sup.removed == []
+    assert set(sup.workers) == {"w0", "w1"}
+
+
+# -- the stub-stripe drills: real drain/respawn/resume mechanics --
+
+
+def test_elastic_stub_drill_grows_shrinks_and_merges_identically():
+    """The cibuild drill, in-process: saturated stub lanes force a
+    grow, the drill flips them idle, the runner shrinks back, and the
+    merged output is bit-identical to a static single-stripe run with
+    cooldown spacing between the scale events."""
+    from licensee_tpu.parallel.stripes import selftest_autoscale
+
+    out = io.StringIO()
+    assert selftest_autoscale(stream=out) == 0, out.getvalue()
+    assert "OK: scaled up then down" in out.getvalue()
+
+
+_KILL_DRIVER = """
+import json, os, sys
+from licensee_tpu.parallel.autoscale import AutoscaleConfig
+from licensee_tpu.parallel.stripes import StripeRunner, _AUTOSCALE_STUB
+
+workdir = sys.argv[1]
+n, delay = 120, 0.05
+stub = os.path.join(workdir, "stub_worker.py")
+with open(stub, "w", encoding="utf-8") as f:
+    f.write(_AUTOSCALE_STUB)
+manifest = os.path.join(workdir, "manifest.txt")
+with open(manifest, "w", encoding="utf-8") as f:
+    f.write("\\n".join(f"f{j:05d}" for j in range(n)) + "\\n")
+pfile = os.path.join(workdir, "pressure.txt")
+with open(pfile, "w", encoding="utf-8") as f:
+    f.write("1.0\\n")  # pinned saturated: the runner must scale up
+out = os.path.join(workdir, "out.jsonl")
+pythonpath = os.environ.get("PYTHONPATH", "")
+repo_root = sys.argv[2]
+env = {
+    **os.environ,
+    "PYTHONPATH": (
+        f"{repo_root}{os.pathsep}{pythonpath}" if pythonpath
+        else repo_root
+    ),
+}
+
+def argv_for(i, count, resume=True):
+    argv = [
+        sys.executable, stub, out, str(i), str(count), str(n),
+        pfile, str(delay),
+    ]
+    if not resume:
+        argv.append("--no-resume")
+    return argv
+
+def on_progress(kind, info):
+    if kind == "rescale":
+        print("RESCALED", flush=True)
+
+runner = StripeRunner(
+    manifest, out, 1,
+    elastic=AutoscaleConfig(
+        min_units=1, max_units=2, up_at=0.8, down_at=0.3,
+        confirm_ticks=2, cooldown_s=0.5, payoff_min=0.0,
+    ),
+    elastic_interval_s=0.2,
+    elastic_stale_after_s=5.0,
+    poll_interval_s=0.05,
+    sigterm_timeout_s=5.0,
+    argv_for=argv_for,
+    env_for=lambda i, chips: env,
+    on_progress=on_progress,
+)
+summary = runner.run()
+print(f"DONE {summary['rows_written']}", flush=True)
+"""
+
+
+def test_sigkill_mid_rescale_rerun_merges_byte_exactly(tmp_path):
+    """SIGKILL the whole elastic runner (runner + stub children) just
+    after a scale-out committed — mid-scale, shards split across two
+    stripe counts — then rerun the same command: the resume machinery
+    must finish and merge bytes identical to a static 1-stripe run."""
+    driver = tmp_path / "driver.py"
+    driver.write_text(_KILL_DRIVER)
+    work = tmp_path / "work"
+    work.mkdir()
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+    argv = [sys.executable, str(driver), str(work), REPO_ROOT]
+
+    proc = subprocess.Popen(
+        argv, env=env, stdout=subprocess.PIPE, text=True,
+        start_new_session=True,  # runner + stubs share the new pgid
+    )
+    try:
+        deadline = time.perf_counter() + 60.0
+        saw_rescale = False
+        while time.perf_counter() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.strip() == "RESCALED":
+                saw_rescale = True
+                break
+        assert saw_rescale, "runner never scaled out"
+        time.sleep(0.3)  # let the post-rescale respawns write a little
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+        proc.wait(timeout=10.0)
+        proc.stdout.close()
+
+    done = subprocess.run(
+        argv, env=env, capture_output=True, text=True, timeout=120.0,
+    )
+    assert done.returncode == 0, done.stderr
+    assert "DONE 120" in done.stdout, done.stdout
+    expected = b"".join(
+        json.dumps({"path": f"f{j:05d}", "row": j}).encode() + b"\n"
+        for j in range(120)
+    )
+    with open(work / "out.jsonl", "rb") as f:
+        assert f.read() == expected
+
+
+# -- the jobs surface: typed elastic options through validate_spec --
+
+
+def test_validate_spec_accepts_elastic_with_runner_options():
+    from licensee_tpu.jobs.executor import validate_spec
+
+    spec, err = validate_spec({
+        "manifest": ["a", "b"],
+        "stripes": "elastic",
+        "options": {
+            "autoscale_min": 1,
+            "autoscale_max": 4,
+            "autoscale_cooldown_s": 5,
+        },
+    })
+    assert err is None
+    assert spec["stripes"] == "elastic"
+    assert spec["options"]["autoscale_cooldown_s"] == 5.0  # int -> float
+
+
+def test_validate_spec_refuses_runner_options_without_elastic():
+    from licensee_tpu.jobs.executor import validate_spec
+
+    spec, err = validate_spec({
+        "manifest": ["a"],
+        "stripes": 2,
+        "options": {"autoscale_min": 1},
+    })
+    assert spec is None
+    assert "needs spec.stripes = 'elastic'" in err
+
+
+def test_validate_spec_refuses_inverted_elastic_bounds():
+    from licensee_tpu.jobs.executor import validate_spec
+
+    spec, err = validate_spec({
+        "manifest": ["a"],
+        "stripes": "elastic",
+        "options": {"autoscale_min": 5, "autoscale_max": 2},
+    })
+    assert spec is None
+    assert "autoscale_min" in err
+    spec, err = validate_spec({
+        "manifest": ["a"],
+        "stripes": "elastic",
+        "options": {"autoscale_cooldown_s": -1.0},
+    })
+    assert spec is None
+    assert "autoscale_cooldown_s" in err
+
+
+def test_runner_options_never_reach_child_argv():
+    from licensee_tpu.jobs.executor import forward_args_for
+
+    args = forward_args_for({
+        "autoscale_min": 1,
+        "autoscale_max": 4,
+        "autoscale_cooldown_s": 5.0,
+        "confidence": 0.9,
+    })
+    joined = " ".join(args)
+    assert "autoscale" not in joined
+    assert "--confidence" in joined

@@ -170,6 +170,28 @@ def _fat_details() -> dict:
                 "identical_output": True,
                 "lane_model": {"measured_over_predicted": 99999.999},
             },
+            "autoscale": {
+                "cores_modeled": 224,
+                "best_static_stripes": 99,
+                "converged_stripes": 99,
+                "modeled_files_per_sec_best": 99_999_999.0,
+                "modeled_files_per_sec_converged": 99_999_999.0,
+                "within_10pct": True,
+                "scale_events": 99,
+                "flapping": False,
+                "events": [
+                    {"t": 99999.9, "from": 9, "to": 10,
+                     "why": "pressure high", "pressure": 1.0}
+                ] * 16,
+            },
+            "native_stage_profile": {
+                "n": 99_999,
+                "us_per_blob": {
+                    "stage.tokenize_only": 99999.99,
+                    "s2.title_strips": 99999.99,
+                    "s2.fold_spell": 99999.99,
+                },
+            },
         },
         "stripes": {
             "files": 1_000_000,
@@ -301,6 +323,14 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["host_model"]["overlap_speedup"] == 99999.999
     assert d["host_model"]["overlap_identical"] is True
     assert d["host_model"]["overlap_vs_lane_model"] == 99999.999
+    # the elastic autoscaler's convergence verdict (PR 17): the real
+    # decider driven over the measured scaling model must land within
+    # 10% of the best static stripe count and then go quiet (headline
+    # keys squeezed for the byte budget; full row in details)
+    assert d["host_model"]["autoscale"]["best"] == 99
+    assert d["host_model"]["autoscale"]["conv"] == 99
+    assert d["host_model"]["autoscale"]["ok"] is True
+    assert d["host_model"]["autoscale"]["flap"] is False
     assert d["stripes"]["n"] == 4
     assert d["stripes"]["files_per_sec_1"] == 99_999_999.9
     assert d["stripes"]["files_per_sec_n"] == 99_999_999.9
@@ -393,6 +423,18 @@ def test_fast_mode_jobs_keys_say_skipped(bench_mod):
     jobs = headline["details"]["jobs"]
     assert set(jobs) == set(bench_mod.JOBS_HEADLINE_KEYS)
     assert all(v == "skipped" for v in jobs.values()), jobs
+    line = json.dumps(headline, separators=(",", ":"))
+    assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
+
+
+def test_fast_mode_autoscale_says_skipped(bench_mod):
+    """The PR 17 satellite: a fast-mode run (host_model suite not run)
+    stamps the headline's autoscale verdict "skipped" — not-run must
+    never read as broken, and the stamped line still fits."""
+    details = _fat_details()
+    details["host_model"] = {}
+    headline = bench_mod.make_headline("m", 1.0, 1.0, details)
+    assert headline["details"]["host_model"]["autoscale"] == "skipped"
     line = json.dumps(headline, separators=(",", ":"))
     assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
 

@@ -132,3 +132,83 @@ def test_prepare_batch_sparse_subset_matches_dense(clf):
         assert sparse.lengths[i] == dense.lengths[i]
     assert sparse.results[1] is preset[1]
     assert sparse.todo == [0, 2, 3]
+
+
+# -- the round-2 title-strip prefix gate (native/pipeline.py) --
+#
+# The native pipeline fronts the corpus-wide PCRE2 title union with a
+# derived table of literal lowercase prefixes: a head matching none of
+# them provably cannot match the union, so PCRE2 is skipped.  The gate
+# is only sound if (a) every real title still reaches the regex and
+# (b) gated heads normalize bit-identically to the pure-Python path.
+
+
+def _title_parity(clf, raw: bytes):
+    from licensee_tpu.kernels.batch import NormalizedBlob
+    from licensee_tpu.rubytext import ruby_strip
+
+    blob = NormalizedBlob(raw)
+    stripped = ruby_strip(blob.content)
+    s1, _ = clf._nat.stage1(stripped)
+    s2 = clf._nat.stage2(s1.lower())
+    assert s2 == blob.content_normalized(), raw[:80]
+    assert (
+        hashlib.sha1(s2.encode()).hexdigest() == blob.content_hash
+    ), raw[:80]
+
+
+def test_title_prefix_gate_covers_every_real_title():
+    """Derivation soundness, checked against the corpus itself: every
+    vendored license title and unversioned name (the strings the union
+    is BUILT from) must start with one of the derived prefixes — a
+    miss here means the gate would skip a genuine title head."""
+    from licensee_tpu.corpus.license import License
+    from licensee_tpu.native.pipeline import _derive_title_prefixes
+
+    prefixes = _derive_title_prefixes()
+    assert prefixes, "title-prefix derivation went None (gate disabled)"
+    assert all(p == p.lower() for p in prefixes)
+    for lic in License.all(hidden=True, pseudo=False):
+        for head in (lic.title, lic.name_without_version):
+            low = head.lower()
+            assert any(low.startswith(p) for p in prefixes), (
+                lic.key, head, sorted(prefixes),
+            )
+
+
+def test_adversarial_title_strip_goldens(clf):
+    """Bit-identical parity on heads engineered against the prefix
+    gate: exact titles, gate-hit-but-regex-miss near-titles,
+    one-char-off near-prefixes (gate miss), 'the '/paren/indent
+    wrappers, and titles buried mid-document (the \\A anchor)."""
+    from licensee_tpu.native.pipeline import _derive_title_prefixes
+
+    body = b"\n\npermission is hereby granted to deal in the software.\n"
+    heads = [
+        b"MIT License",
+        b"The MIT License (MIT)",
+        b"(The MIT License)",
+        b"   Apache License\nVersion 2.0, January 2004",
+        b"GNU GENERAL PUBLIC LICENSE\nVersion 3, 29 June 2007",
+        b"BSD 3-Clause License",
+        b"the mit license",  # lowercase 'the' wrapper
+        b"MIT LICENSE",  # all-caps through the caseless union
+        b"MITNOTQUITE a license",  # gate hit, regex miss
+        b"Apache Licensing Department",  # gate hit, regex miss
+        b"MI License",  # one char short of every mit prefix
+        b"XYZ Public License",  # gate miss entirely
+        b"preamble first\nMIT License",  # title not at \A: no strip
+        b"Copyright (c) 2026\nMIT License",
+    ]
+    for head in heads:
+        _title_parity(clf, head + body)
+    # and the derived table itself, adversarially: each prefix as a
+    # bare head (gate hit, usually regex miss), plus one-char bumps
+    # and truncations walking the gate's miss edge
+    prefixes = _derive_title_prefixes() or []
+    assert prefixes
+    for p in sorted(prefixes):
+        enc = p.encode("utf-8", "ignore") or b"x"
+        _title_parity(clf, enc + b" license" + body)
+        _title_parity(clf, enc[:-1] + b"~ license" + body)  # last bumped
+        _title_parity(clf, enc[:-1] + body)  # truncated: gate-edge miss

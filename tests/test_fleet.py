@@ -476,6 +476,36 @@ def test_supervisor_assigns_disjoint_chip_ranges(tmp_path):
     assert devices == ["0,1", "2,3"]
 
 
+def test_supervisor_add_and_remove_worker_live(tmp_path):
+    """The fleet autoscaler's mechanics: a runtime-added worker is
+    spawned from the same argv/env ingredients as the seed fleet,
+    joins health probing, and a removal drains it cleanly without
+    disturbing the seed workers."""
+    sockets = {"w0": str(tmp_path / "w0.sock")}
+    with Supervisor(
+        sockets,
+        argv_for=lambda name, sock: stub_argv(sock, name),
+        env_for=lambda name, chips: dict(STUB_ENV),
+        probe_interval_s=0.05, backoff_base_s=0.1, backoff_max_s=1.0,
+        startup_grace_s=15.0,
+    ) as supervisor:
+        assert supervisor.wait_healthy(15.0)
+        handle = supervisor.add_worker(
+            "auto0", str(tmp_path / "auto0.sock")
+        )
+        assert handle.name == "auto0"
+        with pytest.raises(ValueError):
+            supervisor.add_worker("auto0", str(tmp_path / "dup.sock"))
+        assert supervisor.wait_healthy(15.0)  # the add joins probing
+        assert supervisor.probe("auto0") is not None
+        assert supervisor.remove_worker("auto0") is True
+        assert "auto0" not in supervisor.workers
+        # a probe raced against the removal answers None, never raises
+        assert supervisor.probe("auto0") is None
+        # the seed worker is untouched
+        assert supervisor.probe("w0") is not None
+
+
 # -- merged exposition (obs/export.py merge) --
 
 

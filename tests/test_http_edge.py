@@ -632,7 +632,15 @@ def test_merge_expositions_nests_host_outside_worker():
 
 def test_supervisor_host_health():
     with _Fleet(n_workers=2) as fleet:
-        health = fleet.supervisor.host_health()
+        # wait_healthy probes the workers directly; the HEALTHY state
+        # host_health() counts is stamped by the monitor thread's next
+        # pass, so give that pass time to land under suite load
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            health = fleet.supervisor.host_health()
+            if health["healthy"] == 2:
+                break
+            time.sleep(0.05)
         assert health["workers"] == 2
         assert health["healthy"] == 2
         assert health["serving"] is True
