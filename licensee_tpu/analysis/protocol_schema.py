@@ -32,6 +32,15 @@ REQUEST_OPS: dict[str, tuple[str, ...]] = {
     # plain worker answers bad_request, so the stub parity check
     # (worker vs stub) is untouched
     "traces": ("id", "n", "trace_id"),
+    # the telemetry-store query verb: FRONT-socket only (the router
+    # owns the TsdbStore the scrape scheduler feeds) — a plain worker
+    # answers bad_request, same precedent as "traces"
+    "query": (
+        "id", "series", "fn", "window", "q", "labels", "by", "limit",
+        "list", "match",
+    ),
+    # the anomaly watchdog's alert ledger: FRONT-socket only, no args
+    "alerts": ("id",),
     "reload": ("id", "corpus"),
     # normalized blob vs closest (or named) template, rendered as an
     # inline word diff (serve/diffverb.py) — same content body as the
@@ -68,6 +77,10 @@ ERROR_CODES: tuple[str, ...] = (
     "job_not_found",
     # results/containers requested before the job completed
     "job_not_done",
+    # a telemetry-store query named a series the store never ingested
+    # (distinct from bad_request: the query was well-formed, the data
+    # is absent — HTTP maps it to 404, not 400)
+    "unknown_series",
 )
 
 # response-row fields a client may read; every one must have at least
@@ -90,6 +103,8 @@ RESPONSE_FIELDS: tuple[str, ...] = (
     "traces",
     "reload",
     "diff",
+    "query",
+    "alerts",
 )
 
 # every wire "op" the checker enumerates: request verbs plus error
@@ -124,6 +139,7 @@ HTTP_ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/classify"): "content",
     ("GET", "/healthz"): "health",
     ("GET", "/metrics"): "prometheus",
+    ("GET", "/metrics/history"): "metrics_history",
     ("POST", "/jobs"): "job_submit",
     ("GET", "/jobs/{id}"): "job_status",
     ("GET", "/jobs/{id}/results"): "job_results",

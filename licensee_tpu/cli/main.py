@@ -22,6 +22,7 @@ without recompiling and hot-swap via the `{"op": "reload"}` verb.
 from __future__ import annotations
 
 import argparse
+import io
 import json
 import os
 import subprocess
@@ -1177,12 +1178,18 @@ def socket_labels(sockets: list[str]) -> dict[str, str]:
 
 
 def stats_table_rows(
-    snaps: dict, prev: dict | None = None, dt: float | None = None
+    snaps: dict, prev: dict | None = None, dt: float | None = None,
+    rates: dict | None = None,
 ) -> list[list[str]]:
     """The merged fleet table: one row per scraped worker socket.
     ``snaps`` maps label -> stats dict (or None for an unreachable
     worker); ``prev``/``dt`` from the previous --watch round turn
-    completed-counter deltas into a live req/s column."""
+    completed-counter deltas into a live req/s column.  ``rates``
+    overrides REQ_S per label with a store-backed ``rate()`` (a target
+    that serves ``{"op": "query"}`` has retained history, so the rate
+    is honest from the FIRST frame); a None value there means the
+    store is reachable but has no window yet — render "-", never a
+    fabricated 0.0."""
     header = ["WORKER", "UP_S", "DONE", "Q", "INFL", "CACHE%",
               "P50_MS", "P99_MS", "REQ_S"]
     rows = [header]
@@ -1196,13 +1203,19 @@ def stats_table_rows(
         hit_rate = cache.get("hit_rate")
         done = sched.get("completed")
         rate = "-"
-        if prev and dt and label in prev and prev[label]:
+        if rates is not None and label in rates:
+            value = rates[label]
+            rate = "-" if value is None else f"{value:.1f}"
+        elif prev and dt and label in prev and prev[label]:
             before = (prev[label].get("scheduler") or {}).get("completed")
             if isinstance(done, (int, float)) and isinstance(
                 before, (int, float)
-            ) and dt > 0 and done >= before:
+            ) and dt >= 0.2 and done >= before:
                 # done < before means the counter reset (the supervisor
-                # restarted the worker): no honest rate this frame
+                # restarted the worker): no honest rate this frame.
+                # dt < 0.2s means two frames landed near-instantly
+                # (--watch 0 drills): a delta over ~no time is noise —
+                # keep "-" rather than print a made-up 0.0
                 rate = f"{(done - before) / dt:.1f}"
 
         def cell(value, fmt="{}"):
@@ -1234,32 +1247,79 @@ def _render_table(rows: list[list[str]], stream) -> None:
         )
 
 
+def _store_req_rate(
+    sock: str, timeout: float, window: float
+) -> tuple[bool, float | None]:
+    """REQ_S from the target's telemetry store: ``rate()`` over the
+    stored completion counter via ``{"op": "query"}``.  Returns
+    ``(capable, rate)``: capable False means the target is a bare
+    worker with no store verb — the caller falls back to the
+    completed-counter delta path; rate None means the store answered
+    but has no two-sample window yet (render "-", never 0.0)."""
+    try:
+        row = _scrape_row(
+            sock,
+            {
+                "op": "query", "series": "fleet_requests_total",
+                "fn": "rate", "window": window,
+                "labels": {"event": "ok"},
+            },
+            timeout,
+        )
+    except (OSError, ValueError):
+        return False, None
+    if "query" in row:
+        value = (row["query"] or {}).get("value")
+        return True, (None if value is None else float(value))
+    if str(row.get("error", "")).startswith("unknown_series"):
+        # a store-capable front whose scrape rounds have not minted
+        # the series yet (cold start): keep querying, show "-" so far
+        return True, None
+    return False, None
+
+
 def _stats_watch(
     sockets: list[str], interval: float, timeout: float,
     iterations: int | None = None,
 ) -> int:
     """The operator view of a fleet: scrape every socket, print ONE
     merged table, redraw every ``interval`` seconds (Ctrl-C stops).
-    ``iterations`` bounds the loop (None = forever) — tests use it."""
+    ``iterations`` bounds the loop (None = forever) — tests use it.
+
+    REQ_S prefers the target's retained telemetry store (the fleet
+    front's ``{"op": "query"}`` verb) — honest from the first frame;
+    a bare worker without the verb keeps the two-frame
+    completed-counter delta."""
     import itertools
     import time as timelib
 
     labels = socket_labels(sockets)
     prev: dict = {}
     prev_t: float | None = None
+    # None = unprobed; the probe result is remembered so a bare worker
+    # is asked exactly once, not re-probed into an error every frame
+    capable: dict[str, bool | None] = {s: None for s in sockets}
+    window = max(10.0, 2.0 * interval)
     for i in itertools.count():
         if iterations is not None and i >= iterations:
             return 0
         snaps = {}
+        rates: dict = {}
         for sock in sockets:
             try:
                 row = _scrape_row(sock, {"op": "stats"}, timeout)
                 snaps[labels[sock]] = row.get("stats")
             except (OSError, ValueError):
                 snaps[labels[sock]] = None
+                continue
+            if capable[sock] is not False:
+                ok, value = _store_req_rate(sock, timeout, window)
+                capable[sock] = ok
+                if ok:
+                    rates[labels[sock]] = value
         now = timelib.perf_counter()
         dt = None if prev_t is None else now - prev_t
-        table = stats_table_rows(snaps, prev, dt)
+        table = stats_table_rows(snaps, prev, dt, rates=rates)
         if interval > 0 and sys.stdout.isatty():
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home, like watch(1)
         _render_table(table, sys.stdout)
@@ -1452,6 +1512,273 @@ def cmd_slo(args) -> int:
     _render_table(rows, sys.stdout)
     print(f"slo: {'ok' if slo.get('ok') else 'BURNING'}")
     return 0 if slo.get("ok") else 1
+
+
+def cmd_alerts(args) -> int:
+    """The anomaly watchdog's ledger: ask a fleet front socket for
+    ``{"op": "alerts"}`` (the watchdog snapshot — active alerts,
+    fire/clear history, declared rules) and render it.  Exit 0 when
+    nothing is firing, 1 when any alert is active."""
+    try:
+        row = _scrape_row(args.socket, {"op": "alerts"}, args.timeout)
+    except (OSError, ValueError) as exc:
+        print(
+            f"error: cannot scrape {args.socket!r}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    snap = row.get("alerts")
+    if not isinstance(snap, dict):
+        # a bare worker answers bad_request: the watchdog lives on the
+        # fleet front (the router owns the telemetry store)
+        print(
+            f"error: no alerts verb at {args.socket!r} (need a fleet "
+            f"front socket): {row.get('error', row)}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(snap))
+        return 0 if not snap.get("active") else 1
+    active = snap.get("active") or []
+    rows = [["RULE", "KIND", "SERIES", "SINCE_S", "DETAIL"]]
+    for alert in active:
+        rows.append([
+            alert.get("rule", "-"),
+            alert.get("kind", "-"),
+            alert.get("series", "-"),
+            f"{alert.get('since_s', 0):.0f}",
+            json.dumps(alert.get("detail") or {}),
+        ])
+    if active:
+        _render_table(rows, sys.stdout)
+    else:
+        print("no active alerts")
+    if args.history:
+        history = (snap.get("history") or [])[-args.history:]
+        for event in history:
+            print(
+                f"  {event.get('state', '?'):7s} {event.get('rule', '?')} "
+                f"({event.get('series', '?')}) "
+                f"{json.dumps(event.get('detail') or {})}"
+            )
+    print(
+        f"alerts: {len(active)} active, "
+        f"{snap.get('fired_total', 0)} fired total, "
+        f"{len(snap.get('rules') or [])} rules"
+    )
+    return 0 if not active else 1
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: list) -> str:
+    """A fixed-height unicode sparkline; None gaps render as spaces."""
+    numeric = [v for v in values if v is not None]
+    if not numeric:
+        return ""
+    lo, hi = min(numeric), max(numeric)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK_CHARS[0])
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_CHARS) - 1) + 0.5)
+            out.append(_SPARK_CHARS[min(idx, len(_SPARK_CHARS) - 1)])
+    return "".join(out)
+
+
+def _counter_rate_points(points: list) -> list:
+    """Adjacent-pair rates over stored counter samples ([ts, value]
+    rows from a ``fn=raw`` query); a negative step (counter reset)
+    yields a None gap instead of a bogus negative rate."""
+    out = []
+    for (t0, v0), (t1, v1) in zip(points, points[1:]):
+        if t1 <= t0:
+            continue
+        step = v1 - v0
+        out.append(step / (t1 - t0) if step >= 0 else None)
+    return out
+
+
+def _top_query(sock: str, params: dict, timeout: float) -> dict | None:
+    """One ``{"op": "query"}`` round trip; None on any miss (the
+    dashboard renders what it can and dashes the rest)."""
+    try:
+        row = _scrape_row(sock, {"op": "query", **params}, timeout)
+    except (OSError, ValueError):
+        return None
+    result = row.get("query")
+    return result if isinstance(result, dict) else None
+
+
+def _top_frame(sock: str, timeout: float, window: float) -> list[str]:
+    """Render one ``top`` frame from the front socket's stats verb,
+    alerts verb, and telemetry-store queries."""
+    lines: list[str] = []
+    try:
+        stats = _scrape_row(
+            sock, {"op": "stats"}, timeout
+        ).get("stats") or {}
+    except (OSError, ValueError) as exc:
+        return [f"licensee-tpu top — {sock}: unreachable ({exc})"]
+    tsdb = stats.get("tsdb") or {}
+    scrape = tsdb.get("scrape") or {}
+    alerts_block = stats.get("alerts") or {}
+    lines.append(
+        f"licensee-tpu top — {sock}   up {stats.get('uptime_s', 0):.0f}s   "
+        f"store {tsdb.get('series', 0)} series / "
+        f"{tsdb.get('bytes_est', 0)} B   "
+        f"scrape rounds {scrape.get('rounds', 0)} "
+        f"(lag {scrape.get('last_lag_s', 0):.2f}s)   "
+        f"alerts {alerts_block.get('active', 0)} active"
+    )
+    lines.append("")
+    # -- per-worker throughput + p99 from the stored series --
+    # worker schedulers count finished work as event="completed"
+    # ("ok" is the fleet-level counter, which has no worker label)
+    rps = _top_query(
+        sock,
+        {"series": "serve_requests_total", "fn": "rate",
+         "window": window, "labels": {"event": "completed"},
+         "by": "worker"},
+        timeout,
+    )
+    p99 = _top_query(
+        sock,
+        {"series": "serve_stage_seconds", "fn": "quantile", "q": 0.99,
+         "window": window, "labels": {"stage": "total"}, "by": "worker"},
+        timeout,
+    )
+    workers = sorted(
+        set((rps or {}).get("groups") or {})
+        | set((p99 or {}).get("groups") or {})
+    )
+    rows = [["WORKER", "REQ_S", "P99_MS", f"TREND({window:.0f}s)"]]
+    for name in workers:
+        rate_row = ((rps or {}).get("groups") or {}).get(name) or {}
+        p99_row = ((p99 or {}).get("groups") or {}).get(name) or {}
+        raw = _top_query(
+            sock,
+            {"series": "serve_requests_total", "fn": "raw",
+             "window": window, "limit": 24,
+             "labels": {"event": "completed", "worker": name}},
+            timeout,
+        )
+        trend = _spark(_counter_rate_points((raw or {}).get("points") or []))
+        rate = rate_row.get("value")
+        q_value = p99_row.get("value")
+        rows.append([
+            name or "(unlabeled)",
+            "-" if rate is None else f"{rate:.1f}",
+            "-" if q_value is None else f"{q_value * 1000:.1f}",
+            trend or "-",
+        ])
+    if workers:
+        out = io.StringIO()
+        _render_table(rows, out)
+        lines.extend(out.getvalue().splitlines())
+    else:
+        lines.append("(no stored per-worker series yet)")
+    # -- SLO burn --
+    objectives = (stats.get("slo") or {}).get("objectives") or {}
+    if objectives:
+        lines.append("")
+        for name, obj in sorted(objectives.items()):
+            verdict = "ok"
+            if obj.get("fast_burn_alert"):
+                verdict = "PAGE"
+            elif obj.get("slow_burn_alert"):
+                verdict = "TICKET"
+            sources = obj.get("window_sources") or {}
+            stored = sum(1 for s in sources.values() if s == "store")
+            lines.append(
+                f"slo {name}: max burn {obj.get('max_burn', 0):g} "
+                f"[{verdict}] ({stored}/{len(sources) or 0} windows "
+                f"store-backed)"
+            )
+    # -- autoscale state, when a fleet autoscaler publishes it --
+    units = _top_query(
+        sock, {"series": "autoscale_capacity_units", "fn": "latest"},
+        timeout,
+    )
+    if units is not None and units.get("value") is not None:
+        pressure = _top_query(
+            sock, {"series": "autoscale_pressure", "fn": "latest"},
+            timeout,
+        )
+        p_value = (pressure or {}).get("value")
+        lines.append(
+            f"autoscale: {units['value']:.0f} units, pressure "
+            + ("-" if p_value is None else f"{p_value:.2f}")
+        )
+    # -- active alerts --
+    try:
+        snap = _scrape_row(
+            sock, {"op": "alerts"}, timeout
+        ).get("alerts") or {}
+    except (OSError, ValueError):
+        snap = {}
+    active = snap.get("active") or []
+    if active:
+        lines.append("")
+        for alert in active:
+            lines.append(
+                f"ALERT {alert.get('rule', '?')} "
+                f"({alert.get('series', '?')}, "
+                f"{alert.get('since_s', 0):.0f}s): "
+                f"{json.dumps(alert.get('detail') or {})}"
+            )
+    return lines
+
+
+def cmd_top(args) -> int:
+    """The live fleet dashboard: per-worker req/s + p99 with stored-
+    sample sparklines, SLO burn, autoscale state, and active watchdog
+    alerts — all read from a fleet front socket's telemetry store
+    (``{"op": "stats"}`` / ``{"op": "query"}`` / ``{"op": "alerts"}``),
+    redrawn every ``--interval`` seconds."""
+    import itertools
+    import time as timelib
+
+    # a bare worker serves none of the store verbs: fail loudly once
+    # instead of rendering an empty dashboard forever
+    try:
+        probe = _scrape_row(
+            args.socket, {"op": "query", "list": True}, args.timeout
+        )
+    except (OSError, ValueError) as exc:
+        print(
+            f"error: cannot scrape {args.socket!r}: {exc}",
+            file=sys.stderr,
+        )
+        return 1
+    if "query" not in probe:
+        print(
+            f"error: no query verb at {args.socket!r} (need a fleet "
+            f"front socket): {probe.get('error', probe)}",
+            file=sys.stderr,
+        )
+        return 1
+    window = max(30.0, 4.0 * args.interval)
+    for i in itertools.count():
+        if args.iterations is not None and i >= args.iterations:
+            return 0
+        lines = _top_frame(args.socket, args.timeout, window)
+        if args.interval > 0 and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write("\n".join(lines) + "\n")
+        sys.stdout.flush()
+        if args.interval <= 0:
+            return 0
+        try:
+            timelib.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 def _jobs_option_value(value: str):
@@ -1873,6 +2200,8 @@ COMMANDS = (
     ("stats", "Scrape serve workers' metrics/traces (obs exporters)"),
     ("traces", "Render assembled cross-process trace trees (fleet)"),
     ("slo", "Evaluate SLO burn rates from a worker/fleet scrape"),
+    ("top", "Live fleet dashboard from the retained telemetry store"),
+    ("alerts", "Show the anomaly watchdog's active alerts and history"),
     ("fleet", "Supervise N serve workers behind one routed socket"),
     ("corpus-build", "Compile a corpus into a fingerprinted artifact"),
     ("jobs", "Submit and track durable striped jobs over the HTTP edge"),
@@ -2379,8 +2708,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--watch", type=nonneg(float), default=None, metavar="SECS",
         help=(
             "Re-scrape and redraw the merged table every SECS seconds "
-            "(Ctrl-C stops) — the live operator view of a fleet; the "
-            "REQ_S column is the completed-counter delta per second"
+            "(Ctrl-C stops) — the live operator view of a fleet; REQ_S "
+            "reads the target's telemetry store when it serves the "
+            "query verb (honest from the first frame), else the "
+            "completed-counter delta per second"
         ),
     )
     stats.add_argument(
@@ -2456,6 +2787,52 @@ def build_parser() -> argparse.ArgumentParser:
         help="Socket connect/read timeout (default 10)",
     )
     slo.set_defaults(func=cmd_slo)
+
+    top = sub.add_parser("top", help=_COMMAND_HELP["top"])
+    top.add_argument(
+        "--socket", required=True, metavar="PATH|HOST:PORT",
+        help=(
+            "A fleet FRONT door (its router owns the telemetry store "
+            "the dashboard reads); host:port for a TCP front"
+        ),
+    )
+    top.add_argument(
+        "--interval", type=nonneg(float), default=2.0, metavar="SECS",
+        help=(
+            "Redraw cadence (default 2; 0 prints one frame and exits)"
+        ),
+    )
+    top.add_argument(
+        "--iterations", type=nonneg(int), default=None,
+        help=argparse.SUPPRESS,  # bound the redraw loop (tests/CI)
+    )
+    top.add_argument(
+        "--timeout", type=nonneg(float), default=10.0, metavar="SECS",
+        help="Socket connect/read timeout (default 10)",
+    )
+    top.set_defaults(func=cmd_top)
+
+    alerts = sub.add_parser("alerts", help=_COMMAND_HELP["alerts"])
+    alerts.add_argument(
+        "--socket", required=True, metavar="PATH|HOST:PORT",
+        help=(
+            "A fleet FRONT door (the router's watchdog owns the alert "
+            "ledger); host:port for a TCP front"
+        ),
+    )
+    alerts.add_argument(
+        "--history", type=nonneg(int), default=0, metavar="N",
+        help="Also print the last N fire/clear transitions",
+    )
+    alerts.add_argument(
+        "--json", action="store_true",
+        help="Print the raw watchdog snapshot instead of the table",
+    )
+    alerts.add_argument(
+        "--timeout", type=nonneg(float), default=10.0, metavar="SECS",
+        help="Socket connect/read timeout (default 10)",
+    )
+    alerts.set_defaults(func=cmd_alerts)
 
     fleet = sub.add_parser("fleet", help=_COMMAND_HELP["fleet"])
     fleet.add_argument(
@@ -2796,7 +3173,9 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
-    known_commands = {"detect", "diff", "license-path", "version", "help", "batch-detect", "serve", "stats", "traces", "slo", "fleet", "corpus-build", "jobs", "-h", "--help"}
+    # derived from COMMANDS (not a second hand-kept list) so a new
+    # subcommand can never silently fall through to detect-with-a-path
+    known_commands = {name for name, _ in COMMANDS} | {"-h", "--help"}
     # default task is detect (bin/licensee:12)
     if not argv or (argv[0] not in known_commands):
         argv = ["detect", *argv]

@@ -488,6 +488,12 @@ class _StubState:
         self.lock = threading.Lock()
         self.completed = 0
         self.in_flight = 0
+        self.admitted = 0
+        # --slow-span START:COUNT:MS parsed once: (start, count, ms)
+        self.slow_span = None
+        if getattr(args, "slow_span", None):
+            start, count, ms = args.slow_span.split(":")
+            self.slow_span = (int(start), int(count), float(ms))
         # 256 deep: the SIGKILL drill assembles a failover trace from
         # this tail AFTER the remaining stream drained onto the
         # surviving worker — 64 evicted the evidence
@@ -634,9 +640,19 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
     state.flight.record("admission", id=rid, trace=msg.get("trace"))
     with state.lock:
         state.in_flight += 1
+        state.admitted += 1
+        n_admit = state.admitted
+    # the scripted latency fault: inside the --slow-span window this
+    # row serves at the fault latency, not --service-ms — admission
+    # order (not completion order) picks the victims so concurrent
+    # rows cannot shrink the span
+    delay_ms = args.service_ms
+    span = state.slow_span
+    if span is not None and span[0] < n_admit <= span[0] + span[1]:
+        delay_ms = span[2]
     try:
-        if args.service_ms:
-            time.sleep(args.service_ms / 1000.0)
+        if delay_ms:
+            time.sleep(delay_ms / 1000.0)
         with state.lock:
             state.completed += 1
             n = state.completed
@@ -648,9 +664,9 @@ def _stub_answer(state: _StubState, msg: dict) -> dict | None:
                 state.traces.append({
                     "trace": trace_id, "id": rid, "kind": "trace",
                     "proc": state.name, "status": "ok",
-                    "dur_ms": float(args.service_ms),
+                    "dur_ms": float(delay_ms),
                     "spans": [{"name": "stub_serve", "t_ms": 0.0,
-                               "dur_ms": float(args.service_ms)}],
+                               "dur_ms": float(delay_ms)}],
                 })
     finally:
         with state.lock:
@@ -779,6 +795,12 @@ def stub_main(argv=None) -> int:
         "--reload-deny", default=None, metavar="PREFIX",
         help="Refuse reload verbs whose corpus value starts with "
         "PREFIX (the per-worker validation-failure script)",
+    )
+    parser.add_argument(
+        "--slow-span", default=None, metavar="START:COUNT:MS",
+        help="Scripted latency fault: after the START-th admitted "
+        "content row, the next COUNT rows serve in MS milliseconds "
+        "instead of --service-ms (the telemetry-plane p99 drill)",
     )
     args = parser.parse_args(argv)
     kind, addr = parse_target(args.socket)

@@ -55,7 +55,10 @@ from __future__ import annotations
 import json
 import math
 import time
+import urllib.parse
 from collections import deque
+
+from licensee_tpu.obs.tsdb import QueryError
 
 # the header-echo fast path shares the router's hot-path extractor
 from licensee_tpu.fleet.wire import json_str_field as _field_from_line
@@ -75,6 +78,7 @@ ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/classify"): "content",
     ("GET", "/healthz"): "health",
     ("GET", "/metrics"): "prometheus",
+    ("GET", "/metrics/history"): "metrics_history",
     ("POST", "/jobs"): "job_submit",
     ("GET", "/jobs/{id}"): "job_status",
     ("GET", "/jobs/{id}/results"): "job_results",
@@ -316,7 +320,8 @@ class _EdgeSession:
         # jobs body budget, every other route keeps the wire-row one
         limit = (
             self.server.max_job_body_bytes
-            if (self.method, self.path) == ("POST", "/jobs")
+            if (self.method, self.path.partition("?")[0])
+            == ("POST", "/jobs")
             else self.server.max_body_bytes
         )
         if length > limit:
@@ -362,7 +367,11 @@ class _EdgeSession:
         route, job_id), or ("error", responder args) — decided at
         end-of-headers, delivered at end-of-body."""
         server = self.server
-        method, path = slot["method"], slot["path"]
+        method, raw_path = slot["method"], slot["path"]
+        # the query string is transport detail, not route identity:
+        # /metrics/history?series=… routes as /metrics/history, the
+        # params ride in the slot for the handler
+        path, _, slot["query_string"] = raw_path.partition("?")
         job_id = None
         route = ROUTES.get((method, path))
         if route is None:
@@ -395,6 +404,8 @@ class _EdgeSession:
                         [("WWW-Authenticate", "Bearer")])
         if route == "prometheus":
             return ("metrics", client)
+        if route == "metrics_history":
+            return ("metrics_history", client)
         wait = server.bucket_for(client).take()
         if wait > 0.0:
             server.count_throttle("rate_limit")
@@ -422,6 +433,9 @@ class _EdgeSession:
             return
         if kind == "metrics":
             self._defer_metrics(slot)
+            return
+        if kind == "metrics_history":
+            self._defer_history(slot)
             return
         if kind == "jobs":
             self._defer_job(slot, verdict[2], verdict[3], body)
@@ -480,6 +494,44 @@ class _EdgeSession:
                     self._respond(slot, 200, payload, ctype=ctype)
                 else:
                     self._respond(slot, 500, payload)
+
+            loop.call_soon_threadsafe(fill)
+
+        server.router._ops.submit(run)
+
+    def _defer_history(self, slot: dict) -> None:
+        """GET /metrics/history: a telemetry-store query.  Store reads
+        take the series lock — ops executor, never the loop, same
+        contract as the metrics scrape.  Param decoding happens HERE
+        (loop thread, pure string work) so a malformed number answers
+        400 without burning an ops hop."""
+        server = self.server
+        loop = server.router.loop
+        try:
+            params = _history_params(slot.get("query_string", ""))
+        except ValueError as exc:
+            self._respond(
+                slot, 400, _err_body("bad_request", str(exc)[:200])
+            )
+            return
+
+        def run() -> None:
+            try:
+                result = server.router.store.query(params)
+                resp = (200, json.dumps(result).encode("utf-8"))
+            except QueryError as exc:
+                if exc.code == "unknown_series":
+                    resp = (404,
+                            _err_body("unknown_series", str(exc)[:200]))
+                else:
+                    resp = (400,
+                            _err_body("bad_request", str(exc)[:200]))
+            except Exception as exc:  # noqa: BLE001 — session containment
+                resp = (500, _err_body("internal_error", str(exc)[:200]))
+
+            def fill() -> None:
+                code, payload = resp
+                self._respond(slot, code, payload)
 
             loop.call_soon_threadsafe(fill)
 
@@ -615,6 +667,50 @@ class _EdgeSession:
 
 def _err_body(code: str, detail: str) -> bytes:
     return json.dumps({"error": f"{code}: {detail}"}).encode("utf-8")
+
+
+def _history_params(query_string: str) -> dict:
+    """Decode ``?series=…&window=…&fn=…`` into a TsdbStore.query params
+    dict.  Labels ride as ``labels=name:value,name:value``; numeric
+    fields convert here so the store only ever sees typed params (its
+    own validation then covers ranges and vocabulary)."""
+    params: dict = {}
+    for key, value in urllib.parse.parse_qsl(
+        query_string, keep_blank_values=True
+    ):
+        if key in ("series", "fn", "by", "match"):
+            params[key] = value
+        elif key in ("window", "q"):
+            try:
+                params[key] = float(value)
+            except ValueError:
+                raise ValueError(
+                    f"{key} must be a number, got {value!r}"
+                ) from None
+        elif key == "limit":
+            try:
+                params[key] = int(value)
+            except ValueError:
+                raise ValueError(
+                    f"limit must be an integer, got {value!r}"
+                ) from None
+        elif key == "list":
+            params[key] = value.lower() not in ("", "0", "false", "no")
+        elif key == "labels":
+            labels: dict[str, str] = {}
+            for pair in value.split(","):
+                if not pair:
+                    continue
+                name, sep, lval = pair.partition(":")
+                if not sep or not name:
+                    raise ValueError(
+                        f"labels pair {pair!r} is not name:value"
+                    )
+                labels[name] = lval
+            params["labels"] = labels
+        else:
+            raise ValueError(f"unknown query parameter {key!r}")
+    return params
 
 
 def _bad_spec(detail: str) -> tuple:

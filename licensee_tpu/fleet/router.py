@@ -68,11 +68,18 @@ from concurrent.futures import ThreadPoolExecutor
 
 import re
 
-from licensee_tpu.fleet.wire import WireError, oneshot
+from licensee_tpu.fleet.wire import ConnectionPool, WireError, oneshot
 from licensee_tpu.obs import (
+    AnomalyWatchdog,
+    FlatlineRule,
     Observability,
+    QueryError,
+    RateJumpRule,
+    SaturationRule,
+    ScrapeScheduler,
     SLOEngine,
     TraceCollector,
+    TsdbStore,
     merge_expositions,
     router_objectives,
 )
@@ -466,6 +473,9 @@ class Router:
         trace_sample: float = 0.01,
         trace_slow_ms: float = 250.0,
         merge_label: str = "worker",
+        scrape_interval_s: float = 5.0,
+        store: "TsdbStore | None" = None,
+        watchdog_rules=None,
     ):
         if not backends:
             raise ValueError("need at least one backend")
@@ -552,11 +562,56 @@ class Router:
             max_workers=4, thread_name_prefix="fleet-ops"
         )
         self._register_metrics()
+        # the retained telemetry plane (obs/tsdb.py): a scrape round
+        # every scrape_interval_s pulls each worker's exposition over a
+        # parked wire connection plus the router's own registry
+        # in-process, all on the ops executor — the store behind the
+        # {"op": "query"} verb, /metrics/history, the SLO burn windows,
+        # and the anomaly watchdog.  scrape_interval_s <= 0 keeps the
+        # store but never starts the cadence thread (benches drive
+        # scrape_once() by hand to isolate its cost).
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.store = store if store is not None else TsdbStore()
+        self.store.register_metrics(self.obs.registry)
+        self._scrape_pools = {
+            name: ConnectionPool(
+                b.socket_path, max_idle=1,
+                connect_timeout=self.probe_timeout_s,
+            )
+            for name, b in self.backends.items()
+        }
+        self.scraper = ScrapeScheduler(
+            self.store,
+            interval_s=max(self.scrape_interval_s, 0.05),
+            label=self.merge_label,
+            executor=self._ops,
+            on_round=self._watchdog_round,
+        )
+        self.scraper.register_metrics(self.obs.registry)
+        self.scraper.add_target("router", self._own_exposition)
+        for name in self.backends:
+            self.scraper.add_target(
+                name, lambda n=name: self._scrape_backend(n)
+            )
+        self.watchdog = AnomalyWatchdog(
+            self.store,
+            (
+                watchdog_rules
+                if watchdog_rules is not None
+                else self._default_watchdog_rules()
+            ),
+            registry=self.obs.registry,
+        )
         # the fleet SLO engine (obs/slo.py): availability + p99 over
         # the router counters, attached AFTER _register_metrics so the
-        # collector pass syncs counters before each evaluation
+        # collector pass syncs counters before each evaluation.  Burn
+        # windows read the telemetry store (the router's own series
+        # land there labeled merge_label="router"); the private sample
+        # ring stays as the fallback until the store has coverage.
         self.slo = SLOEngine(
-            self.obs.registry, router_objectives()
+            self.obs.registry, router_objectives(),
+            store=self.store,
+            store_labels={self.merge_label: "router"},
         ).attach()
         # the telemetry-plane collector (obs/collect.py): the router's
         # own tail plus a {"op":"trace"} pull per worker, joined by
@@ -655,6 +710,78 @@ class Router:
 
         reg.add_collector(collect)
 
+    # -- telemetry plane --
+
+    def _own_exposition(self) -> str:
+        """The router registry's exposition for the scrape scheduler —
+        in-process, no socket; lands in the store under
+        ``{merge_label: "router"}``."""
+        return self.obs.prometheus()
+
+    def _scrape_backend(self, name: str) -> str:
+        """One worker's exposition over its parked scrape connection
+        (fleet/wire ConnectionPool: the connection survives between
+        rounds).  Raises on any failure — the scheduler counts it a
+        miss and the worker's stored series go stale, which is exactly
+        what the flatline watchdog rule watches."""
+        row = self._scrape_pools[name].request(
+            {"op": "stats", "format": "prometheus"},
+            timeout=self.probe_timeout_s,
+        )
+        text = row.get("prometheus")
+        if not isinstance(text, str):
+            raise WireError(f"no prometheus text from {name}: {row}")
+        return text
+
+    def _watchdog_round(self) -> None:
+        # runs at the end of every scrape round, on the ops executor
+        self.watchdog.evaluate()
+
+    def _default_watchdog_rules(self) -> list:
+        """The stock fleet rule set: p99 jump on the routed latency
+        histogram, scrape flatline per worker, saturation-approach on
+        the bounded occupancy gauges.  Rules over series the fleet
+        never stores simply never fire."""
+        interval = max(self.scrape_interval_s, 0.05)
+        rules = [
+            RateJumpRule(
+                "router_p99_latency_jump",
+                "fleet_request_seconds",
+                labels={self.merge_label: "router"},
+                signal="quantile",
+                q=0.99,
+                window_s=max(4.0 * interval, 2.0),
+                baseline_windows=8,
+                min_baseline=4,
+                z_threshold=4.5,
+                min_value=0.005,
+                description="routed p99 jumped vs its trailing baseline",
+            ),
+            SaturationRule(
+                "edge_queue_saturation",
+                "edge_queue_depth",
+                threshold=64.0,
+                description="HTTP edge queue depth approaching overflow",
+            ),
+            SaturationRule(
+                "pipeline_featurize_saturation",
+                "pipeline_featurize_busy",
+                threshold=0.95,
+                description="featurize lane occupancy near saturation",
+            ),
+        ]
+        for name in self.backends:
+            rules.append(FlatlineRule(
+                f"worker_scrape_flatline_{name}",
+                "tsdb_scrape_up",
+                labels={self.merge_label: name},
+                stale_after_s=max(3.5 * interval, 5.0),
+                description=(
+                    f"worker {name} stopped answering telemetry scrapes"
+                ),
+            ))
+        return rules
+
     # -- lifecycle --
 
     def start(self) -> None:
@@ -669,9 +796,12 @@ class Router:
         self.loop.start()
         self.loop.call_soon_threadsafe(self._probe_tick)
         self.loop.call_soon_threadsafe(self._arm_timeout_sweep)
+        if self.scrape_interval_s > 0:
+            self.scraper.start()
         self._first_probe_round.wait(self.probe_timeout_s + 2.0)
 
     def close(self) -> None:
+        self.scraper.stop()
         try:
             self.loop.run_sync(self._shutdown_on_loop)
         except (LoopClosedError, TimeoutError):
@@ -679,6 +809,8 @@ class Router:
         self.loop.stop()
         self._ops.shutdown(wait=False)
         self.collector.close()
+        for pool in self._scrape_pools.values():
+            pool.close()
 
     def _shutdown_on_loop(self) -> None:
         self._closing = True
@@ -1146,7 +1278,10 @@ class Router:
             ] += 1
         dt = time.perf_counter() - req.t0
         self._latency.record(dt)
-        self._latency_hist.observe(dt)
+        # the wire trace ID rides as the histogram bucket's exemplar:
+        # the exposition's slowest-bucket `# {trace_id="..."}` then
+        # resolves via `traces --id` to this request's assembled tree
+        self._latency_hist.observe(dt, exemplar=req.wire_trace)
         self._counters["ok"] += 1
         if req.trace is not None:
             self.obs.tracer.finish(req.trace, "ok")
@@ -1351,6 +1486,17 @@ class Router:
             # counters) + the trace collector's accounting
             "slo": self.slo.snapshot(),
             "collector": self.collector.stats(),
+            # the retained telemetry plane: store occupancy, scrape
+            # cadence health, and the watchdog's active-alert count
+            # (full alert detail is the {"op": "alerts"} verb)
+            "tsdb": {
+                **self.store.stats(),
+                "scrape": self.scraper.stats(),
+            },
+            "alerts": {
+                "active": len(self.watchdog.active()),
+                "fired_total": self.watchdog.snapshot()["fired_total"],
+            },
         }
 
     def prometheus(self) -> str:
@@ -1595,6 +1741,16 @@ class _FrontSession:
                 })
             else:
                 self._push("reload", (rid, corpus))
+        elif op == "query":
+            # the telemetry-store verb: server-side rate/delta/quantile
+            # over retained series (obs/tsdb.py) — param validation is
+            # the store's (QueryError carries the wire error code)
+            params = {
+                k: v for k, v in msg.items() if k not in ("op", "id")
+            }
+            self._push("query", (rid, params))
+        elif op == "alerts":
+            self._push("alerts", rid)
         else:
             self._push("raw", row={
                 "id": rid, "error": f"bad_request: unknown op {op!r}",
@@ -1650,6 +1806,30 @@ class _FrontSession:
                     return {"id": rid, "error": f"reload_failed: {exc}"}
 
             self._defer(slot, run_reload)
+        elif kind == "query":
+            rid, params = slot["payload"]
+
+            def run_query() -> dict:
+                row = {"id": rid}
+                try:
+                    row["query"] = self.router.store.query(params)
+                except QueryError as exc:
+                    if exc.code == "unknown_series":
+                        row["error"] = f"unknown_series: {exc}"
+                    else:
+                        row["error"] = f"bad_request: {exc}"
+                return row
+
+            self._defer(slot, run_query)
+        elif kind == "alerts":
+            rid = slot["payload"]
+
+            def run_alerts() -> dict:
+                row = {"id": rid}
+                row["alerts"] = self.router.watchdog.snapshot()
+                return row
+
+            self._defer(slot, run_alerts)
 
     def _defer(self, slot: dict, fn) -> None:
         loop = self.router.loop

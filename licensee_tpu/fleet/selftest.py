@@ -41,7 +41,7 @@ from licensee_tpu.fleet.http_edge import HttpEdgeServer
 from licensee_tpu.fleet.router import FrontServer, Router
 from licensee_tpu.fleet.supervisor import Supervisor, worker_env
 from licensee_tpu.fleet.wire import WireError, oneshot
-from licensee_tpu.obs import check_exposition
+from licensee_tpu.obs import RateJumpRule, check_exposition
 
 
 def _stub_argv(name: str, sock: str) -> list[str]:
@@ -350,11 +350,16 @@ def selftest(
             server_thread.join(timeout=5.0)
         router.close()
         supervisor.stop()
+    # -- the retained-telemetry acceptance drill: its own mini-fleet
+    #    (the scripted fault must not race the kill/drain drills
+    #    above); stub-only — --slow-span is a stub fault flag --
+    telemetry = _telemetry_drill(problems) if stub else None
     if verbose:
         summary = {
             "fleet_selftest": "ok" if not problems else "FAIL",
             "stub_workers": stub,
             "saturation": saturation,
+            "telemetry": telemetry,
             "problems": problems,
         }
         sys.stderr.write(json.dumps(summary) + "\n")
@@ -402,6 +407,243 @@ def _check_flight_harvest(
             f"harvested={entry.get('flight_harvested')} "
             f"events={len(entry.get('flight_events') or [])}"
         )
+
+
+def _telemetry_drill(problems: list[str]) -> dict | None:
+    """The retained-telemetry acceptance drill: a scripted latency
+    fault on a stub worker must (1) appear as a stored p99 series
+    windowable via the ``{"op": "query"}`` front verb, (2) carry an
+    exemplar whose trace ID resolves through ``{"op": "traces"}`` to an
+    assembled tree naming that worker, and (3) raise exactly ONE
+    watchdog alert (``router_p99_latency_jump``) that clears once the
+    fault ends.
+
+    Runs its own single-worker mini-fleet: with one backend, router
+    dispatch order IS the stub's admission order, so the ``--slow-span``
+    fault window (rows N_BASE+1 .. N_BASE+N_SLOW) is deterministic —
+    no racing against a load balancer.  Scrape cadence is cranked to
+    0.25s so the stock p99-jump rule's 2s windows fill in seconds, not
+    the production minutes."""
+    from licensee_tpu.fleet.wire import Connection
+
+    n_base, n_slow, slow_ms = 400, 14, 250.0
+    tmpdir = tempfile.mkdtemp(prefix="licensee-tsdb-drill-")
+    sock = os.path.join(tmpdir, "wslow.sock")
+    front_path = os.path.join(tmpdir, "front.sock")
+
+    def argv_for(name: str, path: str) -> list[str]:
+        return [
+            sys.executable, "-m", "licensee_tpu.fleet.faults",
+            "--socket", path, "--name", name, "--service-ms", "5",
+            "--slow-span", f"{n_base}:{n_slow}:{slow_ms:g}",
+        ]
+
+    env = worker_env(None, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    supervisor = Supervisor(
+        {"wslow": sock},
+        argv_for=argv_for,
+        env_for=lambda name, chips: env,
+        probe_interval_s=0.25,
+        startup_grace_s=20.0,
+    )
+    router = Router(
+        {"wslow": sock},
+        supervisor=supervisor,
+        probe_interval_s=0.25,
+        request_timeout_s=10.0,
+        trace_sample=1.0,
+        scrape_interval_s=0.25,
+        # the stock rule set with one drill tuning: min_value=0.05
+        # keeps cold-start jitter (tens of ms on the first windows)
+        # from firing — only the scripted 250ms span can breach
+        watchdog_rules=[RateJumpRule(
+            "router_p99_latency_jump",
+            "fleet_request_seconds",
+            labels={"worker": "router"},
+            signal="quantile",
+            q=0.99,
+            window_s=2.0,
+            baseline_windows=8,
+            min_baseline=4,
+            z_threshold=4.5,
+            min_value=0.05,
+            description="routed p99 jumped vs its trailing baseline",
+        )],
+    )
+    server = None
+    server_thread = None
+    stop = threading.Event()
+    drive_errors: list[str] = []
+    out: dict = {}
+
+    def drive() -> None:
+        """Paced lockstep traffic over ONE connection: the 25ms pace
+        spreads the n_base baseline rows across >10s of wall clock, so
+        the p99 rule's trailing 2s windows all see traffic before the
+        fault lands; past n_base the stub itself throttles (each slow
+        row holds the line slow_ms)."""
+        conn = None
+        try:
+            conn = Connection(front_path, 10.0)
+            i = 0
+            while not stop.is_set():
+                row = conn.request(json.dumps(
+                    {"id": i, "content": f"drill {i}"}
+                ), 10.0)
+                if row.get("error"):
+                    drive_errors.append(f"drill row error: {row}")
+                    return
+                i += 1
+                stop.wait(0.025)
+        except (WireError, OSError) as exc:
+            if not stop.is_set():
+                drive_errors.append(f"drill driver died: {exc}")
+        finally:
+            if conn is not None:
+                conn.close()
+
+    driver = threading.Thread(target=drive, daemon=True)
+    try:
+        supervisor.start()
+        if not supervisor.wait_healthy(20.0):
+            problems.append(
+                f"telemetry drill: worker never healthy: "
+                f"{supervisor.status()}"
+            )
+            raise _Abort()
+        router.start()
+        server = FrontServer(front_path, router, stall_timeout_s=5.0)
+        server_thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        server_thread.start()
+        driver.start()
+
+        # -- (3a) the fault fires the p99-jump rule, and ONLY it --
+        fired = None
+        deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < deadline and not drive_errors:
+            row = oneshot(front_path, {"op": "alerts"}, 5.0)
+            active = (row.get("alerts") or {}).get("active") or []
+            if any(
+                a.get("rule") == "router_p99_latency_jump"
+                for a in active
+            ):
+                fired = active
+                break
+            time.sleep(0.3)
+        if fired is None:
+            problems.append(
+                "telemetry drill: p99-jump alert never fired "
+                f"(driver errors: {drive_errors[:2]})"
+            )
+            raise _Abort()
+        extras = [
+            a["rule"] for a in fired
+            if a.get("rule") != "router_p99_latency_jump"
+        ]
+        if extras:
+            problems.append(
+                f"telemetry drill: unexpected co-firing rules: {extras}"
+            )
+        out["alert"] = fired[0]
+
+        # -- (1) the fault is windowable store history: p99 over a
+        #    window covering the fault, served by the query verb.  The
+        #    alert fires at the FIRST slow completion (one 250ms row
+        #    detonates the z-score against the tight baseline), but the
+        #    windowed p99 only crosses once enough of the span has
+        #    drained through the stub to outnumber the top percentile —
+        #    so poll while the remaining ~3.5s of slow rows land.  20s
+        #    window (not the rule's 2s): the 14 slow rows stay >1% of
+        #    any 20s window at the ~40/s drill pace, so a scheduling
+        #    stall on a loaded single-core VM cannot roll the fault
+        #    out from under the assertion --
+        q: dict = {}
+        value = None
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            row = oneshot(front_path, {
+                "op": "query", "series": "fleet_request_seconds",
+                "fn": "quantile", "q": 0.99, "window": 20.0,
+                "labels": {"worker": "router"},
+            }, 5.0)
+            q = row.get("query") or {}
+            value = q.get("value")
+            if value is not None and value >= 0.05:
+                break
+            time.sleep(0.3)
+        if value is None or not value >= 0.05:
+            problems.append(
+                f"telemetry drill: stored p99 missed the "
+                f"{slow_ms:g}ms fault: {q}"
+            )
+        out["p99"] = value
+
+        # -- (2) the stored exemplar closes the loop to a trace tree
+        #    naming the slow worker --
+        ex = q.get("exemplar") or {}
+        ex_id = ex.get("trace_id")
+        if not ex_id:
+            problems.append(
+                f"telemetry drill: fault p99 carries no exemplar: {q}"
+            )
+        else:
+            row = oneshot(front_path, {
+                "op": "traces", "trace_id": ex_id, "n": 5,
+            }, 10.0)
+            trees = row.get("traces") or []
+            procs = set((trees[0].get("procs") or ())) if trees else set()
+            if not trees or "wslow" not in procs:
+                problems.append(
+                    f"telemetry drill: exemplar {ex_id!r} resolved to "
+                    f"no tree naming the slow worker (procs={procs})"
+                )
+            out["exemplar"] = ex_id
+
+        # -- (3b) recovery traffic clears the alert; exactly one fire
+        #    across the whole drill --
+        cleared = False
+        deadline = time.perf_counter() + 45.0
+        while time.perf_counter() < deadline:
+            row = oneshot(front_path, {"op": "alerts"}, 5.0)
+            snap = row.get("alerts") or {}
+            if not snap.get("active"):
+                cleared = True
+                break
+            time.sleep(0.3)
+        if not cleared:
+            problems.append(
+                "telemetry drill: alert never cleared after the fault "
+                f"ended: {snap.get('active')}"
+            )
+        elif snap.get("fired_total") != 1:
+            problems.append(
+                f"telemetry drill: fired_total "
+                f"{snap.get('fired_total')} != 1"
+            )
+        out["fired_total"] = snap.get("fired_total")
+        if drive_errors:
+            problems.append(f"telemetry drill: {drive_errors[:3]}")
+    except _Abort:
+        pass
+    except Exception as exc:  # noqa: BLE001 — selftest must report, not die
+        problems.append(
+            f"telemetry drill crashed: {type(exc).__name__}: {exc}"
+        )
+    finally:
+        stop.set()
+        driver.join(timeout=15.0)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if server_thread is not None:
+            server_thread.join(timeout=5.0)
+        router.close()
+        supervisor.stop()
+    return out or None
 
 
 def _check_slo(router: Router, problems: list[str]) -> None:

@@ -30,7 +30,7 @@ import os
 import threading
 import time
 
-from licensee_tpu.obs import MetricsRegistry, Tracer
+from licensee_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
 from licensee_tpu.parallel.distributed import shard_output_path
 from licensee_tpu.parallel.stripes import (
     StripeError,
@@ -258,6 +258,13 @@ class JobExecutor:
             )
         self.jobs_dir = jobs_dir
         self.journal = JobJournal(os.path.join(jobs_dir, "journal.jsonl"))
+        # the jobs tier's black box: every submit/resume/cancel/merge
+        # transition lands in the ring, spilled to jobs_dir/flight.json
+        # — after a SIGKILL the harvest tells the story the journal's
+        # terse state rows cannot
+        self.flight = FlightRecorder(
+            os.path.join(jobs_dir, "flight.json"), proc="jobs"
+        )
         self.max_concurrent = int(max_concurrent)
         self.base_env = base_env
         self.runner_factory = runner_factory
@@ -394,6 +401,10 @@ class JobExecutor:
                 self._queue.append(job_id)
             n_resumed = self.resumed_jobs
             n_queued = len(self._queue)
+            for job_id in self._queue:
+                if self._jobs[job_id].resumed:
+                    self.flight.record("job_resume", job=job_id)
+        self.flight.start()
         if n_queued:
             self._event(
                 f"journal replay: {n_queued} job(s) re-enqueued "
@@ -424,6 +435,7 @@ class JobExecutor:
             for t in self._threads:
                 t.join(timeout=max(0.1, deadline - time.perf_counter()))
         self.journal.close()
+        self.flight.stop()
 
     # -- the client surface (ops threads) --
 
@@ -457,6 +469,9 @@ class JobExecutor:
             self._jobs[job_id] = job
         self.journal.append(record)
         self._submitted.inc()
+        self.flight.record(
+            "job_submit", job=job_id, entries=len(spec["manifest"])
+        )
         with self._lock:
             self._queue.append(job_id)
             self._wake.notify()
@@ -481,6 +496,9 @@ class JobExecutor:
                 except ValueError:
                     pass
                 job.state = "cancelled"
+        self.flight.record(
+            "job_cancel", job=job_id, queued=was_queued
+        )
         if was_queued:
             self._append_state(job, "cancelled")
             self._cancelled.inc()
@@ -623,6 +641,11 @@ class JobExecutor:
         trace.add_span(
             "job.merge", t_end - last_done_t[0], t0=last_done_t[0]
         )
+        self.flight.record(
+            "job_merge", job=job.job_id,
+            rows=summary.get("rows_written"),
+            merge_ms=round((t_end - last_done_t[0]) * 1000.0, 3),
+        )
         with self._lock:
             job.summary = {
                 k: summary.get(k)
@@ -665,6 +688,7 @@ class JobExecutor:
             job.state = state
             job.error = error
             job.runner = None
+        self.flight.record("job_finish", job=job.job_id, state=state)
         self.tracer.finish(
             trace, "ok" if state == "completed" else state
         )

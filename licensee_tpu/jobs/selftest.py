@@ -428,6 +428,32 @@ def selftest_jobs(verbose: bool = True, stub: bool = True) -> int:
             front_sock = ready["front"]
             client = JobsClient(target, token=_EDGE_TOKEN)
 
+            # -- the executor's black box survived the SIGKILL: fleet
+            # A's flight recorder spilled its submit/finish events to
+            # the jobs dir before the kill, and the harvest must not
+            # come back empty (the whole point of a black box) --
+            from licensee_tpu.obs import load_flight_dump
+
+            box = load_flight_dump(os.path.join(jobs_dir, "flight.json"))
+            box_kinds = {
+                e.get("kind") for e in (box or {}).get("events") or ()
+            }
+            if not box or not box_kinds:
+                problems.append(
+                    "executor flight recorder left no harvest after "
+                    f"the SIGKILL drill: {box}"
+                )
+            elif not box_kinds & {"job_submit", "job_resume"}:
+                # fleet A's box carries the submits; if fleet B's
+                # flusher already rewrote the file, its replay carries
+                # the resume of the killed job — either proves the
+                # black box closed the loop
+                problems.append(
+                    f"flight harvest has no job events: {box_kinds}"
+                )
+            else:
+                say(f"flight harvest: {sorted(box_kinds)}")
+
             # the completed job survived the journal replay
             code, row = client.status(job1)
             if code != 200 or row.get("state") != "completed":

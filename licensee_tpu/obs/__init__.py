@@ -38,6 +38,17 @@ from licensee_tpu.obs.flight import (
     load_flight_dump,
 )
 from licensee_tpu.obs.pipeline import PipelineLanes
+from licensee_tpu.obs.anomaly import (
+    AnomalyWatchdog,
+    FlatlineRule,
+    RateJumpRule,
+    SaturationRule,
+)
+from licensee_tpu.obs.tsdb import (
+    QueryError,
+    ScrapeScheduler,
+    TsdbStore,
+)
 from licensee_tpu.obs.slo import (
     SLOEngine,
     router_objectives,
@@ -58,6 +69,8 @@ __all__ = [
     "TraceCollector", "assemble_rows", "assemble_trace", "render_tree",
     "FlightRecorder", "flight_path_for_socket", "load_flight_dump",
     "SLOEngine", "serve_objectives", "router_objectives",
+    "TsdbStore", "ScrapeScheduler", "QueryError",
+    "AnomalyWatchdog", "RateJumpRule", "FlatlineRule", "SaturationRule",
     "DEFAULT_LATENCY_BUCKETS", "Observability",
 ]
 

@@ -28,17 +28,21 @@ from licensee_tpu.obs.registry import MetricsRegistry
 
 # one exposition line: a comment (# HELP / # TYPE), or a sample —
 # name, optional {labels} with escaped string values, a float value
-# (inf/nan included), optional timestamp.  The selftest holds every
-# rendered line to this grammar.
+# (inf/nan included), optional timestamp, optional OpenMetrics
+# exemplar (`# {trace_id="..."} value [ts]`).  The selftest holds
+# every rendered line to this grammar.
 _LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+_VALUE = r"(?:[+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|inf)|NaN|nan)"
+_LABELSET = rf"\{{(?:(?:{_LABEL})(?:,(?:{_LABEL}))*)?\}}"
 PROM_LINE_RE = re.compile(
     r"^(?:"
     r"# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*(?: [^\n]*)?"
     r"|"
     r"[a-zA-Z_:][a-zA-Z0-9_:]*"
     rf"(?:\{{(?:{_LABEL})(?:,(?:{_LABEL}))*\}})?"
-    r" (?:[+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|inf)|NaN|nan)"
+    rf" {_VALUE}"
     r"(?: [+-]?[0-9]+)?"
+    rf"(?: # {_LABELSET} {_VALUE}(?: {_VALUE})?)?"
     r")$"
 )
 
@@ -90,11 +94,22 @@ def render_prometheus(registry: MetricsRegistry) -> str:
         lines.append(f"# TYPE {fam.name} {fam.kind}")
         for labels, value in samples:
             if fam.kind == "histogram":
+                exemplars = value.get("exemplars") or {}
                 for le, count in value["buckets"].items():
-                    lines.append(
+                    line = (
                         f"{fam.name}_bucket"
                         f"{_labelset({**labels, 'le': le})} {count}"
                     )
+                    ex = exemplars.get(le)
+                    if ex is not None:
+                        # OpenMetrics exemplar: the trace behind the
+                        # slowest observation this bucket retained
+                        line += (
+                            f' # {{trace_id='
+                            f'"{_escape_label(ex["trace_id"])}"}} '
+                            f"{_fmt(ex['value'])}"
+                        )
+                    lines.append(line)
                 lines.append(
                     f"{fam.name}_sum{_labelset(labels)} "
                     f"{_fmt(value['sum'])}"
@@ -123,9 +138,13 @@ def check_exposition(text: str) -> list[str]:
 
 
 # one sample line, split into (name, optional {labels}, value+rest) —
-# the merge rewriter injects a source label between name and labels
+# the merge rewriter injects a source label between name and labels.
+# The labels group is non-greedy ([^}]*, NOT .*): an OpenMetrics
+# exemplar suffix carries its own {...} later in the line, and a
+# greedy match would swallow up to the exemplar's closing brace and
+# corrupt the rewrite.  Exemplars ride through untouched in ``rest``.
 _SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?( .+)$"
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?( .+)$"
 )
 _COMMENT_RE = re.compile(r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*)(.*)$")
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
@@ -106,11 +107,24 @@ class Gauge:
         return self._value
 
 
+# an exemplar sticks to its bucket for one retention window: within
+# the window only a SLOWER observation replaces it (the p99 culprit
+# survives a flood of fast requests), after it anything fresh wins
+EXEMPLAR_TTL_S = 120.0
+
+
 class Histogram:
     """Fixed-bound histogram: cumulative bucket counts + sum + count,
-    the Prometheus histogram type."""
+    the Prometheus histogram type.
 
-    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock")
+    ``observe(value, exemplar=trace_id)`` optionally pins an OpenMetrics
+    exemplar to the bucket the observation lands in — the slowest
+    observation per bucket per :data:`EXEMPLAR_TTL_S` window keeps its
+    trace ID, so a p99 spike in the exposition links straight back to
+    the assembled trace that caused it."""
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock",
+                 "_exemplars")
 
     def __init__(self, bounds=DEFAULT_LATENCY_BUCKETS):
         bounds = tuple(float(b) for b in bounds)
@@ -123,8 +137,10 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        # per bucket: (value, trace_id, monotonic ts) or None
+        self._exemplars = [None] * (len(bounds) + 1)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         # linear probe: bound lists are short (~14) and the common case
         # (sub-ms latencies) exits in the first few steps
         i = 0
@@ -135,19 +151,38 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                slot = self._exemplars[i]
+                now = time.monotonic()
+                if (
+                    slot is None
+                    or value >= slot[0]
+                    or now - slot[2] > EXEMPLAR_TTL_S
+                ):
+                    self._exemplars[i] = (float(value), str(exemplar), now)
 
     @property
     def value(self) -> dict:
         with self._lock:
             counts = list(self._counts)
             total, n = self._sum, self._count
+            slots = list(self._exemplars)
         cumulative: dict[str, int] = {}
         running = 0
         for bound, c in zip(self.bounds, counts):
             running += c
             cumulative[repr(bound)] = running
         cumulative["+Inf"] = running + counts[-1]
-        return {"buckets": cumulative, "sum": total, "count": n}
+        out = {"buckets": cumulative, "sum": total, "count": n}
+        now = time.monotonic()
+        exemplars = {
+            le: {"value": slot[0], "trace_id": slot[1]}
+            for le, slot in zip([*cumulative], slots)
+            if slot is not None and now - slot[2] <= EXEMPLAR_TTL_S
+        }
+        if exemplars:
+            out["exemplars"] = exemplars
+        return out
 
 
 _METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -201,8 +236,8 @@ class MetricFamily:
     def set_fn(self, fn) -> None:
         self._solo().set_fn(fn)
 
-    def observe(self, value: float) -> None:
-        self._solo().observe(value)
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        self._solo().observe(value, exemplar=exemplar)
 
     @property
     def value(self):

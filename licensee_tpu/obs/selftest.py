@@ -20,10 +20,14 @@ import tempfile
 import time
 
 from licensee_tpu.obs import (
+    AnomalyWatchdog,
     MetricsRegistry,
     NativeProfileSource,
     Observability,
+    QueryError,
+    RateJumpRule,
     Tracer,
+    TsdbStore,
     check_exposition,
     render_prometheus,
 )
@@ -129,6 +133,102 @@ def selftest(stream=None) -> int:
     )
     if blobs != 25.0:
         problems.append(f"profile delta lost an increment: {blobs}")
+
+    # -- telemetry store: ingest -> downsample -> query round trip --
+    fake_t = [1000.0]
+    store = TsdbStore(
+        fine_step_s=1.0, fine_len=10, coarse_step_s=5.0, coarse_len=20,
+        clock=lambda: fake_t[0],
+    )
+    for i in range(40):
+        # 40 samples through a 10-deep fine ring: 30 of them MUST
+        # survive by folding into the coarse ring, or the rate below
+        # has no window to stand on
+        store.ingest("t_req_total", {"worker": "w0"}, float(i),
+                     ts=1000.0 + i)
+    fake_t[0] = 1039.0
+    rate = store.rate("t_req_total", {"worker": "w0"}, window_s=39.0)
+    if rate is None or abs(rate - 1.0) > 0.2:
+        problems.append(f"tsdb rate after downsample: {rate}")
+    raw = store.query({
+        "series": "t_req_total", "fn": "raw", "window": 39.0,
+    })
+    if len(raw.get("points") or []) <= 10:
+        problems.append(
+            f"tsdb downsample lost history: {len(raw.get('points') or [])}"
+        )
+    try:
+        store.query({"series": "t_absent_total", "fn": "latest"})
+        problems.append("tsdb unknown_series not raised")
+    except QueryError as exc:
+        if exc.code != "unknown_series":
+            problems.append(f"tsdb query error code: {exc.code}")
+
+    # -- exemplars: histogram -> exposition -> store -> quantile --
+    reg3 = MetricsRegistry()
+    h3 = reg3.histogram("t_rt_seconds", "rt", buckets=(0.01, 0.1, 1.0))
+    h3.observe(0.005)
+    h3.observe(0.25, exemplar="deadbeefcafef00d")
+    store.ingest_exposition(
+        render_prometheus(reg3), extra_labels={"worker": "w0"},
+        ts=1040.0,
+    )
+    h3.observe(0.5, exemplar="feedfacefeedface")
+    store.ingest_exposition(
+        render_prometheus(reg3), extra_labels={"worker": "w0"},
+        ts=1045.0,
+    )
+    fake_t[0] = 1045.0
+    q_row = store.query({
+        "series": "t_rt_seconds", "fn": "quantile", "q": 0.99,
+        "window": 10.0,
+    })
+    q_value = q_row.get("value")
+    if q_value is None or not 0.1 < q_value <= 1.0:
+        problems.append(f"tsdb quantile: {q_row}")
+    ex = q_row.get("exemplar") or {}
+    if ex.get("trace_id") != "feedfacefeedface":
+        problems.append(f"tsdb exemplar round trip: {ex}")
+
+    # -- anomaly watchdog: a forced 50x rate jump fires exactly once
+    # and clears after recovery --
+    fake2 = [0.0]
+    store2 = TsdbStore(fine_len=400, clock=lambda: fake2[0])
+    v = 0.0
+    for i in range(101):  # steady 1/s baseline
+        store2.ingest("t_jump_total", value=v, ts=float(i))
+        v += 1.0
+    rule = RateJumpRule(
+        "t_jump", "t_jump_total", window_s=10.0, baseline_windows=4,
+        min_baseline=3, z_threshold=4.0,
+    )
+    wd = AnomalyWatchdog(
+        store2, [rule], hold_ticks=1, clear_ticks=2,
+        clock=lambda: fake2[0],
+    )
+    fake2[0] = 100.0
+    wd.evaluate()
+    if wd.active():
+        problems.append(f"watchdog fired on steady traffic: {wd.active()}")
+    for i in range(101, 121):  # the fault: 50/s
+        store2.ingest("t_jump_total", value=v, ts=float(i))
+        v += 50.0
+    fake2[0] = 120.0
+    wd.evaluate()
+    if not wd.active():
+        problems.append("watchdog missed a 50x rate jump")
+    for i in range(121, 181):  # recovery: steady 1/s again
+        store2.ingest("t_jump_total", value=v, ts=float(i))
+        v += 1.0
+    for t in (150.0, 165.0, 180.0):
+        fake2[0] = t
+        wd.evaluate()
+    if wd.active():
+        problems.append(f"watchdog failed to clear: {wd.active()}")
+    if wd.snapshot()["fired_total"] != 1:
+        problems.append(
+            f"watchdog fired_total: {wd.snapshot()['fired_total']}"
+        )
 
     # -- Observability bundle: uptime gauge + merged snapshot shape --
     obs = Observability(tracing=True, trace_sample=1.0)

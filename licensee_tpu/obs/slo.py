@@ -78,6 +78,14 @@ class Objective:
     def totals(self, registry) -> tuple[float, float]:
         raise NotImplementedError
 
+    def store_deltas(self, store, labels: dict, window_s: float):
+        """(good_delta, bad_delta) over the trailing window read from a
+        telemetry store (obs/tsdb.py), or None when the store has no
+        coverage yet — the engine then falls back to its sample ring.
+        ``labels`` narrows to this process's scraped series (the
+        scheduler's source tag, e.g. ``{"worker": "router"}``)."""
+        return None
+
 
 class AvailabilityObjective(Objective):
     """Good/bad read from an ``{event}``-labeled counter family:
@@ -110,6 +118,26 @@ class AvailabilityObjective(Objective):
             elif event in self.bad_events:
                 bad += value
         return good, bad
+
+    def store_deltas(self, store, labels: dict, window_s: float):
+        good = bad = 0.0
+        covered = False
+        for events, bucket in (
+            (self.good_events, "good"), (self.bad_events, "bad")
+        ):
+            for event in events:
+                d = store.delta(
+                    self.family, {**labels, "event": event},
+                    window_s=window_s,
+                )
+                if d is None:
+                    continue  # this event never happened: no series
+                covered = True
+                if bucket == "good":
+                    good += d
+                else:
+                    bad += d
+        return (good, bad) if covered else None
 
 
 class LatencyObjective(Objective):
@@ -157,6 +185,36 @@ class LatencyObjective(Objective):
             if float(bound_repr) >= self.threshold_s:
                 good = float(cum)
                 break
+        return good, max(0.0, total - good)
+
+    def store_deltas(self, store, labels: dict, window_s: float):
+        merged = {**labels, **self.labels}
+        total = store.delta(
+            self.family + "_count", merged, window_s=window_s
+        )
+        if total is None or total <= 0:
+            return None
+        # nearest stored bound at or above the threshold (same
+        # round-UP rule as the registry path); no finite bound at or
+        # above it means every bucketed observation counts as good
+        bounds = []
+        for le in store.label_values(
+            self.family + "_bucket", "le", merged
+        ):
+            if le != "+Inf":
+                bounds.append((float(le), le))
+        at_or_above = sorted(
+            b for b in bounds if b[0] >= self.threshold_s
+        )
+        if not at_or_above:
+            return total, 0.0
+        good = store.delta(
+            self.family + "_bucket",
+            {**merged, "le": at_or_above[0][1]},
+            window_s=window_s,
+        )
+        if good is None:
+            return None
         return good, max(0.0, total - good)
 
 
@@ -230,12 +288,22 @@ class SLOEngine:
     collector pass so every scrape both ticks the sample ring and
     refreshes the gauges."""
 
-    def __init__(self, registry, objectives: list[Objective]):
+    def __init__(
+        self, registry, objectives: list[Objective],
+        *, store=None, store_labels: dict | None = None,
+    ):
         names = [o.name for o in objectives]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate objective names: {names}")
         self.registry = registry
         self.objectives = list(objectives)
+        # the telemetry store (obs/tsdb.py): when attached, burn
+        # windows become store queries over the retained series
+        # (tagged store_labels by the scrape scheduler); the private
+        # sample ring stays as the fallback until the store has
+        # coverage for a window
+        self._store = store
+        self._store_labels = dict(store_labels or {})
         self._t0 = time.perf_counter()
         self.last: dict | None = None  # the most recent evaluation
         # the sample ring: (t, {objective: (good, bad)}) — guarded by
@@ -337,10 +405,24 @@ class SLOEngine:
         for obj in self.objectives:
             good_now, bad_now = tick["totals"][obj.name]
             windows: dict[str, float | None] = {}
+            sources: dict[str, str] = {}
             for wname, wsecs in WINDOWS:
-                good_d, bad_d = self._window_delta(
-                    samples, now, wsecs, obj.name
-                )
+                deltas = None
+                if self._store is not None:
+                    try:
+                        deltas = obj.store_deltas(
+                            self._store, self._store_labels, wsecs
+                        )
+                    except Exception:  # noqa: BLE001 — a store hiccup falls back to the ring
+                        deltas = None
+                if deltas is None:
+                    sources[wname] = "ring"
+                    deltas = self._window_delta(
+                        samples, now, wsecs, obj.name
+                    )
+                else:
+                    sources[wname] = "store"
+                good_d, bad_d = deltas
                 total = good_d + bad_d
                 if total <= 0:
                     burn = 0.0  # no traffic burns no budget
@@ -358,6 +440,7 @@ class SLOEngine:
                 "good": good_now,
                 "bad": bad_now,
                 "windows": windows,
+                "window_sources": sources,
                 "max_burn": max(windows.values()),
                 "fast_burn_alert": fast > FAST_BURN,
                 "slow_burn_alert": slow > SLOW_BURN,

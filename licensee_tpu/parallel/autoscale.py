@@ -314,14 +314,23 @@ class ExpositionScraper:
     that epoch keeps advancing.  A file whose epoch has not moved for
     ``stale_after_s`` belongs to a dead, wedged, or not-yet-started
     incarnation and reads as None — the decider then sees "no signal",
-    never a frozen lane snapshot from a just-killed stripe."""
+    never a frozen lane snapshot from a just-killed stripe.
 
-    def __init__(self, stale_after_s: float = 10.0):
+    With a fleet telemetry store attached (``store=``, a
+    :class:`~licensee_tpu.obs.tsdb.TsdbStore`), ``sample_store``
+    offers the same occupancy signal without a file in the path: the
+    router's scrape scheduler already ingests every worker's gauges
+    under per-worker labels, so the autoscaler can read the stored
+    samples directly.  The file path stays — the striped batch runner
+    has no router and keeps scraping ``--prom-file`` dumps."""
+
+    def __init__(self, stale_after_s: float = 10.0, *, store=None):
         if stale_after_s <= 0:
             raise ValueError(
                 f"stale_after_s must be > 0, got {stale_after_s!r}"
             )
         self.stale_after_s = float(stale_after_s)
+        self.store = store
         # key -> (last epoch seen, monotonic time the epoch last moved)
         self._seen: dict[str, tuple[float, float]] = {}
 
@@ -351,6 +360,31 @@ class ExpositionScraper:
         if now - last[1] > self.stale_after_s:
             return None
         return gauges
+
+    def sample_store(
+        self,
+        labels: dict,
+        now: float | None = None,
+        *,
+        names: tuple = ("pipeline_featurize_busy",),
+    ) -> dict[str, float] | None:
+        """Store-backed twin of ``sample``: each named gauge's freshest
+        stored sample carrying ``labels`` (the scrape scheduler's
+        per-worker ingest labels).  Freshness is the sample's own
+        timestamp — a series that stopped advancing reads as None
+        exactly like a frozen ``--prom-file`` epoch.  Timestamps live
+        in the store's clock domain (``time.monotonic``), so a passed
+        ``now`` must too; None reads that clock."""
+        if self.store is None:
+            return None
+        now = time.monotonic() if now is None else now
+        out: dict[str, float] = {}
+        for name in names:
+            hit = self.store.latest(name, labels)
+            if hit is None or now - hit[0] > self.stale_after_s:
+                continue
+            out[name] = hit[1]
+        return out or None
 
 
 class FleetAutoscaler:

@@ -252,7 +252,9 @@ class MicroBatcher:
         )
         self.stats_stages = StageStats(
             STAGES,
-            observer=lambda s, dt: stage_hist.labels(stage=s).observe(dt),
+            observer=lambda s, dt, ex=None: stage_hist.labels(
+                stage=s
+            ).observe(dt, exemplar=ex),
         )
         self._queue: deque[ServeRequest] = deque()
         # content key -> the queued primary request: a duplicate
@@ -819,7 +821,9 @@ class MicroBatcher:
         req.result = result
         with self._lock:
             self._counters["completed"] += 1
-        self.stats_stages.record("total", time.perf_counter() - t0)
+        self.stats_stages.record(
+            "total", time.perf_counter() - t0, exemplar=req.trace_id
+        )
         if req.trace is not None:
             self.obs.tracer.finish(req.trace, status)
         req.done.set()
@@ -1142,7 +1146,8 @@ class MicroBatcher:
                         with self._lock:
                             self._counters["expired"] += 1
                     self.stats_stages.record(
-                        "total", done_t - member.created
+                        "total", done_t - member.created,
+                        exemplar=member.trace_id,
                     )
                     if member.trace is not None:
                         self.obs.tracer.finish(member.trace, status)

@@ -85,10 +85,12 @@ class StageStats:
     """A named family of LatencyStats — one per pipeline stage — that
     snapshots into a single JSON-ready dict.
 
-    ``observer(stage, seconds)``, when given, is called on every record
-    — the obs registry tees each sample into its fixed-bound histograms
-    without a second timing site (one reservoir, one histogram, one
-    clock read)."""
+    ``observer(stage, seconds, exemplar)``, when given, is called on
+    every record — the obs registry tees each sample into its
+    fixed-bound histograms without a second timing site (one reservoir,
+    one histogram, one clock read).  ``exemplar`` is the recording
+    request's trace ID (or None): the histogram keeps it as the
+    OpenMetrics exemplar for the bucket the sample lands in."""
 
     def __init__(
         self, stages: tuple[str, ...], capacity: int = 4096, observer=None
@@ -96,10 +98,12 @@ class StageStats:
         self._stages = {s: LatencyStats(capacity) for s in stages}
         self._observer = observer
 
-    def record(self, stage: str, seconds: float) -> None:
+    def record(
+        self, stage: str, seconds: float, exemplar: str | None = None
+    ) -> None:
         self._stages[stage].record(seconds)
         if self._observer is not None:
-            self._observer(stage, seconds)
+            self._observer(stage, seconds, exemplar)
 
     def snapshot(self) -> dict:
         return {s: ls.snapshot() for s, ls in self._stages.items()}

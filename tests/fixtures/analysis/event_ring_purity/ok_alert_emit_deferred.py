@@ -1,0 +1,30 @@
+"""Clean: alert transitions land in the ring as plain slot stores;
+paging (socket I/O under a lock) lives on the flusher, where a slow
+pager can stall nothing but itself."""
+
+import time
+
+
+class DeferredAlertRecorder:
+    def __init__(self, sock, lock, capacity=64):
+        self._sock = sock
+        self._lock = lock
+        self._slots = [None] * capacity
+        self._capacity = capacity
+        self._seq = 0
+
+    def record(self, kind, **fields):
+        seq = self._seq
+        self._slots[seq % self._capacity] = (
+            seq, time.perf_counter(), kind, fields
+        )
+        self._seq = seq + 1
+
+    def flush_alerts(self):
+        firing = [
+            e for e in list(self._slots)
+            if e is not None and e[2] == "alert_firing"
+        ]
+        with self._lock:
+            for event in sorted(firing):
+                self._sock.sendall(repr(event).encode())

@@ -1,0 +1,21 @@
+"""Seeded TP: the watchdog's transition recorder pages out-of-band
+from the hot append — a slow pager (or a contended lock) now stalls
+every evaluation tick that merely wanted to note a state change."""
+
+import time
+
+
+class AlertEmitRecorder:
+    def __init__(self, sock, lock):
+        self._sock = sock
+        self._lock = lock
+        self._events = []
+
+    def record(self, kind, **fields):
+        self._events.append((time.perf_counter(), kind, fields))
+        if kind == "alert_firing":
+            self._notify(kind, fields)
+
+    def _notify(self, kind, fields):
+        with self._lock:  # BAD
+            self._sock.sendall(repr((kind, fields)).encode())  # BAD

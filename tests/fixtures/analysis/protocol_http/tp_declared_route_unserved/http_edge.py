@@ -9,6 +9,7 @@ ROUTES = {  # BAD
     ("GET", "/jobs/{id}/results"): "job_results",
     ("GET", "/jobs/{id}/containers"): "job_containers",
     ("DELETE", "/jobs/{id}"): "job_cancel",
+    ("GET", "/metrics/history"): "metrics_history",
 }
 
 STATUS_TEXT = {

@@ -10,6 +10,7 @@ ROUTES = {  # BAD
     ("GET", "/jobs/{id}/containers"): "job_containers",
     ("DELETE", "/jobs/{id}"): "job_cancel",
     ("GET", "/metrics"): "prometheus",
+    ("GET", "/metrics/history"): "metrics_history",
     ("POST", "/v2/classify"): "content",
 }
 

@@ -10,6 +10,7 @@ ROUTES = {
     ("GET", "/jobs/{id}/containers"): "job_containers",
     ("DELETE", "/jobs/{id}"): "job_cancel",
     ("GET", "/metrics"): "prometheus",
+    ("GET", "/metrics/history"): "metrics_history",
 }
 
 STATUS_TEXT = {  # BAD

