@@ -1815,6 +1815,140 @@ def bench_fleet(n_requests: int = 1500) -> dict:
     return out
 
 
+def bench_tenant(n_requests: int = 1200) -> dict:
+    """Multi-tenant serving priced over stub workers: corpus-tag
+    routing overhead (requests/sec through a two-pool router with
+    tagged rows vs a plain single-pool router over the SAME worker
+    count), and the roll-isolation story — p99 of tenant B's traffic
+    while tenant A's pool rolls onto a new corpus mid-stream (the
+    tenancy contract says B never notices)."""
+    import os as _os
+    import tempfile
+    import threading
+
+    from licensee_tpu.fleet.router import Router
+    from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+    from licensee_tpu.tenancy import TenantPools
+
+    def stub_argv(name, sock):
+        pool = name.rstrip("0123456789")
+        return [
+            sys.executable, "-m", "licensee_tpu.fleet.faults",
+            "--socket", sock, "--name", name, "--service-ms", "1",
+            "--fingerprint", f"fp-{pool}-1",
+        ]
+
+    def patch_fp(argv, corpus):
+        argv = list(argv)
+        argv[argv.index("--fingerprint") + 1] = corpus
+        return argv
+
+    def measure(router, n, tags, senders=8):
+        errors = [0]
+        lats: list[float] = []
+        lock = threading.Lock()
+
+        def send(k: int) -> None:
+            for i in range(k):
+                tag = tags[i % len(tags)] if tags else None
+                msg = {"id": i, "content": f"blob {i}"}
+                if tag is not None:
+                    msg["corpus"] = tag
+                t0 = time.perf_counter()
+                row = router.dispatch(msg)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lats.append(dt)
+                    if row.get("error"):
+                        errors[0] += 1
+
+        per = n // senders
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=send, args=(per,), daemon=True)
+            for _ in range(senders)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        lats.sort()
+        p99 = lats[int(0.99 * (len(lats) - 1))] if lats else 0.0
+        return per * senders / dt, errors[0], p99 * 1000.0
+
+    out: dict = {"requests": n_requests}
+    tmpdir = tempfile.mkdtemp(prefix="licensee-tenant-bench-")
+
+    def sup_for(names) -> Supervisor:
+        return Supervisor(
+            {n: _os.path.join(tmpdir, f"{n}.sock") for n in names},
+            argv_for=stub_argv,
+            env_for=lambda name, chips: worker_env(None, None),
+            probe_interval_s=0.1, backoff_base_s=0.1, backoff_max_s=1.0,
+        )
+
+    # the baseline: the SAME two workers behind a pool-less router
+    with sup_for(("base0", "base1")) as supervisor:
+        if not supervisor.wait_healthy(30.0):
+            raise RuntimeError("tenant bench baseline never booted")
+        sockets = {
+            n: h.socket_path for n, h in supervisor.workers.items()
+        }
+        with Router(
+            sockets, supervisor=supervisor, probe_interval_s=0.1,
+            request_timeout_s=10.0, trace_sample=0.0,
+        ) as router:
+            rps, errors, _p99 = measure(router, n_requests, tags=())
+            out["single_pool_rps"] = round(rps, 1)
+            out["single_pool_errors"] = errors
+    # two pools x one worker: every row corpus-tagged, resolved by the
+    # router's route table to its pool
+    pools = TenantPools(
+        {"acme": sup_for(("acme0",)), "beta": sup_for(("beta0",))},
+        default_pool="acme",
+    )
+    with pools:
+        if not pools.wait_healthy(30.0):
+            raise RuntimeError("tenant bench pools never booted")
+        with Router(
+            pools.workers, supervisor=pools, probe_interval_s=0.1,
+            request_timeout_s=10.0, trace_sample=0.0,
+            pools=pools.worker_pools(), default_pool="acme",
+        ) as router:
+            router.set_corpus_route("acme", "acme")
+            router.set_corpus_route("beta", "beta")
+            rps, errors, _p99 = measure(
+                router, n_requests, tags=("acme", "beta")
+            )
+            out["two_pool_rps"] = round(rps, 1)
+            out["two_pool_errors"] = errors
+            single = out["single_pool_rps"]
+            out["routing_overhead_pct"] = (
+                round((1.0 - rps / single) * 100.0, 2) if single else None
+            )
+            # roll tenant A's pool MID-STREAM under tenant B's load:
+            # B's p99 over the whole window is the isolation number
+            roll: dict = {}
+
+            def roll_acme() -> None:
+                roll["result"] = pools.reload_fleet(
+                    "fp-acme-2", pool="acme", timeout_s=30.0,
+                    health_timeout_s=30.0, argv_patch=patch_fp,
+                )
+
+            roller = threading.Timer(0.1, roll_acme)
+            roller.start()
+            _rps, b_errors, b_p99 = measure(
+                router, n_requests, tags=("beta",)
+            )
+            roller.join(timeout=60.0)
+            out["reload_ok"] = bool((roll.get("result") or {}).get("ok"))
+            out["reload_p99_ms"] = round(b_p99, 3)
+            out["reload_errors"] = b_errors
+    return out
+
+
 # PR 4's measured closed-loop ceiling on this VM (CHANGES.md): every
 # attempt ran inline on its dispatch thread, so 16 senders x ~1ms stub
 # service topped out around 1.2k rps.  The saturation bench prices the
@@ -2303,8 +2437,9 @@ def bench_tsdb(n_requests: int = 6000) -> dict:
 # worst-case details dict) — and BENCH_r06.json now carries the same
 # headline as a FILE, so the stdout window is no longer load-bearing.
 # Re-pinned 1800 -> 1850 when the striped_* ingest keys joined (PR 15),
-# 1850 -> 1980 when the durable-jobs block joined (PR 16).
-HEADLINE_BYTE_BUDGET = 2080
+# 1850 -> 1980 when the durable-jobs block joined (PR 16),
+# 2080 -> 2200 when the multi-tenant block joined (PR 19).
+HEADLINE_BYTE_BUDGET = 2200
 
 # the driver-facing headline artifact, written UNCONDITIONALLY by
 # main() (fast mode included) so a skipped or truncated stdout capture
@@ -2410,6 +2545,14 @@ JOBS_HEADLINE_KEYS = (
 # members (joined in PR 18: the retained-telemetry plane's price tag)
 TSDB_HEADLINE_KEYS = ("ovh_pct", "ovh_ok", "q_p99_ms", "cap_ok")
 
+# the headline's multi-tenant block — fast mode stamps exactly this
+# set "skipped"; tests/test_bench_contract.py pins the members
+# (joined in PR 19: corpus-tag routing overhead + roll isolation)
+TENANT_HEADLINE_KEYS = (
+    "two_pool_rps", "single_pool_rps", "routing_overhead_pct",
+    "reload_p99_ms",
+)
+
 
 def make_headline(
     metric: str, value: float, vs_baseline: float, details: dict
@@ -2446,6 +2589,9 @@ def make_headline(
     jobs_row = details.get("jobs")
     jobs_skipped = jobs_row == "skipped"
     jobs = jobs_row if isinstance(jobs_row, dict) else {}
+    tenant_row = details.get("tenant")
+    tenant_skipped = tenant_row == "skipped"
+    tenant = tenant_row if isinstance(tenant_row, dict) else {}
     n_str = stripes.get("stripes")
     stripes_n_row = stripes.get(f"{n_str}_stripes") or {} if n_str else {}
     return {
@@ -2624,6 +2770,22 @@ def make_headline(
                     "identical_output": jobs.get("identical_output"),
                 }
             ),
+            # multi-tenant serving over stub pools: corpus-tag routing
+            # overhead vs a pool-less router, and tenant B's p99 while
+            # tenant A's pool rolls mid-stream (full row:
+            # details.tenant); fast mode stamps every key "skipped"
+            "tenant": (
+                {k: "skipped" for k in TENANT_HEADLINE_KEYS}
+                if tenant_skipped
+                else {
+                    "two_pool_rps": tenant.get("two_pool_rps"),
+                    "single_pool_rps": tenant.get("single_pool_rps"),
+                    "routing_overhead_pct": tenant.get(
+                        "routing_overhead_pct"
+                    ),
+                    "reload_p99_ms": tenant.get("reload_p99_ms"),
+                }
+            ),
             "details_file": "BENCH_DETAILS.json",
         },
     }
@@ -2786,6 +2948,10 @@ def main() -> None:
     if fast and tsdb_row is None:
         # same contract: the telemetry-store suite was NOT RUN
         tsdb_row = "skipped"
+    tenant_row = run_slow("tenant", bench_tenant)
+    if fast and tenant_row is None:
+        # same contract: the multi-tenant suite was NOT RUN
+        tenant_row = "skipped"
     reference_fallback = run_slow(
         "reference_fallback", bench_reference_fallback
     )
@@ -2830,6 +2996,7 @@ def main() -> None:
         "ingest": ingest,
         "jobs": jobs_row,
         "tsdb": tsdb_row,
+        "tenant": tenant_row,
         "reference_fallback": reference_fallback,
         "tp_width": tp_width,
         "scalar_agreement": agreement,

@@ -22,8 +22,13 @@ from __future__ import annotations
 # request ops -> the request fields each may carry.  "content" is the
 # op-less classification row.
 REQUEST_OPS: dict[str, tuple[str, ...]] = {
+    # "corpus" is the ROUTER-facing tenancy tag (tenant name, pool
+    # name, or fingerprint): the fleet router resolves it to a worker
+    # pool and strips nothing — workers ignore it, and the response
+    # row's "corpus" field (the serving fingerprint) closes the loop
     "content": (
         "content", "content_b64", "id", "filename", "deadline_ms", "trace",
+        "corpus",
     ),
     "stats": ("id", "format"),
     "trace": ("id", "n"),
@@ -41,7 +46,9 @@ REQUEST_OPS: dict[str, tuple[str, ...]] = {
     ),
     # the anomaly watchdog's alert ledger: FRONT-socket only, no args
     "alerts": ("id",),
-    "reload": ("id", "corpus"),
+    # "pool" narrows a front-door reload to one tenant pool (tenancy
+    # topologies only; a plain fleet treats its absence as "the fleet")
+    "reload": ("id", "corpus", "pool"),
     # normalized blob vs closest (or named) template, rendered as an
     # inline word diff (serve/diffverb.py) — same content body as the
     # op-less classification row plus the optional comparison target.
@@ -81,6 +88,19 @@ ERROR_CODES: tuple[str, ...] = (
     # (distinct from bad_request: the query was well-formed, the data
     # is absent — HTTP maps it to 404, not 400)
     "unknown_series",
+    # -- the tenancy tier (fleet/router.py + fleet/http_edge.py) --
+    # a content row's "corpus" routing tag names no pool the router
+    # serves (typo'd tenant name, rolled-away fingerprint)
+    "unknown_corpus",
+    # POST /corpus from an authenticated client bound to no registry
+    # tenant — HTTP maps it to 403 (the token may still /classify)
+    "unknown_tenant",
+    # the uploaded artifact failed the validation gate (unreadable,
+    # wrong format, or its payload no longer hashes to its manifest)
+    "corpus_invalid",
+    # the edge serves no tenant registry (fleet started without
+    # --tenants); POST /corpus answers 503
+    "tenancy_disabled",
 )
 
 # response-row fields a client may read; every one must have at least
@@ -145,15 +165,18 @@ HTTP_ROUTES: dict[tuple[str, str], str] = {
     ("GET", "/jobs/{id}/results"): "job_results",
     ("GET", "/jobs/{id}/containers"): "job_containers",
     ("DELETE", "/jobs/{id}"): "job_cancel",
+    ("POST", "/corpus"): "corpus_upload",
 }
 
 # every status code the edge may mint.  The backpressure contract maps
 # here: queue_full -> 429 (+ Retry-After), router shutdown / a fleet
 # with no dispatchable backend -> 503.  The jobs tier adds 202 (a
 # submit/cancel accepted for async execution) and 409 (results asked
-# of a job that has not completed).
+# of a job that has not completed).  The tenancy tier adds 403 (an
+# authenticated token bound to no tenant asked to onboard a corpus)
+# and reuses 409 for a roll already in flight.
 HTTP_STATUS_CODES: tuple[int, ...] = (
-    200, 202, 400, 401, 404, 405, 409, 413, 429, 500, 503,
+    200, 202, 400, 401, 403, 404, 405, 409, 413, 429, 500, 503,
 )
 
 # role detection, by path basename: the real worker transport, the

@@ -1190,12 +1190,13 @@ def stats_table_rows(
     is honest from the FIRST frame); a None value there means the
     store is reachable but has no window yet — render "-", never a
     fabricated 0.0."""
-    header = ["WORKER", "UP_S", "DONE", "Q", "INFL", "CACHE%",
+    header = ["WORKER", "UP_S", "DONE", "Q", "INFL", "CACHE%", "CORPUS",
               "P50_MS", "P99_MS", "REQ_S"]
     rows = [header]
     for label, snap in snaps.items():
         if not snap:
-            rows.append([label, "-", "-", "-", "-", "-", "-", "-", "down"])
+            rows.append([label, "-", "-", "-", "-", "-", "-", "-", "-",
+                         "down"])
             continue
         sched = snap.get("scheduler") or {}
         cache = snap.get("cache") or {}
@@ -1221,6 +1222,10 @@ def stats_table_rows(
         def cell(value, fmt="{}"):
             return "-" if value is None else fmt.format(value)
 
+        # the serving fingerprint, short form — in a multi-tenant
+        # fleet this is the column that shows which corpus each pool's
+        # workers are actually on (and a roll sweeping through them)
+        corpus_fp = (snap.get("corpus") or {}).get("fingerprint")
         rows.append([
             label,
             cell(snap.get("uptime_s"), "{:.0f}"),
@@ -1228,6 +1233,7 @@ def stats_table_rows(
             cell(sched.get("queue_depth")),
             cell(sched.get("in_flight")),
             "-" if hit_rate is None else f"{hit_rate * 100:.1f}",
+            corpus_fp[:12] if isinstance(corpus_fp, str) else "-",
             cell(total.get("p50_ms")),
             cell(total.get("p99_ms")),
             rate,
@@ -1684,6 +1690,41 @@ def _top_frame(sock: str, timeout: float, window: float) -> list[str]:
         lines.extend(out.getvalue().splitlines())
     else:
         lines.append("(no stored per-worker series yet)")
+    # -- per-pool rollup, when a multi-tenant router publishes the
+    # pool-labeled series (a single-pool fleet never registers them,
+    # so this section simply does not render there) --
+    pool_rps = _top_query(
+        sock,
+        {"series": "fleet_tenant_requests_total", "fn": "rate",
+         "window": window, "labels": {"event": "ok"}, "by": "pool"},
+        timeout,
+    )
+    pool_p99 = _top_query(
+        sock,
+        {"series": "fleet_tenant_request_seconds", "fn": "quantile",
+         "q": 0.99, "window": window, "by": "pool"},
+        timeout,
+    )
+    pool_names = sorted(
+        set((pool_rps or {}).get("groups") or {})
+        | set((pool_p99 or {}).get("groups") or {})
+    )
+    if pool_names:
+        pool_rows = [["POOL", "REQ_S", "P99_MS"]]
+        for name in pool_names:
+            rate_row = ((pool_rps or {}).get("groups") or {}).get(name) or {}
+            p99_row = ((pool_p99 or {}).get("groups") or {}).get(name) or {}
+            rate = rate_row.get("value")
+            q_value = p99_row.get("value")
+            pool_rows.append([
+                name or "(unlabeled)",
+                "-" if rate is None else f"{rate:.1f}",
+                "-" if q_value is None else f"{q_value * 1000:.1f}",
+            ])
+        lines.append("")
+        out = io.StringIO()
+        _render_table(pool_rows, out)
+        lines.extend(out.getvalue().splitlines())
     # -- SLO burn --
     objectives = (stats.get("slo") or {}).get("objectives") or {}
     if objectives:
@@ -1953,6 +1994,10 @@ def cmd_fleet(args) -> int:
         from licensee_tpu.jobs.selftest import selftest_jobs
 
         return selftest_jobs(stub=args.stub)
+    if args.selftest_tenant:
+        from licensee_tpu.fleet.selftest import selftest_tenant
+
+        return selftest_tenant(stub=args.stub)
     if args.jobs_dir and not args.http:
         print(
             "error: --jobs-dir needs --http (jobs are submitted over "
@@ -1978,12 +2023,21 @@ def cmd_fleet(args) -> int:
                 file=sys.stderr,
             )
             return 1
+    if args.tenants and args.federate:
+        print(
+            "error: --tenants supervises local worker pools and cannot "
+            "combine with --federate (put the registry on each member "
+            "fleet instead)",
+            file=sys.stderr,
+        )
+        return 1
     import tempfile
 
     from licensee_tpu.fleet.router import FrontServer, Router
     from licensee_tpu.fleet.supervisor import Supervisor
 
     supervisor = None
+    registry = onboarder = None
     if args.federate:
         # the cross-host FRONT tier: every backend is another fleet's
         # front door (usually host:port); no local workers to spawn
@@ -2013,14 +2067,9 @@ def cmd_fleet(args) -> int:
             prefix="licensee-fleet-"
         )
         os.makedirs(socket_dir, exist_ok=True)
-        workers = {
-            f"w{i}": os.path.join(socket_dir, f"w{i}.sock")
-            for i in range(args.workers)
-        }
         serve_args: list[str] = []
         for flag, value in (
             ("--mode", args.mode),
-            ("--corpus", args.corpus),
             ("--method", args.method),
             ("--max-batch", args.max_batch),
             ("--max-delay-ms", args.max_delay_ms),
@@ -2031,25 +2080,101 @@ def cmd_fleet(args) -> int:
         ):
             if value is not None:
                 serve_args += [flag, str(value)]
-        supervisor = Supervisor(
-            workers,
-            chips_per_worker=args.chips_per_worker,
-            serve_args=tuple(serve_args),
-            backoff_base_s=args.restart_backoff_ms / 1000.0,
-            probe_interval_s=args.probe_interval_ms / 1000.0,
-        )
-        router = Router(
-            workers,
-            supervisor=supervisor,
-            hedge_ms=None if hedge_ms == "off" else hedge_ms,
-            probe_interval_s=args.probe_interval_ms / 1000.0,
-            pool_per_worker=args.pool_per_worker,
-        )
-        print(
-            f"fleet: {args.workers} workers under {socket_dir}, "
-            f"front door {args.socket or args.http}",
-            file=sys.stderr,
-        )
+        if args.tenants:
+            # the multi-tenant topology: one supervisor per pool (each
+            # with its own probe thread, restart backoff, and reload
+            # lock), corpus per pool from the registry, the whole set
+            # behind one router that routes by resolved corpus tag
+            from licensee_tpu.tenancy import (
+                CorpusOnboarder, RegistryError, TenantPools, TenantRegistry,
+            )
+
+            try:
+                registry = TenantRegistry(args.tenants)
+            except RegistryError as exc:
+                print(f"error: {exc}", file=sys.stderr)
+                return 1
+            pool_tenants = registry.pools()
+            if not pool_tenants:
+                print(
+                    f"error: {args.tenants!r} defines no tenants",
+                    file=sys.stderr,
+                )
+                registry.close()
+                return 1
+            tenants = registry.tenants()
+            pool_sups = {}
+            for pool, names in pool_tenants.items():
+                pool_workers = {
+                    f"{pool}{i}": os.path.join(
+                        socket_dir, f"{pool}{i}.sock"
+                    )
+                    for i in range(args.workers)
+                }
+                # co-tenants of one pool share its corpus by
+                # definition; the first (sorted) tenant's binding is it
+                corpus = tenants[names[0]].corpus
+                pool_sups[pool] = Supervisor(
+                    pool_workers,
+                    chips_per_worker=args.chips_per_worker,
+                    serve_args=tuple(serve_args + ["--corpus", corpus]),
+                    backoff_base_s=args.restart_backoff_ms / 1000.0,
+                    probe_interval_s=args.probe_interval_ms / 1000.0,
+                )
+            supervisor = pools = TenantPools(
+                pool_sups, default_pool=registry.default_pool
+            )
+            router = Router(
+                pools.workers,
+                supervisor=pools,
+                hedge_ms=None if hedge_ms == "off" else hedge_ms,
+                probe_interval_s=args.probe_interval_ms / 1000.0,
+                pool_per_worker=args.pool_per_worker,
+                pools=pools.worker_pools(),
+                default_pool=pools.default_pool,
+            )
+            onboarder = CorpusOnboarder(
+                registry, pools, router,
+                staging_dir=os.path.join(socket_dir, "staging"),
+                reload_kwargs={
+                    "timeout_s": 120.0,
+                    "health_timeout_s": args.boot_timeout,
+                },
+            )
+            onboarder.sync_routes()
+            print(
+                f"fleet: {len(pool_sups)} tenant pool(s) "
+                f"({', '.join(sorted(pool_sups))}) x {args.workers} "
+                f"worker(s) under {socket_dir}, front door "
+                f"{args.socket or args.http}",
+                file=sys.stderr,
+            )
+        else:
+            workers = {
+                f"w{i}": os.path.join(socket_dir, f"w{i}.sock")
+                for i in range(args.workers)
+            }
+            if args.corpus is not None:
+                serve_args += ["--corpus", str(args.corpus)]
+            supervisor = Supervisor(
+                workers,
+                chips_per_worker=args.chips_per_worker,
+                serve_args=tuple(serve_args),
+                backoff_base_s=args.restart_backoff_ms / 1000.0,
+                probe_interval_s=args.probe_interval_ms / 1000.0,
+            )
+            router = Router(
+                workers,
+                supervisor=supervisor,
+                hedge_ms=None if hedge_ms == "off" else hedge_ms,
+                probe_interval_s=args.probe_interval_ms / 1000.0,
+                pool_per_worker=args.pool_per_worker,
+            )
+            print(
+                f"fleet: {args.workers} workers under {socket_dir}, "
+                f"front door {args.socket or args.http}",
+                file=sys.stderr,
+            )
     from licensee_tpu.serve.server import SocketInUseError
 
     if supervisor is not None:
@@ -2060,8 +2185,16 @@ def cmd_fleet(args) -> int:
                 file=sys.stderr,
             )
             supervisor.stop()
+            if registry is not None:
+                registry.close()
             return 1
     router.start()
+    if onboarder is not None:
+        # replay rolls a crash interrupted: a journaled roll_start with
+        # no terminal record re-validates and re-rolls at boot
+        for row in onboarder.recover():
+            print(f"fleet: recovered roll {json.dumps(row)}",
+                  file=sys.stderr)
     executor = None
     if args.jobs_dir:
         # the durable jobs tier: journal-backed executor sharing the
@@ -2086,8 +2219,13 @@ def cmd_fleet(args) -> int:
             file=sys.stderr,
         )
     edge_tokens = None
+    if registry is not None:
+        # the registry's bearer tokens authenticate the edge, and the
+        # edge's client label IS the tenant name — that identity is
+        # what POST /corpus and per-tenant routing key off
+        edge_tokens = dict(registry.tokens())
     if args.edge_token:
-        edge_tokens = {}
+        edge_tokens = edge_tokens if edge_tokens is not None else {}
         for spec in args.edge_token:
             name, sep, tok = spec.partition("=")
             if sep and name and tok:
@@ -2107,6 +2245,7 @@ def cmd_fleet(args) -> int:
                 rate_per_client=args.edge_rate,
                 burst=args.edge_burst,
                 jobs=executor,
+                tenancy=onboarder,
             )
             print(
                 f"fleet: HTTP edge on {args.http}"
@@ -2123,6 +2262,8 @@ def cmd_fleet(args) -> int:
         router.close()
         if supervisor is not None:
             supervisor.stop()
+        if registry is not None:
+            registry.close()
         return 1
     # long-lived serving process: the boot-time heap (imports, corpus,
     # supervisor state) never becomes garbage, but untuned gen2 GC
@@ -2181,6 +2322,8 @@ def cmd_fleet(args) -> int:
         router.close()
         if supervisor is not None:
             supervisor.stop()
+        if registry is not None:
+            registry.close()
         if args.stats:
             print(json.dumps(router.stats()), file=sys.stderr)
     return 0
@@ -3024,6 +3167,30 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     fleet.add_argument(
+        "--selftest-tenant", action="store_true",
+        help=(
+            "Run the multi-tenant serving selftest: two tenants with "
+            "disjoint corpora on separate worker pools behind one "
+            "router and HTTP edge — tagged corpus routing, an "
+            "authenticated POST /corpus upload+roll of tenant A under "
+            "tenant B's live traffic (B's latency SLO must hold), "
+            "SIGKILL failover confined to one pool, 401/403/400 auth "
+            "probes, and journal crash recovery, with ZERO cross-"
+            "tenant rows; exit 0/1"
+        ),
+    )
+    fleet.add_argument(
+        "--tenants", default=None, metavar="FILE",
+        help=(
+            "Serve multi-tenant: FILE is the tenant registry JSON "
+            "(token -> corpus -> pool); the fleet boots one worker "
+            "pool per registry pool (--workers workers EACH, on that "
+            "pool's corpus), routes requests by corpus tag / bearer "
+            "token, and serves self-serve corpus onboarding on "
+            "POST /corpus (needs --http for the authenticated edge)"
+        ),
+    )
+    fleet.add_argument(
         "--jobs-dir", default=None, metavar="DIR",
         help=(
             "Serve the durable jobs tier (POST /jobs on the HTTP "
@@ -3046,9 +3213,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--stub", action="store_true",
         help=(
             "With --selftest/--selftest-reload/--selftest-tcp/"
-            "--selftest-jobs: use protocol-faithful stub workers "
-            "(no device path) — seconds instead of a JAX boot per "
-            "worker"
+            "--selftest-jobs/--selftest-tenant: use protocol-faithful "
+            "stub workers (no device path) — seconds instead of a JAX "
+            "boot per worker"
         ),
     )
     fleet.set_defaults(func=cmd_fleet)

@@ -249,6 +249,68 @@ def load_artifact(path: str) -> tuple[CompiledCorpus, dict]:
     return corpus, manifest
 
 
+def check_corpus_source(source: str) -> str | None:
+    """Cheap fail-closed check that SOURCE names a loadable corpus,
+    WITHOUT compiling or loading it (submit-time validation for job
+    specs and tenant bindings — milliseconds, not the seconds
+    :func:`resolve_corpus` spends compiling).
+
+    Returns the artifact's fingerprint when the source is a bundle
+    file (its manifest carries one), else None.  Raises
+    :class:`ArtifactError` for anything resolve_corpus would later
+    refuse: an unknown source string, a file that is not a corpus
+    artifact, or a bundle with the wrong format/version."""
+    if not isinstance(source, str) or not source:
+        raise ArtifactError("corpus source must be a non-empty string")
+    if source in ("vendored", "spdx"):
+        return None
+    if os.path.isdir(source):
+        return None  # an SPDX src/ checkout compiles at load time
+    if not os.path.isfile(source):
+        raise ArtifactError(
+            f"cannot load corpus {source!r}: not 'vendored', 'spdx', an "
+            "SPDX src/ directory, or a corpus artifact file"
+        )
+    import zipfile
+    import zlib
+
+    # peek ONLY the manifest array — the bit matrix stays on disk
+    try:
+        with np.load(source, allow_pickle=False) as npz:
+            if "meta" not in npz.files:
+                raise ArtifactError(
+                    f"{source!r}: not a corpus artifact (no manifest)"
+                )
+            meta_bytes = bytes(npz["meta"])
+    except (
+        OSError, ValueError, KeyError, EOFError,
+        zipfile.BadZipFile, zlib.error,
+    ) as exc:
+        raise ArtifactError(
+            f"cannot read artifact {source!r}: {exc}"
+        ) from exc
+    try:
+        meta = json.loads(meta_bytes.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ArtifactError(f"{source!r}: bad manifest: {exc}") from exc
+    manifest = meta.get("manifest") or {}
+    if manifest.get("format") != FORMAT:
+        raise ArtifactError(
+            f"{source!r}: format {manifest.get('format')!r} is not "
+            f"{FORMAT!r}"
+        )
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise ArtifactError(
+            f"{source!r}: format_version "
+            f"{manifest.get('format_version')!r} unsupported (this "
+            f"build reads v{FORMAT_VERSION})"
+        )
+    fp = manifest.get("fingerprint")
+    if not isinstance(fp, str) or not fp:
+        raise ArtifactError(f"{source!r}: manifest has no fingerprint")
+    return fp
+
+
 def resolve_corpus(source: str) -> tuple[CompiledCorpus, str, dict | None]:
     """Resolve a corpus SOURCE string to (corpus, fingerprint, manifest).
 

@@ -84,6 +84,7 @@ ROUTES: dict[tuple[str, str], str] = {
     ("GET", "/jobs/{id}/results"): "job_results",
     ("GET", "/jobs/{id}/containers"): "job_containers",
     ("DELETE", "/jobs/{id}"): "job_cancel",
+    ("POST", "/corpus"): "corpus_upload",
 }
 
 # every status the edge may mint; _respond looks codes up here, so an
@@ -94,6 +95,7 @@ STATUS_TEXT: dict[int, str] = {
     202: "Accepted",
     400: "Bad Request",
     401: "Unauthorized",
+    403: "Forbidden",
     404: "Not Found",
     405: "Method Not Allowed",
     409: "Conflict",
@@ -114,6 +116,17 @@ _JOB_NOT_FOUND = {"error": "job_not_found: no such job id"}
 _JOB_NOT_DONE = {
     "error": "job_not_done: the job has not completed; poll its "
              "status first",
+}
+
+# the tenancy tier's error vocabulary, same contract: module-level
+# dict literals so every mint site is checker-visible
+_TENANCY_DISABLED = {
+    "error": "tenancy_disabled: this edge serves no tenant registry "
+             "(start the fleet with --tenants)",
+}
+_UNKNOWN_TENANT = {
+    "error": "unknown_tenant: this client is bound to no tenant "
+             "(corpus onboarding needs a registry-listed bearer token)",
 }
 
 # error-code prefixes (the JSONL "error" field) -> HTTP status classes;
@@ -188,15 +201,18 @@ class _EdgeRequest:
     line), the client identity it queues under, and its fair-queuing
     cost in body bytes."""
 
-    __slots__ = ("session", "slot", "line", "client", "cost")
+    __slots__ = ("session", "slot", "line", "client", "cost", "pool")
 
     def __init__(self, session: "_EdgeSession", slot: dict, line: str,
-                 client: str):
+                 client: str, pool: str | None = None):
         self.session = session
         self.slot = slot
         self.line = line
         self.client = client
         self.cost = max(1, len(line))
+        # the client's tenant pool (bearer-token binding): rides into
+        # router._submit so dispatch/failover stay inside the pool
+        self.pool = pool
 
 
 class _EdgeSession:
@@ -316,12 +332,13 @@ class _EdgeSession:
         slot["method"] = self.method
         slot["path"] = self.path
         slot["keep_alive"] = self.keep_alive
-        # job submissions may carry an uploaded archive: they get the
-        # jobs body budget, every other route keeps the wire-row one
+        # job submissions and corpus uploads carry whole artifacts:
+        # they get the fat body budget, every other route keeps the
+        # wire-row one
         limit = (
             self.server.max_job_body_bytes
             if (self.method, self.path.partition("?")[0])
-            == ("POST", "/jobs")
+            in (("POST", "/jobs"), ("POST", "/corpus"))
             else self.server.max_body_bytes
         )
         if length > limit:
@@ -418,7 +435,23 @@ class _EdgeSession:
                 return ("error", 503,
                         json.dumps(_JOBS_DISABLED).encode("utf-8"))
             return ("jobs", client, route, job_id)
-        return ("dispatch", client)
+        if route == "corpus_upload":
+            if server.tenancy is None:
+                return ("error", 503,
+                        json.dumps(_TENANCY_DISABLED).encode("utf-8"))
+            if server.tenancy.tenant_for(client) is None:
+                # authenticated (or auth-less peer-named) but bound to
+                # no tenant: 403, not 401 — the token may be perfectly
+                # valid for /classify yet own no corpus
+                server.count_throttle("auth")
+                return ("error", 403,
+                        json.dumps(_UNKNOWN_TENANT).encode("utf-8"))
+            return ("corpus", client)
+        pool = (
+            server.tenancy.pool_for_client(client)
+            if server.tenancy is not None else None
+        )
+        return ("dispatch", client, pool)
 
     def _finish_request(self, slot: dict, body: bytes) -> None:
         verdict = slot.pop("verdict")
@@ -440,6 +473,9 @@ class _EdgeSession:
         if kind == "jobs":
             self._defer_job(slot, verdict[2], verdict[3], body)
             return
+        if kind == "corpus":
+            self._defer_corpus(slot, verdict[1], body)
+            return
         line = body.decode("utf-8", errors="replace").strip()
         if not line or "\n" in line:
             # an empty body is not a content row; an embedded newline
@@ -451,7 +487,8 @@ class _EdgeSession:
             )
             return
         self.server.enqueue(
-            _EdgeRequest(self, slot, line, verdict[1] or self.peer)
+            _EdgeRequest(self, slot, line, verdict[1] or self.peer,
+                         pool=verdict[2] if len(verdict) > 2 else None)
         )
 
     def _finish_health(self, slot: dict) -> None:
@@ -560,6 +597,28 @@ class _EdgeSession:
                 self._respond(
                     slot, code, payload, extra_headers=extra, ctype=ctype
                 )
+
+            loop.call_soon_threadsafe(fill)
+
+        server.router._ops.submit(run)
+
+    def _defer_corpus(self, slot: dict, client: str | None,
+                      body: bytes) -> None:
+        """POST /corpus: the whole onboarding pipeline (stage the
+        artifact, run the validation gate, roll the tenant's pool)
+        blocks for seconds — ops executor, never the loop."""
+        server = self.server
+        loop = server.router.loop
+
+        def run() -> None:
+            try:
+                resp = _corpus_upload(server, client, body)
+            except Exception as exc:  # noqa: BLE001 — session containment
+                resp = (500, _err_body("internal_error", str(exc)[:200]))
+
+            def fill() -> None:
+                code, payload = resp
+                self._respond(slot, code, payload)
 
             loop.call_soon_threadsafe(fill)
 
@@ -752,6 +811,17 @@ def _job_submit(server: "HttpEdgeServer", body: bytes) -> tuple:
     spec, problem = validate_spec(row)
     if spec is None:
         return _bad_spec(problem)
+    corpus_opt = (spec.get("options") or {}).get("corpus")
+    if corpus_opt is not None:
+        # fail the bad corpus source at submit time (400), not hours
+        # later when a stripe crashes on it
+        from licensee_tpu.corpus.artifact import (
+            ArtifactError, check_corpus_source,
+        )
+        try:
+            check_corpus_source(corpus_opt)
+        except (ArtifactError, OSError) as exc:
+            return _bad_spec(f"corpus: {exc}")
     trace_in = row.get("trace")
     tracer = server.router.obs.tracer
     trace = tracer.start(
@@ -847,6 +917,55 @@ def _job_response(server: "HttpEdgeServer", route: str,
     return (200, payload, extra, "application/jsonl")
 
 
+def _corpus_upload(server: "HttpEdgeServer", client: str | None,
+                   body: bytes) -> tuple:
+    """POST /corpus on an ops thread -> (code, payload).  The verdict
+    already proved the client maps to a tenant; here the artifact
+    bytes decode, the onboarding pipeline runs (stage -> validate ->
+    journal -> roll -> persist), and OnboardError codes map onto HTTP
+    statuses: invalid artifacts 400, a roll already in flight 409
+    (retryable), a failed roll 500."""
+    import base64
+    import binascii
+
+    from licensee_tpu.tenancy import OnboardError
+
+    try:
+        row = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        return (400, _err_body("bad_request",
+                               "body must be a JSON corpus upload"))
+    if not isinstance(row, dict):
+        return (400, _err_body("bad_request",
+                               "corpus upload must be a JSON object"))
+    artifact_b64 = row.get("artifact_b64")
+    if not isinstance(artifact_b64, str) or not artifact_b64:
+        return (400, _err_body(
+            "bad_request", "artifact_b64 must be a base64 string"
+        ))
+    try:
+        blob = base64.b64decode(artifact_b64, validate=True)
+    except (binascii.Error, ValueError):
+        return (400, _err_body("bad_request",
+                               "artifact_b64 does not decode"))
+    name = row.get("name")
+    if name is not None and not isinstance(name, str):
+        return (400, _err_body("bad_request", "name must be a string"))
+    try:
+        result = server.tenancy.upload(client, blob, name)
+    except OnboardError as exc:
+        if exc.code == "unknown_tenant":
+            return (403, json.dumps(_UNKNOWN_TENANT).encode("utf-8"))
+        if exc.code == "corpus_invalid":
+            return (400, json.dumps(
+                {"error": f"corpus_invalid: {exc.detail}"}
+            ).encode("utf-8"))
+        if exc.code == "fleet_reload_in_progress":
+            return (409, _err_body(exc.code, exc.detail[:200]))
+        return (500, _err_body("internal_error", str(exc)[:200]))
+    return (200, json.dumps({"corpus": result}).encode("utf-8"))
+
+
 def _echo_headers(text: str) -> list[tuple[str, str]]:
     out = []
     trace = _field_from_line(text, "trace")
@@ -887,11 +1006,16 @@ class HttpEdgeServer(LoopJsonlServer):
         max_job_body_bytes: int = 32 << 20,
         stall_timeout_s: float = 30.0,
         jobs=None,
+        tenancy=None,
     ):
         self.router = router
         # the jobs tier (licensee_tpu.jobs.JobExecutor), or None: the
         # /jobs routes then answer 503 jobs_disabled
         self.jobs = jobs
+        # the tenancy tier (licensee_tpu.tenancy.CorpusOnboarder), or
+        # None: POST /corpus answers 503 tenancy_disabled and content
+        # dispatch carries no client-derived pool
+        self.tenancy = tenancy
         router.loop.start()  # idempotent; the loop must carry accepts
         super().__init__(
             target, loop=router.loop, stall_timeout_s=stall_timeout_s
@@ -1044,7 +1168,7 @@ class HttpEdgeServer(LoopJsonlServer):
                 session.fill_content(item.slot, row, text)
             self._pump()
 
-        self.router._submit(None, item.line, on_done)
+        self.router._submit(None, item.line, on_done, pool=item.pool)
 
     # -- connections --
 

@@ -68,7 +68,12 @@ from concurrent.futures import ThreadPoolExecutor
 
 import re
 
-from licensee_tpu.fleet.wire import ConnectionPool, WireError, oneshot
+from licensee_tpu.fleet.wire import (
+    ConnectionPool,
+    WireError,
+    json_str_field,
+    oneshot,
+)
 from licensee_tpu.obs import (
     AnomalyWatchdog,
     FlatlineRule,
@@ -81,6 +86,7 @@ from licensee_tpu.obs import (
     TraceCollector,
     TsdbStore,
     merge_expositions,
+    pool_objectives,
     router_objectives,
 )
 from licensee_tpu.serve.eventloop import (
@@ -118,6 +124,16 @@ _FEDERATED_FAILOVER_CODES = frozenset(
 _TRACE_ID_RE = re.compile(r"\A[0-9a-f]{16}\Z")
 
 
+def _fp_compatible(got: str, want: str) -> bool:
+    """Fingerprint identity across stamp conventions: real workers
+    stamp the SHORT (12-hex) fingerprint on response rows while the
+    route table may hold the full 64-hex form (and stub workers stamp
+    whatever ``--fingerprint`` string they were booted with) — a
+    prefix match in either direction is the same corpus, anything else
+    is a worker on the wrong fingerprint."""
+    return got == want or got.startswith(want) or want.startswith(got)
+
+
 class _Attempt:
     """One request sent to one backend connection: the FIFO entry that
     a response line (or a connection death, or a timeout) resolves.
@@ -150,11 +166,13 @@ class _Request:
     __slots__ = ("msg", "wire_line", "trace", "wire_trace",
                  "tried", "queue_full_rows", "arms", "t0", "deadline",
                  "hedge_timer", "hedge_started", "first_round",
-                 "finished", "last_reason", "on_done", "repick_timer")
+                 "finished", "last_reason", "on_done", "repick_timer",
+                 "pool")
 
     def __init__(self, msg: dict | None, wire_line: str, trace,
-                 wire_trace, on_done):
+                 wire_trace, on_done, pool=None):
         self.msg = msg
+        self.pool = pool
         self.wire_line = wire_line
         self.trace = trace
         self.wire_trace = wire_trace
@@ -357,6 +375,7 @@ class Backend:
     def __init__(self, name: str, socket_path: str):
         self.name = name
         self.socket_path = socket_path
+        self.pool: str | None = None  # tenant pool (multi-pool fleets)
         self.conns: list[_BackendConn] = []
         self.healthy = False
         self.probed_load = 0
@@ -428,7 +447,7 @@ class Backend:
             abort()
 
     def as_dict(self) -> dict:
-        return {
+        row = {
             "socket": self.socket_path,
             "healthy": self.healthy,
             "probed_load": self.probed_load,
@@ -440,6 +459,9 @@ class Backend:
             "pool_conns": len(self.conns),
             "pool_inflight": self.pool_inflight(),
         }
+        if self.pool is not None:
+            row["pool"] = self.pool
+        return row
 
 
 class Router:
@@ -476,6 +498,8 @@ class Router:
         scrape_interval_s: float = 5.0,
         store: "TsdbStore | None" = None,
         watchdog_rules=None,
+        pools: dict[str, str] | None = None,
+        default_pool: str = "default",
     ):
         if not backends:
             raise ValueError("need at least one backend")
@@ -511,6 +535,39 @@ class Router:
             name: Backend(name, path)
             for name, path in backends.items()
         }
+        # the tenancy plane: ``pools`` maps worker name -> pool name
+        # (heterogeneous fleets serving different corpora side by
+        # side); dispatch, failover, and hedging are then confined to
+        # the request's pool.  ``_corpus_routes`` maps a request's
+        # corpus tag (tenant name, pool name, full or short
+        # fingerprint) to its pool; ``_pool_fps`` holds each pool's
+        # expected fingerprint for response verification.  Both tables
+        # are plain dicts written by ops threads (onboarding rolls)
+        # and read per-request on the loop — GIL-atomic replace-only
+        # updates, same discipline as the loop-owned counters.
+        self.default_pool = str(default_pool)
+        self.pools_active = bool(pools)
+        self._corpus_routes: dict[str, str] = {}
+        self._pool_fps: dict[str, str] = {}
+        self._pool_counts: dict[tuple[str, str], int] = {}
+        if pools:
+            unknown = sorted(set(pools) - set(self.backends))
+            if unknown:
+                raise ValueError(
+                    f"pools names unknown workers: {unknown}"
+                )
+            for name, backend in self.backends.items():
+                backend.pool = pools.get(name, self.default_pool)
+            if not any(
+                b.pool == self.default_pool
+                for b in self.backends.values()
+            ):
+                raise ValueError(
+                    f"default pool {self.default_pool!r} has no "
+                    "workers (untagged traffic would never dispatch)"
+                )
+            for pool in set(pools.values()):
+                self._corpus_routes.setdefault(pool, pool)
         self.loop = EventLoop(name="fleet-router")
         self._latency = LatencyStats(capacity=1024)
         self._hedge_p95_cache: tuple[float, float] | None = None
@@ -526,6 +583,7 @@ class Router:
             "queue_full_failovers": 0,
             "queue_full_returned": 0,
             "no_backend": 0,
+            "unknown_corpus": 0,
         }
         self._active = 0
         self._admission: deque = deque()
@@ -608,8 +666,16 @@ class Router:
         # windows read the telemetry store (the router's own series
         # land there labeled merge_label="router"); the private sample
         # ring stays as the fallback until the store has coverage.
+        objectives = router_objectives()
+        if self.pools_active:
+            # one latency objective per tenant pool over the
+            # pool-labeled histogram: B's burn gauge witnesses that
+            # rolling A's pool never touched B's tail
+            objectives += pool_objectives(
+                {b.pool for b in self.backends.values()}
+            )
         self.slo = SLOEngine(
-            self.obs.registry, router_objectives(),
+            self.obs.registry, objectives,
             store=self.store,
             store_labels={self.merge_label: "router"},
         ).attach()
@@ -660,7 +726,8 @@ class Router:
             "fleet_requests_total",
             "Router lifecycle events by kind (requests, ok, failovers, "
             "retries, hedges_started, hedges_won, hedges_lost, "
-            "queue_full_failovers, queue_full_returned, no_backend)",
+            "queue_full_failovers, queue_full_returned, no_backend, "
+            "unknown_corpus)",
             labels=("event",),
         )
         # labeled "backend", not "worker": the fleet scrape merges this
@@ -691,6 +758,32 @@ class Router:
         # labels() -> dict lookup per call, which is measurable at
         # per-request rates on the loop thread
         self._latency_hist = hist.labels()
+        # the tenancy plane's metrics exist only on multi-pool fleets:
+        # a single-corpus fleet's exposition is byte-identical to
+        # before the subsystem existed
+        self._pool_hists: dict[str, object] = {}
+        pool_events = None
+        if self.pools_active:
+            pool_names = sorted(
+                {b.pool for b in self.backends.values() if b.pool}
+            )
+            pool_hist = reg.histogram(
+                "fleet_tenant_request_seconds",
+                "Routed request latency by tenant pool (retries and "
+                "hedges included)",
+                labels=("pool",),
+            )
+            # children resolved once per pool, same reasoning as the
+            # solo fleet_request_seconds child above
+            self._pool_hists = {
+                p: pool_hist.labels(pool=p) for p in pool_names
+            }
+            pool_events = reg.counter(
+                "fleet_tenant_requests_total",
+                "Tenant-pool routing events by pool and kind (ok, "
+                "corpus_mismatch, unknown_corpus)",
+                labels=("pool", "event"),
+            )
 
         def collect(_reg) -> None:
             # loop-owned ints read lock-free: a torn read is impossible
@@ -707,8 +800,17 @@ class Router:
                 )
                 pool_conns.labels(backend=name).set(len(b.conns))
                 pool_inflight.labels(backend=name).set(b.pool_inflight())
+            if pool_events is not None:
+                for (pool, event), v in list(self._pool_counts.items()):
+                    pool_events.labels(pool=pool, event=event).sync(v)
 
         reg.add_collector(collect)
+
+    def _bump_pool(self, pool: str | None, event: str) -> None:
+        # loop-owned tenancy counters; the collector pass syncs them
+        # into fleet_tenant_requests_total
+        key = (pool or self.default_pool, event)
+        self._pool_counts[key] = self._pool_counts.get(key, 0) + 1
 
     # -- telemetry plane --
 
@@ -942,7 +1044,7 @@ class Router:
 
     # -- dispatch decision (loop thread; public facade below) --
 
-    def _pick(self, exclude=frozenset()) -> str | None:
+    def _pick(self, exclude=frozenset(), pool=None) -> str | None:
         # a single hand-rolled min pass: this runs once per request at
         # saturation, where two list comprehensions plus a keyed min
         # were measurable
@@ -951,6 +1053,11 @@ class Router:
         best_load = 0
         for name, b in self.backends.items():
             if name in exclude or not b.healthy:
+                continue
+            if pool is not None and b.pool != pool:
+                # tenancy isolation: failover and hedging never leave
+                # the request's pool — a worker on another corpus
+                # fingerprint is not a replica, whatever its load
                 continue
             if supervisor is not None and not supervisor.dispatchable(
                 name
@@ -966,14 +1073,48 @@ class Router:
                 best_load = load
         return best_name
 
-    def pick(self, exclude=frozenset()) -> str | None:
+    def pick(self, exclude=frozenset(), pool=None) -> str | None:
         """The least-loaded healthy, non-draining worker outside
         ``exclude`` — the dispatch decision: the router's probed health
-        view plus the supervisor's drain/stop veto."""
+        view plus the supervisor's drain/stop veto (confined to
+        ``pool`` on a multi-pool fleet)."""
         try:
-            return self.loop.run_sync(self._pick, exclude)
+            return self.loop.run_sync(self._pick, exclude, pool)
         except (LoopClosedError, TimeoutError):
-            return self._pick(exclude)
+            return self._pick(exclude, pool)
+
+    # -- tenancy route table (written by ops threads; read per-request
+    #    on the loop — replace-only dict updates, GIL-atomic) --
+
+    def set_corpus_route(self, tag: str, pool: str) -> None:
+        """Bind a corpus tag (tenant name, pool name, full or short
+        fingerprint) to a pool; tagged rows and tenant-bound HTTP
+        traffic route through this table."""
+        self._corpus_routes[tag] = pool
+
+    def drop_corpus_route(self, tag: str) -> None:
+        self._corpus_routes.pop(tag, None)
+
+    def set_pool_fingerprint(self, pool: str, fp: str | None) -> None:
+        """The fingerprint responses from ``pool`` must stamp; a row
+        answering with any other fingerprint is failed over inside the
+        pool instead of ever reaching the client.  ``None`` disarms
+        the fence (a mid-roll pool legitimately serves old AND new
+        fingerprints until the roll completes)."""
+        if fp is None:
+            self._pool_fps.pop(pool, None)
+        else:
+            self._pool_fps[pool] = fp
+
+    def pool_fingerprints(self) -> dict[str, str]:
+        return dict(self._pool_fps)
+
+    def resolve_pool(self, tag: str | None) -> str | None:
+        """Corpus tag -> pool, or None for an unroutable tag (the
+        default pool when untagged)."""
+        if tag is None:
+            return self.default_pool if self.pools_active else None
+        return self._corpus_routes.get(tag)
 
     def outstanding(self, name: str | None = None) -> int:
         """Routed requests currently in flight (one worker, or all) —
@@ -992,10 +1133,14 @@ class Router:
 
     # -- the request state machine (loop thread) --
 
-    def _submit(self, msg: dict | None, raw_line: str, on_done) -> None:
+    def _submit(self, msg: dict | None, raw_line: str, on_done,
+                pool: str | None = None) -> None:
         """Loop-thread entry: admit one routed request.  ``msg`` may be
         None (the front session's no-parse fast path); the request id
-        is then recovered lazily, only on paths that need it."""
+        is then recovered lazily, only on paths that need it.  ``pool``
+        pins the request to one tenant pool (the HTTP edge resolves it
+        from the bearer token's tenant binding); JSONL rows resolve
+        their own ``"corpus"`` tag below."""
         self._counters["requests"] += 1
         # cross-tier trace ADOPTION: a line that already carries a
         # valid 16-hex trace (a FRONT router federating this one, or
@@ -1062,7 +1207,42 @@ class Router:
                 wire_line = json.dumps(
                     {**(msg or {}), "trace": wire_trace}
                 )
-        req = _Request(msg, wire_line, trace, wire_trace, on_done)
+        if self.pools_active:
+            if pool is None and '"corpus"' in raw_line:
+                # corpus-tagged row: the tag must be PARSED for the
+                # same reason a trace-carrying line is (a textual scan
+                # cannot tell a nested occurrence apart) — only tagged
+                # rows pay this parse, untagged traffic keeps the
+                # no-parse fast path and lands on the default pool
+                if msg is None:
+                    try:
+                        parsed = json.loads(raw_line)
+                        msg = parsed if isinstance(parsed, dict) else {}
+                    except ValueError:
+                        msg = {}
+                tag = msg.get("corpus")
+                if isinstance(tag, str) and tag:
+                    pool = self._corpus_routes.get(tag)
+                    if pool is None:
+                        self._counters["unknown_corpus"] += 1
+                        if trace is not None:
+                            self.obs.tracer.finish(
+                                trace, "unknown_corpus"
+                            )
+                        req = _Request(
+                            msg, wire_line, trace, wire_trace, on_done
+                        )
+                        self._deliver(req, {
+                            "id": req.rid,
+                            "error": f"unknown_corpus: no pool serves "
+                            f"corpus tag {tag!r}",
+                        }, admitted=False)
+                        return
+            if pool is None:
+                pool = self.default_pool
+        else:
+            pool = None
+        req = _Request(msg, wire_line, trace, wire_trace, on_done, pool)
         if self._closing:
             self._deliver(req, {"id": req.rid, "error": "router_closed"},
                           admitted=False)
@@ -1090,7 +1270,7 @@ class Router:
         if now >= req.deadline:
             self._finish_deadline(req)
             return
-        name = self._pick(exclude=req.tried)
+        name = self._pick(exclude=req.tried, pool=req.pool)
         if name is None:
             if req.queue_full_rows:
                 # no untried replica left and at least one answered
@@ -1187,7 +1367,7 @@ class Router:
         req.hedge_timer = None
         if req.finished or self._closing:
             return
-        second = self._pick(exclude=req.tried)
+        second = self._pick(exclude=req.tried, pool=req.pool)
         if second is None:
             return
         self._counters["hedges_started"] += 1
@@ -1227,6 +1407,42 @@ class Router:
         attempt.resolved = True
         backend = attempt.backend
         backend.outstanding -= 1
+        # tenancy's last line of defense: a row answering with the
+        # wrong corpus fingerprint (a worker mid-roll, a stale pool)
+        # must NEVER reach the client — fail it over inside the pool
+        # like a dead backend.  The fence is read LIVE at completion
+        # time (not captured at submit): an onboarding roll disarms/
+        # re-arms it mid-flight, and a request admitted before the
+        # roll must be judged against what the pool serves NOW, not
+        # what it served when the request was queued.
+        want_fp = (
+            self._pool_fps.get(attempt.request.pool)
+            if attempt.request.pool is not None else None
+        )
+        if (
+            outcome == "ok"
+            and want_fp is not None
+            and not attempt.request.finished
+        ):
+            # fast path (payload is None): extract the system-minted
+            # stamp textually, exactly like the HTTP edge's X-Corpus
+            # echo
+            got = (
+                payload.get("corpus") if payload is not None
+                else json_str_field(text, "corpus") if text is not None
+                else None
+            )
+            if (
+                isinstance(got, str) and got
+                and not _fp_compatible(got, want_fp)
+            ):
+                self._bump_pool(attempt.request.pool, "corpus_mismatch")
+                outcome = "fail"
+                payload = (
+                    f"corpus fingerprint mismatch (want "
+                    f"{want_fp[:12]}, row stamps {got[:12]})"
+                )
+                text = None
         if outcome == "ok":
             backend.ok += 1
         elif outcome == "queue_full":
@@ -1282,6 +1498,11 @@ class Router:
         # the exposition's slowest-bucket `# {trace_id="..."}` then
         # resolves via `traces --id` to this request's assembled tree
         self._latency_hist.observe(dt, exemplar=req.wire_trace)
+        if req.pool is not None:
+            pool_hist = self._pool_hists.get(req.pool)
+            if pool_hist is not None:
+                pool_hist.observe(dt)
+            self._bump_pool(req.pool, "ok")
         self._counters["ok"] += 1
         if req.trace is not None:
             self.obs.tracer.finish(req.trace, "ok")
@@ -1462,7 +1683,7 @@ class Router:
             (row["probed_load"] + row["outstanding"])
             for row in backends.values()
         )
-        return {
+        result = {
             "uptime_s": self.obs.uptime_s(),
             "scheduler": {
                 "queue_depth": domain_depth,
@@ -1498,6 +1719,30 @@ class Router:
                 "fired_total": self.watchdog.snapshot()["fired_total"],
             },
         }
+        if self.pools_active:
+            pools: dict[str, dict] = {}
+            for name, row in backends.items():
+                pool = row.get("pool")
+                if pool is None:
+                    continue
+                entry = pools.setdefault(
+                    pool,
+                    {"workers": [],
+                     "fingerprint": self._pool_fps.get(pool)},
+                )
+                entry["workers"].append(name)
+            result["tenancy"] = {
+                "default_pool": self.default_pool,
+                "pools": pools,
+                "corpus_routes": len(self._corpus_routes),
+                "events": {
+                    f"{pool}:{event}": v
+                    for (pool, event), v in sorted(
+                        self._pool_counts.items()
+                    )
+                },
+            }
+        return result
 
     def prometheus(self) -> str:
         """The FLEET exposition: the router's own registry plus a live
@@ -1553,15 +1798,19 @@ class Router:
         self.collector.pull()
         return self.collector.assembled(n, trace_id=trace_id)
 
-    def reload_fleet(self, corpus: str) -> dict:
+    def reload_fleet(self, corpus: str, pool: str | None = None) -> dict:
         """The front-door rolling corpus reload: delegates to the
         attached supervisor's health-gated, rollback-capable
         ``reload_fleet`` (fleet/supervisor.py) — one ops verb swaps the
-        whole fleet with zero downtime."""
+        whole fleet with zero downtime.  On a multi-pool fleet
+        (tenancy/pools.py) ``pool`` confines the roll to one tenant's
+        workers; the other pools keep serving untouched."""
         if self.supervisor is None:
             raise RuntimeError(
                 "no supervisor attached; reload workers directly"
             )
+        if pool is not None:
+            return self.supervisor.reload_fleet(corpus, pool=pool)
         return self.supervisor.reload_fleet(corpus)
 
 
@@ -1733,14 +1982,23 @@ class _FrontSession:
                 self._push("traces", (rid, n, tid))
         elif op == "reload":
             corpus = msg.get("corpus")
+            pool = msg.get("pool")
             if not isinstance(corpus, str) or not corpus:
                 self._push("raw", row={
                     "id": rid,
                     "error": "bad_request: reload needs a 'corpus' "
                     "source string",
                 })
+            elif pool is not None and (
+                not isinstance(pool, str) or not pool
+            ):
+                self._push("raw", row={
+                    "id": rid,
+                    "error": "bad_request: reload 'pool' must be a "
+                    "pool name string",
+                })
             else:
-                self._push("reload", (rid, corpus))
+                self._push("reload", (rid, corpus, pool))
         elif op == "query":
             # the telemetry-store verb: server-side rate/delta/quantile
             # over retained series (obs/tsdb.py) — param validation is
@@ -1796,12 +2054,14 @@ class _FrontSession:
                 "id": rid, "prometheus": self.router.prometheus()
             })
         elif kind == "reload":
-            rid, corpus = slot["payload"]
+            rid, corpus, pool = slot["payload"]
 
             def run_reload() -> dict:
                 try:
                     return {"id": rid,
-                            "reload": self.router.reload_fleet(corpus)}
+                            "reload": self.router.reload_fleet(
+                                corpus, pool=pool
+                            )}
                 except Exception as exc:  # noqa: BLE001 — session containment
                     return {"id": rid, "error": f"reload_failed: {exc}"}
 

@@ -1594,3 +1594,573 @@ def _drive_traffic(
             sock.close()
         except OSError:
             pass
+
+
+def _err_code(row: dict) -> str | None:
+    """The error-code prefix of a response row, or None."""
+    err = row.get("error")
+    if not isinstance(err, str):
+        return None
+    return err.split(":", 1)[0]
+
+
+class _TenantTraffic:
+    """Continuous per-tenant HTTP traffic through the edge for the
+    tenancy drill: sequential keep-alive POSTs under one bearer token,
+    every answer collected (status + parsed body row, so the gates can
+    read the worker name and corpus fingerprint each answer carries)."""
+
+    def __init__(self, edge_target: str, token: str, timeout_s: float):
+        self.edge_target = edge_target
+        self.token = token
+        self.timeout_s = timeout_s
+        self.rows: list[dict] = []
+        self.errors: list[str] = []
+        self.reconnects = 0
+        self.stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def finish(self) -> None:
+        self.stop.set()
+        self._thread.join(timeout=self.timeout_s + 10.0)
+
+    def _run(self) -> None:
+        client = None
+        i = 0
+        while not self.stop.is_set():
+            try:
+                if client is None:
+                    client = _HttpClient(
+                        self.edge_target, self.token, self.timeout_s
+                    )
+                body = json.dumps({
+                    "id": i,
+                    "content": f"tenant drill {self.token} {i}",
+                }).encode("utf-8")
+                code, _hdrs, payload = client.post("/classify", body)
+                try:
+                    row = json.loads(payload.decode("utf-8", "replace"))
+                except ValueError:
+                    row = {}
+                if not isinstance(row, dict):
+                    row = {}
+                row["_status"] = code
+                self.rows.append(row)
+                i += 1
+            except OSError as exc:
+                # the edge must never drop a keep-alive session during
+                # a roll or an in-pool failover: reconnects are counted
+                # as findings, a failure on a fresh connection is hard
+                if client is None:
+                    self.errors.append(str(exc))
+                    self.stop.wait(0.2)
+                else:
+                    self.reconnects += 1
+                    client.close()
+                    client = None
+            time.sleep(0.005)
+        if client is not None:
+            client.close()
+
+
+def selftest_tenant(
+    verbose: bool = True,
+    stub: bool = True,
+    workers_per_pool: int = 2,
+) -> int:
+    """The multi-tenant serving selftest (``licensee-tpu fleet
+    --selftest-tenant``): two tenants with DISJOINT corpora on separate
+    worker pools behind one router and one HTTP edge, drilled under
+    live traffic.  The gates:
+
+    * tagged routing: a ``corpus`` tag (tenant name, pool name, or
+      fingerprint) lands on the right pool, untagged rows fall back to
+      the default pool, an unknown tag answers ``unknown_corpus``;
+    * ZERO cross-tenant rows: every answer a tenant's token receives
+      stamps that tenant's corpus fingerprint and a worker from that
+      tenant's pool — across an upload-roll and a SIGKILL;
+    * self-serve onboarding: an authenticated ``POST /corpus`` from
+      tenant A validates, journals, and rolls A's pool zero-downtime
+      while tenant B's traffic keeps answering inside its latency SLO;
+    * auth: a wrong bearer token answers 401; a valid token bound to
+      no tenant answers 403 on ``POST /corpus``; a garbage artifact
+      answers 400 ``corpus_invalid`` without touching the fleet;
+    * SIGKILL of one pool's worker fails over ONLY inside that pool
+      with zero client-visible errors, and the worker rejoins;
+    * crash recovery: a dangling journaled ``roll_start`` is replayed
+      by a fresh onboarder and the pool lands on the rolled corpus.
+
+    ``stub=True`` (the CI path) runs protocol-faithful stub workers
+    whose "corpus" is the fingerprint string their reload installs;
+    ``stub=False`` boots real serve workers on vendored/spdx corpora
+    and onboards a real corpus artifact."""
+    from licensee_tpu.tenancy import (
+        CorpusOnboarder, OnboardError, Tenant, TenantPools,
+        TenantRegistry,
+    )
+
+    problems: list[str] = []
+    tmpdir = tempfile.mkdtemp(prefix="licensee-tenant-fleet-")
+    boot_timeout = 20.0 if stub else 240.0
+    req_timeout = 10.0 if stub else 120.0
+    pool_names = ("acme", "beta")
+    pool_sockets = {
+        pool: {
+            f"{pool}{i}": os.path.join(tmpdir, f"{pool}{i}.sock")
+            for i in range(workers_per_pool)
+        }
+        for pool in pool_names
+    }
+    if stub:
+        boot_corpus = {"acme": "fp-acme-1", "beta": "fp-beta-1"}
+
+        def argv_for(name: str, sock: str) -> list[str]:
+            pool = name.rstrip("0123456789")
+            return [
+                sys.executable, "-m", "licensee_tpu.fleet.faults",
+                "--socket", sock, "--name", name, "--service-ms", "5",
+                "--fingerprint", boot_corpus[pool],
+            ]
+    else:
+        boot_corpus = {"acme": "vendored", "beta": "spdx"}
+
+        def argv_for(name: str, sock: str) -> list[str]:
+            pool = name.rstrip("0123456789")
+            return _serve_argv(name, sock) + [
+                "--corpus", boot_corpus[pool]
+            ]
+
+    env = worker_env(None, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    pools = TenantPools({
+        pool: Supervisor(
+            sockets,
+            argv_for=argv_for,
+            env_for=lambda name, chips: env,
+            probe_interval_s=0.25,
+            backoff_base_s=0.25,
+            backoff_max_s=2.0,
+            startup_grace_s=boot_timeout,
+        )
+        for pool, sockets in pool_sockets.items()
+    }, default_pool="acme")
+    router = Router(
+        pools.workers,
+        supervisor=pools,
+        probe_interval_s=0.25,
+        request_timeout_s=req_timeout,
+        dispatch_wait_s=req_timeout + 30.0,
+        trace_sample=0.0,
+        pools=pools.worker_pools(),
+        default_pool="acme",
+    )
+    registry = TenantRegistry(
+        os.path.join(tmpdir, "tenants.json"), create=True
+    )
+    registry.set_tenant(
+        Tenant("acme", "tok-acme", boot_corpus["acme"]), save=False
+    )
+    registry.set_tenant(Tenant("beta", "tok-beta", boot_corpus["beta"]))
+    front_path = os.path.join(tmpdir, "front.sock")
+    server = None
+    server_thread = None
+    edge = None
+    edge_thread = None
+    traffic: dict[str, _TenantTraffic] = {}
+    onboard_result: dict | None = None
+    recovered: list[dict] = []
+    try:
+        pools.start()
+        if not pools.wait_healthy(boot_timeout):
+            problems.append(
+                f"pools never became healthy: {pools.status()}"
+            )
+            raise _Abort()
+        router.start()
+
+        if stub:
+            def validator(path: str) -> str:
+                with open(path, encoding="utf-8", errors="replace") as fh:
+                    text = fh.read().strip()
+                if not text.startswith("fp-"):
+                    raise ValueError(
+                        f"stub artifact must start with 'fp-', got "
+                        f"{text[:20]!r}"
+                    )
+                return text
+
+            onboarder = CorpusOnboarder(
+                registry, pools, router,
+                staging_dir=os.path.join(tmpdir, "staging"),
+                validator=validator,
+                source_for=lambda path, fp: fp,
+                reload_kwargs={
+                    "timeout_s": req_timeout + 60.0,
+                    "health_timeout_s": 30.0,
+                    "argv_patch": _patch_stub_argv,
+                },
+            )
+            pool_fps = dict(boot_corpus)
+        else:
+            onboarder = CorpusOnboarder(
+                registry, pools, router,
+                staging_dir=os.path.join(tmpdir, "staging"),
+                reload_kwargs={
+                    "timeout_s": req_timeout + 60.0,
+                    "health_timeout_s": 30.0,
+                },
+            )
+            fps = _fingerprints(pools)
+            owners = pools.worker_pools()
+            pool_fps = {
+                owners[name]: fp
+                for name, fp in fps.items() if fp
+            }
+            if set(pool_fps) != set(pool_names):
+                problems.append(
+                    f"workers report no fingerprints: {fps}"
+                )
+                raise _Abort()
+        onboarder.sync_routes(fingerprints=pool_fps)
+
+        server = FrontServer(front_path, router, stall_timeout_s=2.0)
+        server_thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        server_thread.start()
+        edge_tokens = dict(registry.tokens())
+        edge_tokens["tok-anon"] = "anon"  # valid token, no tenant
+        edge = HttpEdgeServer(
+            "127.0.0.1:0", router,
+            tokens=edge_tokens,
+            tenancy=onboarder,
+            rate_per_client=100000.0,
+            stall_timeout_s=2.0,
+        )
+        edge_target = f"127.0.0.1:{edge.bound_port}"
+        edge_thread = threading.Thread(
+            target=edge.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        edge_thread.start()
+
+        workers_of = {
+            pool: set(socks) for pool, socks in pool_sockets.items()
+        }
+        fp_observed: dict[str, set] = {pool: set() for pool in pool_names}
+
+        def allowed_fps(pool: str, *fps) -> set:
+            out = set()
+            for fp in fps:
+                if fp:
+                    out.add(fp)
+                    short = short_fingerprint(fp)
+                    if short:
+                        out.add(short)
+            return out
+
+        def check_row(label: str, row: dict, pool: str,
+                      allowed: set) -> None:
+            if _err_code(row) is not None:
+                problems.append(f"{label}: error row {row}")
+                return
+            worker = row.get("worker")
+            if worker not in workers_of[pool]:
+                problems.append(
+                    f"{label}: answered by {worker!r}, not a {pool} "
+                    f"pool worker"
+                )
+            fp = row.get("corpus")
+            fp_observed[pool].add(fp)
+            if fp not in allowed:
+                problems.append(
+                    f"{label}: stamps corpus {fp!r}, allowed "
+                    f"{sorted(allowed)}"
+                )
+
+        # -- phase 1: tagged JSONL routing through the front socket --
+        probe_timeout = req_timeout + 30.0
+        for tag, pool in (
+            ("acme", "acme"),             # tenant name
+            ("beta", "beta"),
+            (pool_fps["beta"], "beta"),   # full fingerprint
+            (None, "acme"),               # untagged -> default pool
+        ):
+            msg: dict = {"id": 1, "content": f"probe {tag}"}
+            if tag is not None:
+                msg["corpus"] = tag
+            row = oneshot(front_path, msg, probe_timeout)
+            check_row(
+                f"tagged probe {tag!r}", row, pool,
+                allowed_fps(pool, pool_fps[pool]),
+            )
+        row = oneshot(
+            front_path,
+            {"id": 1, "content": "probe", "corpus": "no-such-tenant"},
+            probe_timeout,
+        )
+        if _err_code(row) != "unknown_corpus":
+            problems.append(
+                f"unknown corpus tag answered {row}, wanted an "
+                f"unknown_corpus error"
+            )
+
+        # -- phase 2: live per-tenant HTTP traffic, then an upload-roll
+        #    of tenant acme mid-stream --
+        for name, token in (("acme", "tok-acme"), ("beta", "tok-beta")):
+            traffic[name] = _TenantTraffic(
+                edge_target, token, req_timeout + 30.0
+            )
+            traffic[name].start()
+        time.sleep(0.6 if stub else 2.0)
+
+        if stub:
+            upload_blob = b"fp-acme-2"
+        else:
+            from licensee_tpu.corpus.artifact import write_artifact
+            from licensee_tpu.corpus.compiler import default_corpus
+
+            artifact_path = os.path.join(tmpdir, "upload.corpus.npz")
+            write_artifact(
+                artifact_path, default_corpus(), source="vendored"
+            )
+            with open(artifact_path, "rb") as fh:
+                upload_blob = fh.read()
+        import base64 as _b64
+
+        upload_body = json.dumps({
+            "artifact_b64": _b64.b64encode(upload_blob).decode("ascii"),
+            "name": "upload.corpus.npz",
+        }).encode("utf-8")
+        client = _HttpClient(
+            edge_target, "tok-acme", req_timeout + 120.0
+        )
+        try:
+            code, _hdrs, payload = client.post("/corpus", upload_body)
+        finally:
+            client.close()
+        if code != 200:
+            problems.append(
+                f"corpus upload answered {code}: {payload[:300]!r}"
+            )
+        else:
+            onboard_result = (
+                json.loads(payload.decode("utf-8", "replace"))
+            ).get("corpus") or {}
+            if onboard_result.get("pool") != "acme":
+                problems.append(
+                    f"upload rolled pool {onboard_result.get('pool')!r},"
+                    f" wanted 'acme'"
+                )
+        rolled_fp = (onboard_result or {}).get("fingerprint")
+        if stub and rolled_fp != "fp-acme-2":
+            problems.append(
+                f"upload rolled to {rolled_fp!r}, wanted 'fp-acme-2'"
+            )
+        time.sleep(0.4 if stub else 2.0)
+
+        # -- phase 3: SIGKILL one beta worker under traffic: in-pool
+        #    failover only, zero client-visible errors --
+        victim = pools.pools["beta"]
+        pid = victim.workers["beta0"].pid
+        if pid is None:
+            problems.append("beta0 had no pid at kill time")
+        else:
+            faults.kill(pid)
+        if not _await_respawn(victim, "beta0", boot_timeout + 10.0):
+            problems.append("beta0 never respawned after SIGKILL")
+        time.sleep(0.4 if stub else 2.0)
+        for t in traffic.values():
+            t.finish()
+
+        # -- the cross-tenant fence, across roll AND kill --
+        acme_allowed = allowed_fps("acme", pool_fps["acme"], rolled_fp)
+        beta_allowed = allowed_fps("beta", pool_fps["beta"])
+        for name, allowed in (
+            ("acme", acme_allowed), ("beta", beta_allowed),
+        ):
+            t = traffic[name]
+            if t.errors:
+                problems.append(
+                    f"{name} traffic errors: {t.errors[:3]}"
+                )
+            if t.reconnects:
+                problems.append(
+                    f"{name} edge session dropped {t.reconnects} time(s)"
+                )
+            bad = [r for r in t.rows if r.get("_status") != 200]
+            if bad:
+                problems.append(
+                    f"{name}: {len(bad)} non-200 answers, e.g. {bad[:3]}"
+                )
+            if len(t.rows) < 20:
+                problems.append(
+                    f"{name}: only {len(t.rows)} rows — the drill did "
+                    f"not run under load"
+                )
+            for row in t.rows:
+                if row.get("_status") != 200:
+                    continue
+                check_row(f"{name} traffic", row, name, allowed)
+        if stub and "fp-acme-2" not in fp_observed["acme"]:
+            problems.append(
+                "acme traffic never reached the rolled corpus "
+                f"(saw {sorted(fp_observed['acme'])})"
+            )
+        crossed = fp_observed["acme"] & fp_observed["beta"]
+        if crossed:
+            problems.append(
+                f"cross-tenant fingerprints observed: {sorted(crossed)}"
+            )
+
+        # -- tenant B's latency SLO survived tenant A's roll --
+        slo = router.stats().get("slo") or {}
+        beta_slo = (
+            (slo.get("objectives") or {}).get("pool_beta_latency_p99")
+            or {}
+        )
+        if not beta_slo:
+            problems.append(f"router stats carries no beta pool SLO: {slo}")
+        else:
+            if not (beta_slo.get("good") or 0) > 0:
+                problems.append(f"beta pool SLO saw no traffic: {beta_slo}")
+            max_burn = beta_slo.get("max_burn")
+            if max_burn is None or not (max_burn < 1.0):
+                problems.append(
+                    f"beta latency SLO breached during acme's roll: "
+                    f"max_burn={max_burn}"
+                )
+        # -- the kill actually exercised failover --
+        rstats = router.stats()["router"]
+        if rstats["failovers"] + rstats["retries"] < 1:
+            problems.append(
+                f"no failover recorded — did the kill land? {rstats}"
+            )
+
+        # -- auth probes --
+        client = _HttpClient(edge_target, "wrong-token", req_timeout)
+        try:
+            code, _h, _b = client.post(
+                "/classify", b'{"content": "auth probe"}'
+            )
+        finally:
+            client.close()
+        if code != 401:
+            problems.append(f"bad token answered {code}, wanted 401")
+        client = _HttpClient(edge_target, "tok-anon", req_timeout)
+        try:
+            code, _h, _b = client.post("/corpus", upload_body)
+        finally:
+            client.close()
+        if code != 403:
+            problems.append(
+                f"tenant-less token answered {code} on POST /corpus, "
+                f"wanted 403"
+            )
+        garbage = json.dumps({
+            "artifact_b64": _b64.b64encode(
+                b"garbage, not an artifact"
+            ).decode("ascii"),
+        }).encode("utf-8")
+        client = _HttpClient(edge_target, "tok-acme", req_timeout + 60.0)
+        try:
+            code, _h, body = client.post("/corpus", garbage)
+        finally:
+            client.close()
+        bad_row = {}
+        try:
+            bad_row = json.loads(body.decode("utf-8", "replace"))
+        except ValueError:
+            pass
+        if code != 400 or _err_code(bad_row) != "corpus_invalid":
+            problems.append(
+                f"garbage artifact answered {code} {bad_row}, wanted "
+                f"400 corpus_invalid"
+            )
+
+        # -- phase 4 (stub): crash recovery — a dangling journaled
+        #    roll_start is replayed by a FRESH onboarder at boot --
+        if stub:
+            registry.record_roll(
+                "roll_start", "acme",
+                corpus="fp-acme-3", fingerprint="fp-acme-3",
+            )
+            recovery = CorpusOnboarder(
+                registry, pools, router,
+                staging_dir=os.path.join(tmpdir, "staging"),
+                validator=validator,
+                source_for=lambda path, fp: fp,
+                reload_kwargs={
+                    "timeout_s": req_timeout + 60.0,
+                    "health_timeout_s": 30.0,
+                    "argv_patch": _patch_stub_argv,
+                },
+            )
+            try:
+                recovered = recovery.recover()
+            except OnboardError as exc:
+                problems.append(f"journal recovery raised: {exc}")
+            if len(recovered) != 1 or (
+                recovered[0].get("fingerprint") != "fp-acme-3"
+            ):
+                problems.append(
+                    f"journal recovery did not replay the dangling "
+                    f"roll: {recovered}"
+                )
+            fps_now = {
+                fp for name, fp in _fingerprints(pools).items()
+                if name in workers_of["acme"]
+            }
+            if fps_now != {"fp-acme-3"}:
+                problems.append(
+                    f"recovered acme pool serves {fps_now}, wanted "
+                    "{'fp-acme-3'}"
+                )
+            if router.pool_fingerprints().get("acme") != "fp-acme-3":
+                problems.append(
+                    f"router fence not re-armed after recovery: "
+                    f"{router.pool_fingerprints()}"
+                )
+            if registry.pending_rolls():
+                problems.append(
+                    f"journal still pending after recovery: "
+                    f"{registry.pending_rolls()}"
+                )
+    except _Abort:
+        pass
+    except Exception as exc:  # noqa: BLE001 — selftest must report, not die
+        problems.append(f"selftest crashed: {type(exc).__name__}: {exc}")
+    finally:
+        for t in traffic.values():
+            if not t.stop.is_set():
+                t.finish()
+        if edge is not None:
+            edge.shutdown()
+            edge.server_close()
+        if edge_thread is not None:
+            edge_thread.join(timeout=5.0)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if server_thread is not None:
+            server_thread.join(timeout=5.0)
+        router.close()
+        pools.stop()
+        registry.close()
+    if verbose:
+        summary = {
+            "tenant_fleet_selftest": "ok" if not problems else "FAIL",
+            "stub_workers": stub,
+            "traffic_rows": {
+                name: len(t.rows) for name, t in traffic.items()
+            },
+            "onboarded": onboard_result,
+            "recovered": recovered,
+            "problems": problems,
+        }
+        sys.stderr.write(json.dumps(summary) + "\n")
+    return 0 if not problems else 1

@@ -51,6 +51,7 @@ from licensee_tpu.obs.tsdb import (
 )
 from licensee_tpu.obs.slo import (
     SLOEngine,
+    pool_objectives,
     router_objectives,
     serve_objectives,
 )
@@ -69,6 +70,7 @@ __all__ = [
     "TraceCollector", "assemble_rows", "assemble_trace", "render_tree",
     "FlightRecorder", "flight_path_for_socket", "load_flight_dump",
     "SLOEngine", "serve_objectives", "router_objectives",
+    "pool_objectives",
     "TsdbStore", "ScrapeScheduler", "QueryError",
     "AnomalyWatchdog", "RateJumpRule", "FlatlineRule", "SaturationRule",
     "DEFAULT_LATENCY_BUCKETS", "Observability",

@@ -280,6 +280,30 @@ def router_objectives(
     ]
 
 
+def pool_objectives(
+    pools,
+    latency_target: float = 0.99,
+    latency_threshold_s: float = 0.25,
+) -> list[Objective]:
+    """Per-tenant-pool latency objectives over the router's pool-
+    labeled latency histogram: one objective per pool, so a rolling
+    reload of tenant A's pool breaching tenant B's latency shows up as
+    burn on B's OWN objective — the isolation witness the multi-tenant
+    selftest gates on."""
+    return [
+        LatencyObjective(
+            f"pool_{pool}_latency_p99",
+            family="fleet_tenant_request_seconds",
+            labels={"pool": pool},
+            threshold_s=latency_threshold_s,
+            target=latency_target,
+            description=f"pool {pool!r} requests finishing under "
+            f"{latency_threshold_s * 1000:g} ms",
+        )
+        for pool in sorted(pools)
+    ]
+
+
 class SLOEngine:
     """Samples objective totals per evaluation, differences them over
     the burn windows, and publishes ``slo_burn_rate`` gauges.

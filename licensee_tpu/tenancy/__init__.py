@@ -1,0 +1,33 @@
+"""Multi-tenant serving: tenant registry, per-pool supervision, and
+self-serve corpus onboarding.
+
+The subsystem closes the loop from an edge bearer token to a corpus
+fingerprint:
+
+- :mod:`licensee_tpu.tenancy.registry` — the durable tenant config
+  (token -> tenant -> corpus source -> pool) plus the journaled
+  onboarding state that survives a crash mid-roll.
+- :mod:`licensee_tpu.tenancy.pools` — heterogeneous worker pools: one
+  :class:`~licensee_tpu.fleet.supervisor.Supervisor` per pool behind
+  the supervisor surface the router consumes, with a per-pool
+  ``reload_fleet``.
+- :mod:`licensee_tpu.tenancy.onboard` — the authenticated
+  upload -> validate -> roll -> persist pipeline behind the edge's
+  ``POST /corpus`` verb.
+"""
+
+from licensee_tpu.tenancy.onboard import CorpusOnboarder, OnboardError
+from licensee_tpu.tenancy.pools import TenantPools
+from licensee_tpu.tenancy.registry import (
+    RegistryError,
+    Tenant,
+    TenantRegistry,
+)
+
+__all__ = [
+    "CorpusOnboarder",
+    "OnboardError",
+    "RegistryError",
+    "Tenant",
+    "TenantRegistry",
+]

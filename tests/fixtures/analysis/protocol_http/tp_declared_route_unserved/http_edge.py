@@ -9,6 +9,7 @@ ROUTES = {  # BAD
     ("GET", "/jobs/{id}/results"): "job_results",
     ("GET", "/jobs/{id}/containers"): "job_containers",
     ("DELETE", "/jobs/{id}"): "job_cancel",
+    ("POST", "/corpus"): "corpus_upload",
     ("GET", "/metrics/history"): "metrics_history",
 }
 
@@ -17,6 +18,7 @@ STATUS_TEXT = {
     202: "Accepted",
     400: "Bad Request",
     401: "Unauthorized",
+    403: "Forbidden",
     404: "Not Found",
     405: "Method Not Allowed",
     409: "Conflict",

@@ -9,6 +9,7 @@ ROUTES = {
     ("GET", "/jobs/{id}/results"): "job_results",
     ("GET", "/jobs/{id}/containers"): "job_containers",
     ("DELETE", "/jobs/{id}"): "job_cancel",
+    ("POST", "/corpus"): "corpus_upload",
     ("GET", "/metrics"): "prometheus",
     ("GET", "/metrics/history"): "metrics_history",
 }
@@ -18,6 +19,7 @@ STATUS_TEXT = {  # BAD
     202: "Accepted",
     400: "Bad Request",
     401: "Unauthorized",
+    403: "Forbidden",
     404: "Not Found",
     405: "Method Not Allowed",
     409: "Conflict",

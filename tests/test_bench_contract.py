@@ -261,6 +261,17 @@ def _fat_details() -> dict:
             "cap": {"bytes_est": 99_999_999, "max_bytes": 99_999_999,
                     "evicted_series": 99_999, "ok": True},
         },
+        "tenant": {
+            "requests": 99_999_999,
+            "single_pool_rps": 99_999_999.9,
+            "single_pool_errors": 99,
+            "two_pool_rps": 99_999_999.9,
+            "two_pool_errors": 99,
+            "routing_overhead_pct": 99.99,
+            "reload_ok": True,
+            "reload_p99_ms": 99999.999,
+            "reload_errors": 99,
+        },
         "reference_fallback": {"native_jit": True},
         "tp_width": {"conclusion": "w" * 400},
         "scalar_agreement": {
@@ -301,9 +312,10 @@ def test_headline_line_fits_driver_capture(bench_mod):
     # when the streaming-ingest block joined the headline, 1800 -> 1850
     # when its striped_* keys joined (PR 15), 1850 -> 1980 when the
     # durable-jobs block joined (PR 16), 1980 -> 2080 when the
-    # telemetry-store block joined (PR 18) — this worst-case dict
+    # telemetry-store block joined (PR 18), 2080 -> 2200 when the
+    # multi-tenant block joined (PR 19) — this worst-case dict
     # inflates every scalar to its widest; real lines run shorter
-    assert n <= 2080
+    assert n <= 2200
 
 
 def test_headline_carries_the_headline_numbers(bench_mod):
@@ -379,6 +391,13 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     assert d["obs"]["tsdb"]["ovh_ok"] is True
     assert d["obs"]["tsdb"]["q_p99_ms"] == 99999.999
     assert d["obs"]["tsdb"]["cap_ok"] is True
+    # the multi-tenant scalars (PR 19): corpus-tag routing overhead vs
+    # a pool-less router over the same workers, and tenant B's p99
+    # while tenant A's pool rolled mid-stream
+    assert d["tenant"]["two_pool_rps"] == 99_999_999.9
+    assert d["tenant"]["single_pool_rps"] == 99_999_999.9
+    assert d["tenant"]["routing_overhead_pct"] == 99.99
+    assert d["tenant"]["reload_p99_ms"] == 99999.999
     assert d["details_file"] == "BENCH_DETAILS.json"
 
 
@@ -388,7 +407,7 @@ def test_headline_survives_missing_rows(bench_mod):
     details = _fat_details()
     for k in ("end_to_end_1m", "end_to_end_1m_auto", "scalar_agreement",
               "end_to_end_readme", "serve_path", "fleet", "stripes",
-              "ingest", "jobs", "tsdb"):
+              "ingest", "jobs", "tsdb", "tenant"):
         details[k] = None
     headline = bench_mod.make_headline("m", 1.0, 1.0, details)
     assert headline["details"]["ingest"]["tar_files_per_sec"] is None
@@ -410,6 +429,9 @@ def test_headline_survives_missing_rows(bench_mod):
     # same for a crashed tsdb suite (None != the "skipped" stamp)
     assert headline["details"]["obs"]["tsdb"]["ovh_pct"] is None
     assert headline["details"]["obs"]["tsdb"]["cap_ok"] is None
+    # and a crashed tenant suite
+    assert headline["details"]["tenant"]["two_pool_rps"] is None
+    assert headline["details"]["tenant"]["reload_p99_ms"] is None
 
 
 def test_fast_mode_fleet_keys_say_skipped(bench_mod):
@@ -465,6 +487,19 @@ def test_fast_mode_tsdb_keys_say_skipped(bench_mod):
     tsdb = headline["details"]["obs"]["tsdb"]
     assert set(tsdb) == set(bench_mod.TSDB_HEADLINE_KEYS)
     assert all(v == "skipped" for v in tsdb.values()), tsdb
+    line = json.dumps(headline, separators=(",", ":"))
+    assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
+
+
+def test_fast_mode_tenant_keys_say_skipped(bench_mod):
+    """The PR 19 satellite: fast mode stamps the details.tenant
+    headline keys "skipped" — not-run must never read as broken."""
+    details = _fat_details()
+    details["tenant"] = "skipped"
+    headline = bench_mod.make_headline("m", 1.0, 1.0, details)
+    tenant = headline["details"]["tenant"]
+    assert set(tenant) == set(bench_mod.TENANT_HEADLINE_KEYS)
+    assert all(v == "skipped" for v in tenant.values()), tenant
     line = json.dumps(headline, separators=(",", ":"))
     assert len(line.encode()) <= bench_mod.HEADLINE_BYTE_BUDGET
 

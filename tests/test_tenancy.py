@@ -1,0 +1,396 @@
+"""Multi-tenant serving (licensee_tpu/tenancy/ + the router's corpus
+routing): registry round-trips and token resolution, the TenantPools
+supervisor facade, per-request corpus-tag routing with untagged
+default-pool fallback, the per-pool fingerprint fence (a row stamping
+the wrong corpus must never reach a client), and the edge's
+POST /corpus auth tiers (401/403/400).
+
+Workers are the protocol-faithful stub from fleet/faults.py — real
+subprocesses on real Unix sockets, booting in ~0.3 s — so routing and
+fencing are drilled over the real wire, not mocks."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from licensee_tpu.fleet.http_edge import HttpEdgeServer
+from licensee_tpu.fleet.router import Router
+from licensee_tpu.fleet.supervisor import Supervisor, worker_env
+from licensee_tpu.fleet.wire import WireError, oneshot
+from licensee_tpu.tenancy import (
+    CorpusOnboarder,
+    OnboardError,
+    RegistryError,
+    Tenant,
+    TenantPools,
+    TenantRegistry,
+)
+
+pytestmark = pytest.mark.usefixtures("lock_order_sanitizer")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB_ENV = {**os.environ, "PYTHONPATH": REPO_ROOT}
+
+
+def stub_argv(sock: str, name: str, *extra: str) -> list[str]:
+    return [
+        sys.executable, "-m", "licensee_tpu.fleet.faults",
+        "--socket", sock, "--name", name, *extra,
+    ]
+
+
+def wait_answering(sock: str, timeout: float = 15.0) -> None:
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        try:
+            oneshot(sock, {"op": "stats"}, 1.0)
+            return
+        except WireError:
+            time.sleep(0.02)
+    raise AssertionError(f"stub on {sock} never answered")
+
+
+class StubPools:
+    """Spawn fingerprint-stamping stubs per pool; kill what survives."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(self, name: str, fingerprint: str) -> str:
+        sock = str(self.tmp_path / f"{name}.sock")
+        self.procs[name] = subprocess.Popen(
+            stub_argv(sock, name, "--fingerprint", fingerprint),
+            env=STUB_ENV,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        wait_answering(sock)
+        return sock
+
+    def cleanup(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+@pytest.fixture()
+def stub_pools(tmp_path):
+    pools = StubPools(tmp_path)
+    yield pools
+    pools.cleanup()
+
+
+# -- the tenant registry -----------------------------------------------
+
+
+def test_registry_round_trip_and_token_resolution(tmp_path):
+    path = str(tmp_path / "tenants.json")
+    reg = TenantRegistry(path, create=True)
+    reg.set_tenant(Tenant("acme", "tok-acme", "vendored"), save=False)
+    reg.set_tenant(Tenant("beta", "tok-beta", "spdx", pool="shared"))
+    reg.close()
+
+    loaded = TenantRegistry(path)
+    try:
+        # pool defaults to the tenant's own name; explicit pool sticks
+        assert loaded.get("acme").pool == "acme"
+        assert loaded.get("beta").pool == "shared"
+        assert loaded.tokens() == {"tok-acme": "acme", "tok-beta": "beta"}
+        assert loaded.by_token("tok-beta").name == "beta"
+        assert loaded.by_token("tok-nobody") is None
+        assert loaded.pools() == {"acme": ["acme"], "shared": ["beta"]}
+    finally:
+        loaded.close()
+
+
+def test_registry_rejects_bad_configs(tmp_path):
+    colliding = tmp_path / "collide.json"
+    colliding.write_text(json.dumps({
+        "version": 1,
+        "tenants": {
+            "a": {"token": "tok", "corpus": "vendored"},
+            "b": {"token": "tok", "corpus": "spdx"},
+        },
+    }))
+    with pytest.raises(RegistryError, match="token collision"):
+        TenantRegistry(str(colliding))
+    bad_default = tmp_path / "default.json"
+    bad_default.write_text(json.dumps({
+        "version": 1,
+        "default_pool": "nope",
+        "tenants": {"a": {"token": "tok", "corpus": "vendored"}},
+    }))
+    with pytest.raises(RegistryError, match="default_pool"):
+        TenantRegistry(str(bad_default))
+    from licensee_tpu.tenancy.registry import _parse_tenant
+
+    with pytest.raises(RegistryError, match="missing 'token'"):
+        _parse_tenant("x", {"corpus": "vendored"})
+
+
+def test_registry_journal_pending_rolls(tmp_path):
+    path = str(tmp_path / "tenants.json")
+    reg = TenantRegistry(path, create=True)
+    try:
+        reg.set_tenant(Tenant("acme", "tok", "vendored"))
+        reg.record_roll("roll_start", "acme", corpus="c1",
+                        fingerprint="f1")
+        reg.record_roll("roll_done", "acme", fingerprint="f1")
+        reg.record_roll("roll_start", "acme", corpus="c2",
+                        fingerprint="f2")
+        pending = reg.pending_rolls()
+        assert [row["fingerprint"] for row in pending] == ["f2"]
+    finally:
+        reg.close()
+
+
+# -- the TenantPools facade --------------------------------------------
+
+
+class _FakeHandle:
+    def __init__(self, sock):
+        self.socket_path = sock
+
+
+class _FakeSupervisor:
+    def __init__(self, workers):
+        self.workers = {n: _FakeHandle(s) for n, s in workers.items()}
+        self.router = None
+        self.reloads: list = []
+
+    def reload_fleet(self, corpus, **kwargs):
+        self.reloads.append(corpus)
+        return {"ok": True, "corpus": corpus, "workers": {}}
+
+
+def test_tenant_pools_facade_merges_and_routes():
+    a = _FakeSupervisor({"a0": "/tmp/a0.sock"})
+    b = _FakeSupervisor({"b0": "/tmp/b0.sock"})
+    pools = TenantPools({"A": a, "B": b}, default_pool="A")
+    assert pools.workers == {"a0": "/tmp/a0.sock", "b0": "/tmp/b0.sock"}
+    assert pools.worker_pools() == {"a0": "A", "b0": "B"}
+    assert pools.pool_of("b0") == "B"
+    # a named roll lands on that pool only; default goes to default_pool
+    result = pools.reload_fleet("new-corpus", pool="B")
+    assert result["ok"] and result["pool"] == "B"
+    assert b.reloads == ["new-corpus"] and a.reloads == []
+    pools.reload_fleet("other")
+    assert a.reloads == ["other"]
+    refused = pools.reload_fleet("x", pool="nope")
+    assert not refused["ok"]
+    assert refused["error"].startswith("unknown_pool")
+
+
+def test_tenant_pools_rejects_colliding_worker_names():
+    a = _FakeSupervisor({"w0": "/tmp/a.sock"})
+    b = _FakeSupervisor({"w0": "/tmp/b.sock"})
+    with pytest.raises(ValueError, match="fleet-unique"):
+        TenantPools({"A": a, "B": b})
+
+
+# -- router: corpus-tag routing + the fingerprint fence ----------------
+
+
+def _two_pool_router(stub_pools, **kwargs):
+    sockets = {
+        "a0": stub_pools.spawn("a0", "fp-a-1"),
+        "b0": stub_pools.spawn("b0", "fp-b-1"),
+    }
+    router = Router(
+        sockets,
+        probe_interval_s=0.05,
+        request_timeout_s=5.0,
+        dispatch_wait_s=5.0,
+        pools={"a0": "A", "b0": "B"},
+        default_pool="A",
+        **kwargs,
+    )
+    router.set_corpus_route("A", "A")
+    router.set_corpus_route("B", "B")
+    router.set_corpus_route("fp-a-1", "A")
+    router.set_corpus_route("fp-b-1", "B")
+    return router
+
+
+def test_router_routes_tagged_rows_and_defaults_untagged(stub_pools):
+    with _two_pool_router(stub_pools) as router:
+        tagged_b = router.dispatch(
+            {"id": 1, "content": "x", "corpus": "B"}
+        )
+        assert tagged_b["worker"] == "b0"
+        assert tagged_b["corpus"] == "fp-b-1"
+        by_fp = router.dispatch(
+            {"id": 2, "content": "x", "corpus": "fp-a-1"}
+        )
+        assert by_fp["worker"] == "a0"
+        # untagged rows fall back to the default pool, never pool B
+        for i in range(4):
+            row = router.dispatch({"id": 10 + i, "content": "x"})
+            assert row["worker"] == "a0", row
+        unknown = router.dispatch(
+            {"id": 99, "content": "x", "corpus": "ghost"}
+        )
+        assert str(unknown.get("error", "")).startswith("unknown_corpus")
+
+
+def test_router_fingerprint_fence_blocks_wrong_corpus_rows(stub_pools):
+    """The cross-pool cache-fencing regression: arm pool A's fence
+    with a fingerprint its workers do NOT serve and every answer must
+    be withheld from the client (failed over until no_backend_available)
+    rather than delivered from the wrong corpus; disarming the fence
+    (the mid-roll window) readmits the pool."""
+    with _two_pool_router(stub_pools) as router:
+        router.set_pool_fingerprint("A", "fp-a-1")
+        router.set_pool_fingerprint("B", "fp-b-1")
+        ok = router.dispatch({"id": 1, "content": "x", "corpus": "A"})
+        assert ok["corpus"] == "fp-a-1"
+        # the pool "serves" a fingerprint its workers don't stamp:
+        # the stale row must never reach the client
+        router.set_pool_fingerprint("A", "fp-a-NEXT")
+        fenced = router.dispatch({"id": 2, "content": "x", "corpus": "A"})
+        assert "error" in fenced, fenced
+        assert "corpus fingerprint mismatch" in fenced["error"]
+        # pool B is untouched by A's fence
+        other = router.dispatch({"id": 3, "content": "x", "corpus": "B"})
+        assert other["corpus"] == "fp-b-1"
+        # disarm = the roll window: either fingerprint is admissible
+        router.set_pool_fingerprint("A", None)
+        rolled = router.dispatch({"id": 4, "content": "x", "corpus": "A"})
+        assert rolled["corpus"] == "fp-a-1"
+        assert router.pool_fingerprints().get("B") == "fp-b-1"
+
+
+# -- the edge's POST /corpus auth tiers --------------------------------
+
+
+def _read_response(reader):
+    status_line = reader.readline()
+    if not status_line:
+        return None
+    code = int(status_line.split(b" ")[1])
+    headers = {}
+    while True:
+        line = reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = line.decode().partition(":")
+        headers[name.strip().lower()] = value.strip()
+    n = int(headers.get("content-length", "0"))
+    body = reader.read(n) if n else b""
+    return code, headers, body
+
+
+def _post_corpus(port, token, payload: dict):
+    body = json.dumps(payload).encode()
+    lines = ["POST /corpus HTTP/1.1", "Host: edge"]
+    if token:
+        lines.append(f"Authorization: Bearer {token}")
+    lines.append(f"Content-Length: {len(body)}")
+    raw = ("\r\n".join(lines) + "\r\n\r\n").encode() + body
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    try:
+        sock.sendall(raw)
+        reader = sock.makefile("rb")
+        resp = _read_response(reader)
+        reader.close()
+        return resp
+    finally:
+        sock.close()
+
+
+def test_edge_corpus_auth_tiers(tmp_path):
+    sockets = {"a0": str(tmp_path / "a0.sock")}
+
+    def argv_for(name, sock):
+        return stub_argv(sock, name, "--fingerprint", "fp-a-1")
+
+    supervisor = Supervisor(
+        sockets, argv_for=argv_for,
+        env_for=lambda name, chips: worker_env(None, None),
+        probe_interval_s=0.1, backoff_base_s=0.1, backoff_max_s=1.0,
+    )
+    supervisor.start()
+    assert supervisor.wait_healthy(30.0)
+    router = Router(
+        sockets, supervisor=supervisor, probe_interval_s=0.1,
+        request_timeout_s=10.0, dispatch_wait_s=5.0, trace_sample=0.0,
+        pools={"a0": "acme"}, default_pool="acme",
+    )
+    router.start()
+    registry = TenantRegistry(str(tmp_path / "tenants.json"), create=True)
+    registry.set_tenant(Tenant("acme", "tok-acme", "fp-a-1"))
+
+    def validator(path):
+        raise ValueError("not a corpus artifact")
+
+    onboarder = CorpusOnboarder(
+        registry, TenantPools({"acme": supervisor}), router,
+        staging_dir=str(tmp_path / "staging"), validator=validator,
+    )
+    tokens = dict(registry.tokens())
+    tokens["tok-anon"] = "anon"
+    edge = HttpEdgeServer(
+        "127.0.0.1:0", router, tokens=tokens, tenancy=onboarder,
+        rate_per_client=10000.0, stall_timeout_s=1.0,
+    )
+    thread = threading.Thread(
+        target=edge.serve_forever, kwargs={"poll_interval": 0.05},
+        daemon=True,
+    )
+    thread.start()
+    blob = base64.b64encode(b"garbage").decode("ascii")
+    try:
+        code, _, body = _post_corpus(
+            edge.bound_port, "tok-wrong", {"artifact_b64": blob}
+        )
+        assert code == 401
+        # a VALID token bound to no registry tenant: authenticated but
+        # not a tenant — 403, not 401
+        code, _, body = _post_corpus(
+            edge.bound_port, "tok-anon", {"artifact_b64": blob}
+        )
+        assert code == 403
+        assert json.loads(body)["error"].startswith("unknown_tenant")
+        # the tenant's own token with a garbage artifact: the validator
+        # rejects it before any fleet roll
+        code, _, body = _post_corpus(
+            edge.bound_port, "tok-acme", {"artifact_b64": blob}
+        )
+        assert code == 400
+        assert json.loads(body)["error"].startswith("corpus_invalid")
+        # token -> tenant -> pool resolution, the classify path's key
+        assert onboarder.pool_for_client("acme") == "acme"
+        assert onboarder.pool_for_client("anon") is None
+    finally:
+        edge.shutdown()
+        edge.server_close()
+        thread.join(timeout=5.0)
+        router.close()
+        supervisor.stop()
+        registry.close()
+
+
+def test_onboarder_rejects_unknown_tenant_upload(tmp_path):
+    registry = TenantRegistry(str(tmp_path / "tenants.json"), create=True)
+    try:
+        onboarder = CorpusOnboarder(
+            registry,
+            TenantPools({"p": _FakeSupervisor({"w0": "/tmp/w0.sock"})}),
+            router=None,
+            staging_dir=str(tmp_path / "staging"),
+        )
+        with pytest.raises(OnboardError) as exc:
+            onboarder.upload("ghost", b"bytes")
+        assert exc.value.code == "unknown_tenant"
+    finally:
+        registry.close()
