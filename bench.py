@@ -1035,6 +1035,100 @@ def bench_ingest(n_files: int = 4096) -> dict:
         ) as f:
             striped["container_rows"] = sum(1 for _ in f)
         row["striped"] = striped
+
+        # -- the remote block (ingest/remote.py): the SAME tarball
+        # served over a loopback HTTP host.  Two rungs: (1) at zero
+        # injected latency the full BatchProject pipeline over the
+        # URL — the acceptance shape wants remote within 25% of the
+        # local tar rate, sha256-identical; (2) with ~20 ms injected
+        # per-request latency, a raw read_at sweep of the ranged path
+        # at readahead=8 vs readahead=1 — the prefetch window must
+        # hold >= 3x the serial throughput (proving the pipelined
+        # requests actually overlap the RTT), sha256 gate on both.
+        from licensee_tpu.ingest.loopback import LoopbackBlobHost
+        from licensee_tpu.ingest.sources import expand_manifest
+
+        with open(tar, "rb") as f:
+            tar_bytes = f.read()
+        remote: dict = {}
+        with LoopbackBlobHost({"archive.tar": tar_bytes}) as host:
+            out = os.path.join(tmpdir, "remote-tar.jsonl")
+            project = BatchProject(
+                [host.url("archive.tar") + "::*"], batch_size=1024
+            )
+            try:
+                stats = project.run(out, resume=False)
+            finally:
+                project.close()
+            elapsed = stats.stage_seconds.get("elapsed", 0.0) or 1e-9
+            remote["tar_files_per_sec"] = round(n_files / elapsed, 1)
+            remote["vs_local_tar"] = round(
+                remote["tar_files_per_sec"] / row["tar_files_per_sec"],
+                3,
+            )
+            with open(out, "rb") as f:
+                remote["identical_output"] = (
+                    hashlib.sha256(f.read()).hexdigest()
+                    == digests["tar"]
+                )
+            remote["requests"] = host.hits.get("archive.tar")
+
+        # rung 2: RTT-dominated regime.  A smaller coalesce span keeps
+        # the request count meaningful (the default 1 MiB would fold
+        # the whole span into a handful of reads and price nothing);
+        # the span restricts to 1024 blobs so the serial baseline
+        # stays affordable.
+        lat_s = 0.02
+        span = min(1024, n_files)
+        knob_env = {
+            "LICENSEE_TPU_REMOTE_COALESCE_KB": "8",
+        }
+        saved = {
+            k: os.environ.get(k)
+            for k in (*knob_env, "LICENSEE_TPU_REMOTE_READAHEAD")
+        }
+        remote["latency_ms"] = round(lat_s * 1000)
+        try:
+            os.environ.update(knob_env)
+            lat_digests = {}
+            for ra in (8, 1):
+                os.environ["LICENSEE_TPU_REMOTE_READAHEAD"] = str(ra)
+                with LoopbackBlobHost(
+                    {"archive.tar": tar_bytes}, latency_s=lat_s
+                ) as host:
+                    ex = expand_manifest(
+                        [host.url("archive.tar") + "::*"]
+                    )
+                    try:
+                        ex.restrict(0, span)
+                        digest = hashlib.sha256()
+                        t0 = time.perf_counter()
+                        for i in range(span):
+                            digest.update(ex.read_at(i) or b"")
+                        dt = time.perf_counter() - t0
+                    finally:
+                        ex.close()
+                    lat_digests[ra] = digest.hexdigest()
+                    key = (
+                        "pipelined_files_per_sec" if ra == 8
+                        else "serial_files_per_sec"
+                    )
+                    remote[key] = round(span / max(dt, 1e-9), 1)
+            remote["pipeline_x"] = round(
+                remote["pipelined_files_per_sec"]
+                / max(remote["serial_files_per_sec"], 1e-9),
+                2,
+            )
+            remote["identical_latency"] = (
+                lat_digests[8] == lat_digests[1]
+            )
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        row["remote"] = remote
         return row
 
 
@@ -2438,8 +2532,9 @@ def bench_tsdb(n_requests: int = 6000) -> dict:
 # headline as a FILE, so the stdout window is no longer load-bearing.
 # Re-pinned 1800 -> 1850 when the striped_* ingest keys joined (PR 15),
 # 1850 -> 1980 when the durable-jobs block joined (PR 16),
-# 2080 -> 2200 when the multi-tenant block joined (PR 19).
-HEADLINE_BYTE_BUDGET = 2200
+# 2080 -> 2200 when the multi-tenant block joined (PR 19),
+# 2200 -> 2290 when the remote-ingest keys joined (PR 20).
+HEADLINE_BYTE_BUDGET = 2290
 
 # the driver-facing headline artifact, written UNCONDITIONALLY by
 # main() (fast mode included) so a skipped or truncated stdout capture
@@ -2529,6 +2624,10 @@ FLEET_HEADLINE_KEYS = (
 INGEST_HEADLINE_KEYS = (
     "tar_files_per_sec", "vs_loose", "identical_output",
     "striped_identical", "striped_vs_loose",
+    # PR 20: the remote-source gate — loopback-HTTP tar vs local tar
+    # (sha256-identical, rate ratio), and the injected-latency
+    # prefetch-pipelining multiple (readahead=8 over readahead=1)
+    "remote_vs_local", "remote_identical", "remote_pipeline_x",
 )
 
 # the headline's durable-jobs block — fast mode stamps exactly this
@@ -2751,6 +2850,19 @@ def make_headline(
                     "striped_vs_loose": (
                         ingest.get("striped") or {}
                     ).get("vs_loose_striping"),
+                    # the remote-source gate (full row:
+                    # details.ingest.remote): loopback-HTTP tar rate
+                    # vs local tar, sha256-identical, and the
+                    # injected-latency pipelining multiple
+                    "remote_vs_local": (
+                        ingest.get("remote") or {}
+                    ).get("vs_local_tar"),
+                    "remote_identical": (
+                        ingest.get("remote") or {}
+                    ).get("identical_output"),
+                    "remote_pipeline_x": (
+                        ingest.get("remote") or {}
+                    ).get("pipeline_x"),
                 }
             ),
             # edge-submitted durable jobs priced against the direct

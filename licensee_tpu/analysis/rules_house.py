@@ -29,12 +29,19 @@ WALLCLOCK_DIRS = (
     "licensee_tpu/fleet",
     "licensee_tpu/jobs",
     "licensee_tpu/parallel/stripes",
+    # remote ingest: retry backoff timing must survive clock steps
+    # (file-precise gates — ingest/verdict.py has a legitimate stdout
+    # print mode, so the whole package is NOT opted in)
+    "licensee_tpu/ingest/remote",
+    "licensee_tpu/ingest/loopback",
 )
 NO_PRINT_DIRS = (
     "licensee_tpu/obs",
     "licensee_tpu/fleet",
     "licensee_tpu/jobs",
     "licensee_tpu/parallel/stripes",
+    "licensee_tpu/ingest/remote",
+    "licensee_tpu/ingest/loopback",
 )
 PER_BLOB_DIRS = (
     "licensee_tpu/projects",

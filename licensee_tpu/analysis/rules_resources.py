@@ -48,6 +48,11 @@ RESOURCE_FACTORIES = {
     "socket.socket": "socket",
     "socket.create_connection": "socket",
     "subprocess.Popen": "child process handle",
+    # remote ingest (ingest/remote.py) dials these; a pooled
+    # keep-alive connection that escapes its release/discard path is a
+    # leaked socket just the same
+    "http.client.HTTPConnection": "http connection",
+    "http.client.HTTPSConnection": "http connection",
 }
 
 CLOSE_METHODS = {

@@ -727,6 +727,10 @@ def cmd_batch_detect(args) -> int:
         from licensee_tpu.parallel.stripes import selftest_autoscale
 
         return selftest_autoscale()
+    if args.selftest_remote:
+        from licensee_tpu.parallel.stripes import selftest_remote
+
+        return selftest_remote()
     if not args.manifest:
         print(
             "error: need a manifest (one path per line), or --selftest",
@@ -2651,6 +2655,15 @@ def build_parser() -> argparse.ArgumentParser:
             "drain/respawn/resume machinery: a saturated featurize lane "
             "must scale up, an idle one back down, and the merged "
             "output must stay bit-identical) and exit 0/1"
+        ),
+    )
+    batch.add_argument(
+        "--selftest-remote", action="store_true",
+        help=(
+            "Run the remote-ingest drill (a loopback HTTP host serves "
+            "a tar + zip with one scripted 503-then-recover and one "
+            "mid-stream truncation; remote scans and a 2-stripe merge "
+            "must be bit-identical to local disk) and exit 0/1"
         ),
     )
     batch.add_argument("--stats", action="store_true",

@@ -1,0 +1,1255 @@
+"""Remote HTTP(S) blob sources: scan release artifacts where they live.
+
+Manifest entries may address containers by URL through the same ``::``
+grammar as local paths (``https://host/release.tar.gz::*``,
+``https://host/src.zip::member``) — members stream straight off the
+forge into the featurize lane, the container never lands on disk:
+
+* **zip** — central directory via zipfile over a ranged-window file
+  view (a tail read plus whatever blocks the directory spans), then
+  per-member ranged GETs of the local record span, decompressed and
+  CRC-checked on the host.
+* **uncompressed tar** — one ranged metadata scan (tarfile walks the
+  512-byte headers through the same block view, seeking past data),
+  then per-member ranged GETs by ``offset_data``.
+* **compressed tar** (``.tar.gz`` and friends) — no random access
+  exists inside the stream, so metadata and reads ride forward-only
+  streaming GETs through the PR 15 sequential-window reader (one
+  stream per stripe span, wanted members cached as the walk passes).
+
+The perf core is a **pipelined prefetch window**: the expansion's
+``want()`` registrations give each container its span's read schedule
+up front, adjacent small members **coalesce** into one ranged read
+(split on the host — a thousand tiny LICENSE files must not pay a
+thousand round trips), and a bounded window of coalesced requests is
+kept in flight over keep-alive connection pools so per-request RTT
+hides behind featurize instead of serializing with it
+(``details.ingest.remote`` in bench.py prices this with injected
+latency).  Knobs (env): ``LICENSEE_TPU_REMOTE_READAHEAD`` (in-flight
+requests, default 8; 1 = no overlap), ``LICENSEE_TPU_REMOTE_COALESCE_KB``
+(max coalesced span, default 1024), ``LICENSEE_TPU_REMOTE_GAP_KB``
+(max dead bytes fetched between coalesced members, default 16).
+
+The failure model is part of the contract:
+
+* **retry/backoff budget** — timeouts, connection drops, torn bodies
+  (fewer bytes than Content-Length), and 5xx answers retry with
+  capped exponential backoff on a monotonic clock, bounded per read
+  (``LICENSEE_TPU_REMOTE_RETRIES``, default 4) and cumulatively per
+  container (``LICENSEE_TPU_REMOTE_RETRY_CAP``, default 64); budget
+  exhaustion raises :class:`RemoteRetryBudgetError` — the container
+  fails CLOSED like a torn gzip, never a silent partial scan.
+* **mid-job rewrite fencing** — ETag/Last-Modified/Content-Length are
+  captured at expansion, folded into the expansion fingerprint (so a
+  republished artifact refuses to RESUME via the existing sidecar
+  check), and re-validated on every read: ranged GETs carry
+  ``If-Range`` (a changed artifact answers 200-full-body, detected and
+  refused), streaming GETs carry ``If-Match`` (412 on change) — a
+  republish mid-job raises :class:`RemoteChangedError` instead of
+  mixing old rows with new bytes.
+* **submit-time probing** — :func:`probe_remote` is the cheap
+  HEAD + 1-byte ranged GET the jobs tier runs at ``validate_spec``
+  time, so an unreachable URL or a server without Range support is a
+  400 at submit, not a mid-job stripe crash.
+
+Expansion stays deterministic and metadata-only, so everything
+downstream is unchanged: expanded-count striping splits a remote
+million-member tarball across ``--stripes`` × hosts ×
+``--featurize-procs`` exactly like a local one, and the picklable
+descriptor re-opens remote readers (fresh probes, fresh pools) in
+every worker process, fingerprint-gated against a mid-job republish.
+
+git-over-HTTP is refused at expansion (publish a tar/zip artifact);
+object-store schemes can join behind the same seam later.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+
+from licensee_tpu.ingest import OVERSIZED, SkippedBlob
+from licensee_tpu.ingest.sources import (
+    _COMPRESSED_TAR_SUFFIXES,
+    _SeqTarContainer,
+    IngestError,
+)
+from licensee_tpu.projects.git_project import MAX_LICENSE_SIZE
+
+
+class RemoteError(IngestError):
+    """A remote container that cannot be fetched safely."""
+
+
+class RemoteProbeError(RemoteError):
+    """The submit-time probe failed: unreachable, non-2xx, or the
+    server cannot answer byte-range requests for a ranged kind."""
+
+
+class RemoteChangedError(RemoteError):
+    """The artifact changed under a running job (ETag/Last-Modified/
+    Content-Length no longer match what expansion captured) — the scan
+    refuses to mix bytes from two publishes."""
+
+
+class RemoteRetryBudgetError(RemoteError):
+    """The per-read or per-container retry budget is exhausted — the
+    container fails closed like a torn local archive."""
+
+
+class _Transient(Exception):
+    """Internal: a retryable fetch failure (timeout, dropped
+    connection, torn body, 5xx)."""
+
+
+# -- knobs (read once per container, overridable per instance) --------
+
+def _env_int(name: str, default: int, lo: int = 0) -> int:
+    try:
+        return max(lo, int(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+def _knobs() -> dict:
+    return {
+        "readahead": _env_int("LICENSEE_TPU_REMOTE_READAHEAD", 8, lo=1),
+        "coalesce_bytes": _env_int(
+            "LICENSEE_TPU_REMOTE_COALESCE_KB", 1024, lo=1
+        ) * 1024,
+        "coalesce_gap": _env_int(
+            "LICENSEE_TPU_REMOTE_GAP_KB", 16, lo=0
+        ) * 1024,
+        "retries": _env_int("LICENSEE_TPU_REMOTE_RETRIES", 4),
+        "retry_cap": _env_int("LICENSEE_TPU_REMOTE_RETRY_CAP", 64),
+        "backoff_ms": _env_int("LICENSEE_TPU_REMOTE_BACKOFF_MS", 100),
+        "timeout_s": _env_int("LICENSEE_TPU_REMOTE_TIMEOUT_S", 20, lo=1),
+    }
+
+
+# -- metrics (lazy: the registry import stays off the manifest-scan
+# path until a remote container actually opens) -----------------------
+
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        with _METRICS_LOCK:
+            if _METRICS is None:
+                from licensee_tpu.obs import get_registry
+
+                reg = get_registry()
+                _METRICS = {
+                    "requests": reg.counter(
+                        "ingest_remote_requests_total",
+                        "Remote-source HTTP requests by kind "
+                        "(ranged/stream/probe)",
+                        labels=("kind",),
+                    ),
+                    "retries": reg.counter(
+                        "ingest_remote_retries_total",
+                        "Remote fetches retried after a transient "
+                        "failure (timeout, drop, torn body, 5xx)",
+                    ),
+                    "bytes": reg.counter(
+                        "ingest_remote_bytes_total",
+                        "Response body bytes fetched from remote "
+                        "sources",
+                    ),
+                    "readahead": reg.gauge(
+                        "ingest_remote_readahead",
+                        "Prefetch-window occupancy: coalesced ranged "
+                        "reads currently in flight",
+                    ),
+                }
+    return _METRICS
+
+
+def _split_url(url: str):
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url)
+    scheme = parts.scheme.lower()
+    if scheme not in ("http", "https") or not parts.hostname:
+        raise RemoteError(f"unsupported remote url {url!r}")
+    port = parts.port or (443 if scheme == "https" else 80)
+    target = parts.path or "/"
+    if parts.query:
+        target = f"{target}?{parts.query}"
+    return scheme, parts.hostname, port, target
+
+
+def remote_entry_kind(container: str) -> str | None:
+    """The remote container kind for a manifest container path, or
+    None when it is not an HTTP(S) URL: ``rtar`` (ranged uncompressed
+    tar), ``rctar`` (streaming compressed tar), ``rzip`` (ranged zip),
+    ``rgit`` (recognized but refused)."""
+    low = container.lower()
+    if not (low.startswith("http://") or low.startswith("https://")):
+        return None
+    base = low.split("?", 1)[0].split("#", 1)[0]
+    if base.endswith(_COMPRESSED_TAR_SUFFIXES):
+        return "rctar"
+    if base.endswith(".tar"):
+        return "rtar"
+    if base.endswith(".zip"):
+        return "rzip"
+    if base.endswith(".git"):
+        return "rgit"
+    return None
+
+
+# -- connection pool ---------------------------------------------------
+
+
+class _HostPool:
+    """A small bounded pool of keep-alive connections to one origin.
+    ``acquire`` hands out a parked connection (or dials a fresh one);
+    ``release`` parks it for reuse; ``discard`` closes it.  Every
+    caller must do exactly one of release/discard on every path."""
+
+    def __init__(self, scheme: str, host: str, port: int,
+                 timeout_s: float, size: int = 8):
+        self._scheme = scheme
+        self._host = host
+        self._port = port
+        self._timeout_s = timeout_s
+        self._size = size
+        self._idle: list = []
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _dial(self):
+        import http.client
+
+        if self._scheme == "https":
+            return http.client.HTTPSConnection(
+                self._host, self._port, timeout=self._timeout_s
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self._timeout_s
+        )
+
+    def acquire(self) -> tuple:
+        """``(conn, parked)`` — parked connections may be stale (the
+        server closed an idle keep-alive); a request failure on a
+        parked connection earns one free fresh-dial retry."""
+        with self._lock:
+            if self._idle:
+                return self._idle.pop(), True
+        return self._dial(), False
+
+    def release(self, conn) -> None:
+        with self._lock:
+            if not self._closed and len(self._idle) < self._size:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def discard(self, conn) -> None:
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+            self._closed = True
+        for conn in idle:
+            conn.close()
+
+
+# -- the one remote artifact -------------------------------------------
+
+
+class _RemoteSource:
+    """One remote artifact: validators captured at open, a keep-alive
+    pool, the retry/backoff budget, and the fetch primitives the
+    container readers share."""
+
+    def __init__(self, url: str, *, require_range: bool, knobs=None):
+        self.url = url
+        k = knobs or _knobs()
+        self.retries = k["retries"]
+        self.retry_cap = k["retry_cap"]
+        self.backoff_s = k["backoff_ms"] / 1000.0
+        self.backoff_cap_s = min(30.0, max(self.backoff_s, 1.0) * 16)
+        self.timeout_s = float(k["timeout_s"])
+        self.readahead = k["readahead"]
+        self.coalesce_bytes = k["coalesce_bytes"]
+        self.coalesce_gap = k["coalesce_gap"]
+        scheme, host, port, target = _split_url(url)
+        self._target = target
+        self.pool = _HostPool(
+            scheme, host, port, self.timeout_s,
+            size=max(2, self.readahead),
+        )
+        self._retries_used = 0
+        self._lock = threading.Lock()
+        info = self._probe(require_range=require_range)
+        self.size = info["size"]
+        self.etag = info["etag"]
+        self.last_modified = info["last_modified"]
+
+    # -- plumbing ------------------------------------------------------
+
+    def _request_once(self, method: str, headers: dict, kind: str):
+        """One request/response over the pool; answers
+        ``(status, header_dict, body)`` with the body fully read and
+        the connection parked for reuse.  A stale parked keep-alive
+        (dies before the status line) earns one free fresh dial."""
+        import http.client
+        import socket
+
+        for attempt in (0, 1):
+            conn, parked = self.pool.acquire()
+            try:
+                conn.request(method, self._target, headers=headers)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError) as exc:
+                self.pool.discard(conn)
+                if parked and attempt == 0:
+                    continue  # free retry: the park was stale
+                raise _Transient(f"{method} {self.url}: {exc}") from exc
+            try:
+                try:
+                    body = resp.read()
+                except (
+                    http.client.HTTPException, socket.timeout, OSError,
+                ) as exc:
+                    self.pool.discard(conn)
+                    conn = None
+                    raise _Transient(
+                        f"{method} {self.url}: body: {exc}"
+                    ) from exc
+                hdrs = {k.lower(): v for k, v in resp.getheaders()}
+                clen = hdrs.get("content-length")
+                if (
+                    method != "HEAD" and clen is not None
+                    and clen.isdigit() and len(body) != int(clen)
+                ):
+                    # a torn body the transport did not catch
+                    self.pool.discard(conn)
+                    conn = None
+                    raise _Transient(
+                        f"{method} {self.url}: torn body "
+                        f"({len(body)} of {clen} bytes)"
+                    )
+            finally:
+                if conn is not None:
+                    if resp.will_close:
+                        self.pool.discard(conn)
+                    else:
+                        self.pool.release(conn)
+            m = _metrics()
+            m["requests"].labels(kind=kind).inc()
+            m["bytes"].inc(len(body))
+            return resp.status, hdrs, body
+        raise AssertionError("unreachable")
+
+    def _with_retries(self, fn, what: str):
+        """Capped exponential backoff on a monotonic clock, bounded
+        per read AND cumulatively per container; exhaustion fails the
+        container closed."""
+        attempt = 0
+        delay = self.backoff_s
+        deadline = time.monotonic() + self.timeout_s * (self.retries + 2)
+        while True:
+            try:
+                return fn()
+            except _Transient as exc:
+                attempt += 1
+                with self._lock:
+                    self._retries_used += 1
+                    used = self._retries_used
+                _metrics()["retries"].inc()
+                if (
+                    attempt > self.retries
+                    or used > self.retry_cap
+                    or time.monotonic() > deadline
+                ):
+                    raise RemoteRetryBudgetError(
+                        f"{what}: retry budget exhausted after "
+                        f"{attempt - 1} retries "
+                        f"({used}/{self.retry_cap} container-wide): "
+                        f"{exc}"
+                    ) from exc
+                time.sleep(delay)
+                delay = min(delay * 2, self.backoff_cap_s)
+
+    def _probe(self, require_range: bool) -> dict:
+        """HEAD for reachability + validators, then a 1-byte ranged
+        GET when the kind needs random access — a server that ignores
+        Range (200) is refused HERE, not mid-job."""
+
+        def head():
+            status, hdrs, _ = self._request_once("HEAD", {}, "probe")
+            if status in (500, 502, 503, 504):
+                raise _Transient(f"HEAD {self.url}: {status}")
+            return status, hdrs
+
+        status, hdrs = self._with_retries(head, f"probe {self.url}")
+        if status != 200:
+            raise RemoteProbeError(
+                f"remote source {self.url!r} answered {status} to HEAD"
+            )
+        clen = hdrs.get("content-length")
+        size = int(clen) if clen is not None and clen.isdigit() else None
+        info = {
+            "size": size,
+            "etag": hdrs.get("etag"),
+            "last_modified": hdrs.get("last-modified"),
+            "accept_ranges": "bytes" in hdrs.get("accept-ranges", ""),
+        }
+        if require_range:
+            if size is None:
+                raise RemoteProbeError(
+                    f"remote source {self.url!r} sends no "
+                    "Content-Length; ranged reads need the size"
+                )
+
+            def probe_range():
+                s, h, _ = self._request_once(
+                    "GET", {"Range": "bytes=0-0"}, "probe"
+                )
+                if s in (500, 502, 503, 504):
+                    raise _Transient(f"GET {self.url}: {s}")
+                return s, h
+
+            s, _h = self._with_retries(
+                probe_range, f"range-probe {self.url}"
+            )
+            if s != 206:
+                raise RemoteProbeError(
+                    f"remote source {self.url!r} does not honor byte "
+                    f"ranges (answered {s} to a 1-byte Range GET)"
+                )
+        return info
+
+    def validators_evidence(self) -> str:
+        """The fencing facts the expansion fingerprint folds in: a
+        republished artifact (new ETag / Last-Modified / size) changes
+        the fingerprint, so a resumed run REFUSES via the existing
+        sidecar check before any row is written."""
+        return (
+            f"{self.url}:{self.size}:{self.etag or '-'}"
+            f":{self.last_modified or '-'}"
+        )
+
+    def _fence_headers(self) -> dict:
+        """``If-Range`` for ranged GETs: unchanged answers 206 as
+        asked; a republished artifact answers 200-full-body, which
+        :meth:`fetch_range` refuses as a change."""
+        validator = self.etag or self.last_modified
+        return {"If-Range": validator} if validator else {}
+
+    def fetch_range(self, offset: int, length: int,
+                    kind: str = "ranged") -> bytes:
+        """One ranged read with the full contract: retry budget,
+        If-Range fencing, exact-length and validator re-checks."""
+        if length <= 0:
+            return b""
+        end = offset + length - 1
+
+        def attempt() -> bytes:
+            headers = {"Range": f"bytes={offset}-{end}"}
+            headers.update(self._fence_headers())
+            status, hdrs, body = self._request_once(
+                "GET", headers, kind
+            )
+            if status in (500, 502, 503, 504):
+                raise _Transient(f"GET {self.url}: {status}")
+            if status == 200:
+                # If-Range mismatch: the server fell back to the full
+                # (new) representation — the artifact was republished
+                raise RemoteChangedError(
+                    f"remote source {self.url!r} changed under a "
+                    "running job (If-Range fence answered 200)"
+                )
+            if status != 206:
+                raise RemoteError(
+                    f"remote source {self.url!r} answered {status} to "
+                    f"a ranged GET"
+                )
+            etag = hdrs.get("etag")
+            if self.etag and etag and etag != self.etag:
+                raise RemoteChangedError(
+                    f"remote source {self.url!r} changed under a "
+                    f"running job (ETag {self.etag} -> {etag})"
+                )
+            if len(body) != length:
+                raise _Transient(
+                    f"GET {self.url}: ranged body {len(body)} bytes, "
+                    f"want {length}"
+                )
+            return body
+
+        return self._with_retries(
+            attempt, f"ranged read {self.url}@{offset}+{length}"
+        )
+
+    def open_stream(self):
+        """A forward-only full-body GET on a DEDICATED connection
+        (never pooled: an abandoned stream cannot be reused), fenced
+        with ``If-Match`` so a mid-job republish answers 412 instead
+        of new bytes.  Answers a file-like whose ``read`` raises
+        ``OSError`` on transport failure (the sequential-window
+        reader's row-contained contract) and whose ``close`` closes
+        the connection on every path."""
+        import http.client
+        import socket
+
+        def attempt():
+            conn = self.pool._dial()
+            try:
+                headers = {}
+                if self.etag:
+                    headers["If-Match"] = self.etag
+                conn.request("GET", self._target, headers=headers)
+                resp = conn.getresponse()
+                status = resp.status
+                hdrs = {k.lower(): v for k, v in resp.getheaders()}
+            except (http.client.HTTPException, OSError) as exc:
+                conn.close()
+                raise _Transient(f"GET {self.url}: {exc}") from exc
+            try:
+                if status in (500, 502, 503, 504):
+                    raise _Transient(f"GET {self.url}: {status}")
+                if status == 412:
+                    raise RemoteChangedError(
+                        f"remote source {self.url!r} changed under a "
+                        "running job (If-Match fence answered 412)"
+                    )
+                if status != 200:
+                    raise RemoteError(
+                        f"remote source {self.url!r} answered "
+                        f"{status} to a streaming GET"
+                    )
+                for got, want, what in (
+                    (hdrs.get("etag"), self.etag, "ETag"),
+                    (
+                        hdrs.get("last-modified"), self.last_modified,
+                        "Last-Modified",
+                    ),
+                ):
+                    if want and got and got != want:
+                        raise RemoteChangedError(
+                            f"remote source {self.url!r} changed "
+                            f"under a running job ({what} {want} -> "
+                            f"{got})"
+                        )
+                clen = hdrs.get("content-length")
+                if (
+                    self.size is not None and clen is not None
+                    and clen.isdigit() and int(clen) != self.size
+                ):
+                    raise RemoteChangedError(
+                        f"remote source {self.url!r} changed under a "
+                        f"running job (size {self.size} -> {clen})"
+                    )
+            except BaseException:
+                conn.close()
+                raise
+            m = _metrics()
+            m["requests"].labels(kind="stream").inc()
+            return _StreamBody(conn, resp, m["bytes"], socket.timeout)
+
+        return self._with_retries(attempt, f"stream {self.url}")
+
+    def note_transient(self, what: str) -> None:
+        """Budget accounting for retries driven OUTSIDE
+        :meth:`_with_retries` (the sequential-window reader's
+        row-contained torn-stream retries)."""
+        with self._lock:
+            self._retries_used += 1
+            used = self._retries_used
+        _metrics()["retries"].inc()
+        if used > self.retry_cap:
+            raise RemoteRetryBudgetError(
+                f"{what}: container retry budget exhausted "
+                f"({used}/{self.retry_cap})"
+            )
+
+    def close(self) -> None:
+        self.pool.close()
+
+
+class _StreamBody:
+    """The streaming GET's body: reads count into the bytes counter,
+    transport failures surface as OSError (what the sequential-window
+    reader treats as a torn stream), close closes the connection."""
+
+    def __init__(self, conn, resp, bytes_counter, timeout_exc):
+        self._conn = conn
+        self._resp = resp
+        self._bytes = bytes_counter
+        self._timeout_exc = timeout_exc
+
+    def read(self, n: int = -1) -> bytes:
+        import http.client
+
+        try:
+            data = self._resp.read() if n is None or n < 0 else (
+                self._resp.read(n)
+            )
+        except (http.client.HTTPException, self._timeout_exc) as exc:
+            raise OSError(f"remote stream failed: {exc}") from exc
+        self._bytes.inc(len(data))
+        return data
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class _RangedFile:
+    """A seekable read-only file view over ranged GETs, for the
+    stdlib parsers that do the metadata work (tarfile header walk,
+    zipfile central directory): block-aligned fetches with a tiny LRU
+    so a forward header scan — or zipfile's tail-first directory read
+    — costs one request per 256 KiB touched, not one per ``read``."""
+
+    block = 256 << 10
+    cached_blocks = 4
+
+    def __init__(self, source: _RemoteSource):
+        if source.size is None:
+            raise RemoteError(
+                f"remote source {source.url!r} sends no Content-Length"
+            )
+        self._source = source
+        self._size = source.size
+        self._pos = 0
+        self._blocks: dict[int, bytes] = {}
+
+    def _block(self, idx: int) -> bytes:
+        data = self._blocks.pop(idx, None)
+        if data is None:
+            offset = idx * self.block
+            length = min(self.block, self._size - offset)
+            data = self._source.fetch_range(offset, length)
+        self._blocks[idx] = data  # re-insert: LRU order
+        while len(self._blocks) > self.cached_blocks:
+            self._blocks.pop(next(iter(self._blocks)))
+        return data
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            n = self._size - self._pos
+        n = min(n, self._size - self._pos)
+        out = []
+        while n > 0:
+            idx, off = divmod(self._pos, self.block)
+            chunk = self._block(idx)[off:off + n]
+            if not chunk:
+                break
+            out.append(chunk)
+            self._pos += len(chunk)
+            n -= len(chunk)
+        return b"".join(out)
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == 1:
+            offset += self._pos
+        elif whence == 2:
+            offset += self._size
+        self._pos = max(0, min(offset, self._size))
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def seekable(self) -> bool:
+        return True
+
+    def readable(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        self._blocks.clear()
+
+
+# -- the pipelined prefetch window ------------------------------------
+
+
+class _Group:
+    """One coalesced ranged read: ``[offset, offset+length)`` covering
+    ``members`` = [(name, rel_offset)] slices."""
+
+    __slots__ = ("offset", "length", "members", "pending", "state")
+
+    def __init__(self, offset: int, length: int):
+        self.offset = offset
+        self.length = length
+        self.members: list[tuple[str, int]] = []
+        self.pending = 0
+        self.state = "new"  # new | inflight | ready | failed
+
+
+class _RangedPrefetcher:
+    """The readahead window shared by the ranged containers (tar +
+    zip).  The expansion's ``want()`` calls build the read plan; reads
+    pump a bounded window of coalesced ranged requests through a small
+    thread pool so the next blobs are already in flight while the
+    featurize lane consumes the current ones.  ``readahead=1``
+    degrades to strictly serial requests (the bench's baseline rung).
+
+    Window discipline: a group occupies a slot from schedule until its
+    LAST member is consumed, so buffered-but-unread bytes stay bounded
+    by ``readahead × coalesce_bytes`` no matter how far the reader
+    falls behind.  Reads outside the plan (duplicate explicit entries,
+    out-of-contract orders) fetch directly — correct, just not
+    prefetched."""
+
+    def __init__(self, source: _RemoteSource, span_of, extract):
+        # span_of(name) -> (offset, length) byte span to fetch;
+        # extract(group, raw) -> {name: bytes | None}
+        self._source = source
+        self._span_of = span_of
+        self._extract = extract
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._plan: list[str] = []
+        self._planned: set[str] = set()
+        self._groups: list[_Group] | None = None
+        self._group_of: dict[str, int] = {}
+        self._ready: dict[str, object] = {}
+        self._errors: dict[int, BaseException] = {}
+        self._next = 0
+        self._occupied = 0
+        self._inflight = 0
+        self._pool = None
+        self._closed = False
+
+    def want(self, name: str) -> None:
+        with self._lock:
+            if name not in self._planned:
+                self._planned.add(name)
+                self._plan.append(name)
+            self._groups = None  # rebuild lazily at next read
+
+    def reset(self) -> None:
+        with self._lock:
+            self._plan = []
+            self._planned = set()
+            self._groups = None
+            self._ready.clear()
+            self._errors.clear()
+
+    def _build_groups_locked(self) -> None:
+        src = self._source
+        groups: list[_Group] = []
+        self._group_of = {}
+        cur: _Group | None = None
+        for name in self._plan:
+            span = self._span_of(name)
+            if span is None:
+                continue
+            offset, length = span
+            end = offset + length
+            if (
+                cur is not None
+                and offset >= cur.offset + cur.length
+                and offset - (cur.offset + cur.length) <= src.coalesce_gap
+                and end - cur.offset <= src.coalesce_bytes
+            ):
+                cur.length = end - cur.offset
+            else:
+                cur = _Group(offset, length)
+                groups.append(cur)
+            cur.members.append((name, offset - cur.offset))
+            cur.pending += 1
+            self._group_of[name] = len(groups) - 1
+        self._groups = groups
+        self._next = 0
+        self._occupied = 0
+        self._inflight = 0
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(8, max(1, self._source.readahead)),
+                thread_name_prefix="remote-prefetch",
+            )
+        return self._pool
+
+    def _schedule_locked(self, gid: int) -> None:
+        group = self._groups[gid]
+        if group.state != "new":
+            return
+        group.state = "inflight"
+        self._occupied += 1
+        self._inflight += 1
+        _metrics()["readahead"].set(self._inflight)
+        self._ensure_pool().submit(self._fetch_group, gid, group)
+
+    def _pump_locked(self) -> None:
+        while (
+            self._next < len(self._groups)
+            and self._occupied < self._source.readahead
+        ):
+            gid = self._next
+            self._next += 1
+            self._schedule_locked(gid)
+
+    def _fetch_group(self, gid: int, group: _Group) -> None:
+        # the group rides in as an argument (captured under the lock
+        # at schedule time) so this worker thread never indexes the
+        # rebuildable _groups list off-lock
+        try:
+            raw = self._source.fetch_range(group.offset, group.length)
+            blobs = self._extract(group, raw)
+        except BaseException as exc:  # noqa: BLE001 — relayed to readers
+            with self._cond:
+                self._errors[gid] = exc
+                group.state = "failed"
+                self._inflight -= 1
+                _metrics()["readahead"].set(self._inflight)
+                self._cond.notify_all()
+            return
+        with self._cond:
+            self._ready.update(blobs)
+            group.state = "ready"
+            self._inflight -= 1
+            _metrics()["readahead"].set(self._inflight)
+            self._cond.notify_all()
+
+    def _consume_locked(self, name: str):
+        blob = self._ready.pop(name)
+        gid = self._group_of.get(name)
+        if gid is not None:
+            group = self._groups[gid]
+            group.pending -= 1
+            if group.pending <= 0:
+                self._occupied -= 1
+        self._pump_locked()
+        return blob
+
+    def read(self, name: str):
+        """The planned-read path: pop the prefetched blob, keeping the
+        window full; block on the group when the fetch is still in
+        flight; re-raise the group's failure (fail closed)."""
+        with self._cond:
+            if self._groups is None:
+                self._build_groups_locked()
+            if name in self._ready:
+                return self._consume_locked(name)
+            gid = self._group_of.get(name)
+            if gid is None:
+                # outside the plan: direct fetch, no window
+                span = self._span_of(name)
+            else:
+                self._pump_locked()
+                self._schedule_locked(gid)  # out-of-order: jump ahead
+                while True:
+                    if name in self._ready:
+                        return self._consume_locked(name)
+                    exc = self._errors.get(gid)
+                    if exc is not None:
+                        raise exc
+                    if self._groups[gid].state == "ready":
+                        # group landed but this name was consumed
+                        # already (duplicate manifest entry): fall
+                        # through to a direct fetch
+                        span = self._span_of(name)
+                        break
+                    self._cond.wait()
+        if span is None:
+            return None
+        group = _Group(span[0], span[1])
+        group.members.append((name, 0))
+        raw = self._source.fetch_range(group.offset, group.length)
+        return self._extract(group, raw).get(name)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pool = self._pool
+            self._pool = None
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
+
+
+# -- containers --------------------------------------------------------
+
+
+class _RemoteTarContainer:
+    """Uncompressed tar over HTTP: tarfile walks the member headers
+    through the ranged block view (metadata only — it seeks past
+    data), then per-member reads are ranged GETs by ``offset_data``
+    through the prefetch window."""
+
+    def __init__(self, url: str):
+        import tarfile
+
+        self.url = url
+        self._source = _RemoteSource(url, require_range=True)
+        try:
+            self._members: dict[str, tuple[int, int]] = {}
+            self._order: list[str] = []
+            self._evidence = [f"rtar:{self._source.validators_evidence()}"]
+            view = _RangedFile(self._source)
+            size = self._source.size
+            try:
+                with tarfile.open(fileobj=view, mode="r:") as tf:
+                    for info in tf:
+                        if not info.isreg():
+                            continue
+                        if info.offset_data + info.size > size:
+                            raise IngestError(
+                                f"torn remote archive {url!r}: member "
+                                f"{info.name!r} claims {info.size} "
+                                "bytes past end of artifact"
+                            )
+                        if info.name not in self._members:
+                            self._order.append(info.name)
+                        self._members[info.name] = (
+                            info.offset_data, info.size,
+                        )
+                        self._evidence.append(
+                            f"{info.name}@{info.offset_data}"
+                            f"+{info.size}:{info.mtime}:{info.chksum}"
+                        )
+            finally:
+                view.close()
+        except tarfile.TarError as exc:
+            self._source.close()
+            raise IngestError(
+                f"cannot read remote tar {url!r}: {exc}"
+            ) from exc
+        except BaseException:
+            self._source.close()
+            raise
+        self._prefetch = _RangedPrefetcher(
+            self._source, self._span_of, self._extract
+        )
+
+    def _span_of(self, name: str):
+        got = self._members.get(name)
+        if got is None or got[1] > MAX_LICENSE_SIZE:
+            return None
+        return got
+
+    def _extract(self, group: _Group, raw: bytes) -> dict:
+        out = {}
+        for name, rel in group.members:
+            size = self._members[name][1]
+            blob = raw[rel:rel + size]
+            out[name] = blob if len(blob) == size else None
+        return out
+
+    def members(self) -> list[str]:
+        return list(self._order)
+
+    def evidence(self) -> list[str]:
+        """URL validators (ETag/Last-Modified/size — the republish
+        fence) plus the same member table evidence as the local tar
+        reader (offset, size, mtime, header checksum)."""
+        return list(self._evidence)
+
+    def want(self, member: str) -> None:
+        if self._span_of(member) is not None:
+            self._prefetch.want(member)
+
+    def reset_wants(self) -> None:
+        self._prefetch.reset()
+
+    def read(self, member: str):
+        got = self._members.get(member)
+        if got is None:
+            return None  # a read_error row, like the local readers
+        if got[1] > MAX_LICENSE_SIZE:
+            return SkippedBlob(OVERSIZED)
+        return self._prefetch.read(member)
+
+    def close(self) -> None:
+        self._prefetch.close()
+        self._source.close()
+
+
+class _RemoteZipContainer:
+    """Zip over HTTP: zipfile parses the central directory through the
+    ranged block view (its tail-first reads hit the cached end
+    blocks), then per-member reads fetch the LOCAL RECORD span
+    ``[header_offset, next_header_offset)`` in one ranged GET —
+    coalesced with its neighbors — and inflate + CRC-check on the
+    host."""
+
+    def __init__(self, url: str):
+        import zipfile
+
+        self.url = url
+        self._source = _RemoteSource(url, require_range=True)
+        try:
+            view = _RangedFile(self._source)
+            try:
+                try:
+                    zf = zipfile.ZipFile(view)
+                except (zipfile.BadZipFile, OSError) as exc:
+                    raise IngestError(
+                        f"cannot read remote zip {url!r}: {exc}"
+                    ) from exc
+                with zf:
+                    infos = [i for i in zf.infolist() if not i.is_dir()]
+                    cd_start = zf.start_dir
+            finally:
+                view.close()
+        except BaseException:
+            self._source.close()
+            raise
+        # duplicate member names collapse to the archive's effective
+        # LAST copy, same semantics as the local reader
+        self._infos = {i.filename: i for i in infos}
+        self._order = list(
+            dict.fromkeys(i.filename for i in infos)
+        )
+        # each member's local record ends where the next local header
+        # (or the central directory) starts — the exact fetch bound,
+        # data descriptor included
+        starts = sorted(i.header_offset for i in infos)
+        next_start = {}
+        for a, b in zip(starts, starts[1:] + [cd_start]):
+            next_start[a] = b
+        self._spans = {
+            i.filename: (
+                i.header_offset,
+                max(0, next_start[i.header_offset] - i.header_offset),
+            )
+            for i in infos
+        }
+        self._prefetch = _RangedPrefetcher(
+            self._source, self._span_of, self._extract
+        )
+
+    def _span_of(self, name: str):
+        info = self._infos.get(name)
+        if info is None or info.file_size > MAX_LICENSE_SIZE:
+            return None
+        return self._spans[name]
+
+    def _extract(self, group: _Group, raw: bytes) -> dict:
+        out = {}
+        for name, rel in group.members:
+            info = self._infos[name]
+            span_len = self._spans[name][1]
+            out[name] = self._inflate(info, raw[rel:rel + span_len])
+        return out
+
+    def _inflate(self, info, record: bytes):
+        """Local header -> compressed slice -> plain bytes, CRC-gated;
+        malformed records are row-contained read errors, exactly like
+        a local zip member whose inflate fails."""
+        if len(record) < 30 or record[:4] != b"PK\x03\x04":
+            return None
+        fnlen = int.from_bytes(record[26:28], "little")
+        exlen = int.from_bytes(record[28:30], "little")
+        data = record[30 + fnlen + exlen:30 + fnlen + exlen
+                      + info.compress_size]
+        if len(data) != info.compress_size:
+            return None
+        if info.compress_type == 0:
+            blob = bytes(data)
+        elif info.compress_type == 8:
+            try:
+                d = zlib.decompressobj(-15)
+                blob = d.decompress(data) + d.flush()
+            except zlib.error:
+                return None
+        else:
+            return None  # an unsupported method is a read_error row
+        if len(blob) != info.file_size:
+            return None
+        if zlib.crc32(blob) & 0xFFFFFFFF != info.CRC:
+            return None
+        return blob
+
+    def members(self) -> list[str]:
+        return list(self._order)
+
+    def evidence(self) -> list[str]:
+        """URL validators plus the exact content evidence (member
+        CRC + size), same strength as the local zip reader."""
+        head = [f"rzip:{self._source.validators_evidence()}"]
+        return head + [
+            f"{n}:{self._infos[n].CRC}:{self._infos[n].file_size}"
+            for n in self._order
+        ]
+
+    def want(self, member: str) -> None:
+        if self._span_of(member) is not None:
+            self._prefetch.want(member)
+
+    def reset_wants(self) -> None:
+        self._prefetch.reset()
+
+    def read(self, member: str):
+        info = self._infos.get(member)
+        if info is None:
+            return None
+        if info.file_size > MAX_LICENSE_SIZE:
+            return SkippedBlob(OVERSIZED)
+        return self._prefetch.read(member)
+
+    def close(self) -> None:
+        self._prefetch.close()
+        self._source.close()
+
+
+class _RemoteSeqTarContainer(_SeqTarContainer):
+    """Compressed tar over HTTP: the PR 15 sequential-window reader
+    with its forward passes riding streaming GETs — one full-body
+    stream for the metadata scan, one per stripe span for reads, each
+    fenced with ``If-Match``.  A torn stream is retried with the
+    container's budget (reopen = the window reader's counted rescan);
+    budget exhaustion fails the container closed."""
+
+    def __init__(self, url: str):
+        self._source = _RemoteSource(url, require_range=False)
+        self._raw = None
+        self._meta_raw = None
+        try:
+            super().__init__(url)
+        except BaseException:
+            self._close_meta()
+            self._source.close()
+            raise
+        # the metadata pass is done; its dedicated connection dies now,
+        # not at container close
+        self._close_meta()
+
+    def _head_evidence(self) -> str:
+        return f"rctar:{self._source.validators_evidence()}"
+
+    def _open_meta_tar(self):
+        import tarfile
+
+        raw = self._source.open_stream()
+        try:
+            tf = tarfile.open(fileobj=raw, mode="r|*")
+        except BaseException:
+            raw.close()
+            raise
+        # tarfile's `with` close does not close the fileobj; the
+        # caller (our __init__) closes it via _close_meta
+        self._meta_raw = raw
+        return tf
+
+    def _close_meta(self) -> None:
+        if self._meta_raw is not None:
+            self._meta_raw.close()
+            self._meta_raw = None
+
+    def _open_stream_tar(self):
+        import tarfile
+
+        raw = self._source.open_stream()
+        try:
+            tf = tarfile.open(fileobj=raw, mode="r|*")
+        except BaseException:
+            raw.close()
+            raise
+        self._raw = raw
+        return tf
+
+    def _close_stream(self) -> None:
+        super()._close_stream()
+        if self._raw is not None:
+            self._raw.close()
+            self._raw = None
+
+    def read(self, member: str):
+        got = self._members.get(member)
+        if got is None:
+            return None
+        if got[1] > MAX_LICENSE_SIZE:
+            return SkippedBlob(OVERSIZED)
+        delay = self._source.backoff_s
+        attempt = 0
+        while True:
+            out = super().read(member)
+            if out is not None:
+                return out
+            # None from the window reader = torn/dropped stream (the
+            # transport surfaces as OSError inside the walk).  Retry
+            # against the budget: the next read reopens a fresh
+            # fenced stream; a PERSISTENT tear (or a member whose
+            # bytes genuinely come up short) exhausts the budget and
+            # fails the container closed.
+            attempt += 1
+            self._source.note_transient(
+                f"stream read {self.url}::{member}"
+            )
+            if attempt > self._source.retries:
+                raise RemoteRetryBudgetError(
+                    f"stream read {self.url!r}::{member!r}: retry "
+                    f"budget exhausted after {attempt - 1} retries"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, self._source.backoff_cap_s)
+
+    def close(self) -> None:
+        super().close()
+        self._close_meta()
+        self._source.close()
+
+
+def open_remote_container(kind: str, url: str):
+    """The sources.py routing hook for ``http(s)://`` containers."""
+    if kind == "rtar":
+        return _RemoteTarContainer(url)
+    if kind == "rctar":
+        return _RemoteSeqTarContainer(url)
+    if kind == "rzip":
+        return _RemoteZipContainer(url)
+    if kind == "rgit":
+        raise IngestError(
+            f"git-over-HTTP container {url!r} is not supported; "
+            "publish a tar/zip artifact (release tarballs address as "
+            "https://...tar.gz::*)"
+        )
+    raise IngestError(f"unrecognized remote container kind {kind!r}")
+
+
+def probe_remote(url: str, *, timeout_s: float = 5.0) -> dict:
+    """The cheap submit-time probe (``POST /jobs`` validate_spec): a
+    HEAD for reachability + validators, plus a 1-byte ranged GET for
+    the kinds that need random access.  Answers
+    ``{kind, size, etag, last_modified}``; raises
+    :class:`RemoteProbeError` (unreachable, non-200, no Range support)
+    or :class:`RemoteError` (unsupported scheme/shape) so the edge can
+    400 at submit instead of crashing a stripe mid-job."""
+    kind = remote_entry_kind(url)
+    if kind is None:
+        raise RemoteError(
+            f"{url!r} is not a recognized remote container "
+            "(want http(s)://...{.tar,.tar.gz,.tgz,.zip})"
+        )
+    if kind == "rgit":
+        raise RemoteError(
+            f"git-over-HTTP container {url!r} is not supported; "
+            "publish a tar/zip artifact"
+        )
+    knobs = dict(_knobs())
+    knobs["timeout_s"] = timeout_s
+    knobs["retries"] = min(knobs["retries"], 1)
+    source = _RemoteSource(
+        url, require_range=kind in ("rtar", "rzip"), knobs=knobs
+    )
+    try:
+        return {
+            "kind": kind,
+            "size": source.size,
+            "etag": source.etag,
+            "last_modified": source.last_modified,
+        }
+    finally:
+        source.close()

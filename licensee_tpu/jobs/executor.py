@@ -154,12 +154,43 @@ def validate_spec(spec) -> tuple[dict | None, str | None]:
         not isinstance(key, str) or not key or len(key) > 200
     ):
         return None, "spec.idempotency_key must be a short string"
+    problem = _probe_remote_entries(entries)
+    if problem is not None:
+        return None, problem
     return {
         "manifest": entries,
         "stripes": stripes,
         "options": normalized_options,
         "idempotency_key": key,
     }, None
+
+
+def _probe_remote_entries(entries: list[str]) -> str | None:
+    """Submit-time validation of remote-source manifest entries: a
+    cheap HEAD + 1-byte ranged probe of each distinct remote container
+    URL (ingest/remote.py), so an unreachable artifact, a server
+    without Range support, or a refused shape (git-over-HTTP) is a 400
+    at SUBMIT — not a stripe crash minutes into the job.  validate_spec
+    runs on the edge's ops thread (like check_corpus_source's
+    submit-time IO), never on the event loop."""
+    from licensee_tpu.ingest.remote import (
+        RemoteError,
+        probe_remote,
+        remote_entry_kind,
+    )
+    from licensee_tpu.ingest.sources import SEP
+
+    seen: set[str] = set()
+    for entry in entries:
+        container = entry.split(SEP, 1)[0]
+        if container in seen or remote_entry_kind(container) is None:
+            continue
+        seen.add(container)
+        try:
+            probe_remote(container, timeout_s=5.0)
+        except RemoteError as exc:
+            return f"remote source {container!r} failed its probe: {exc}"
+    return None
 
 
 def forward_args_for(options: dict) -> tuple[str, ...]:

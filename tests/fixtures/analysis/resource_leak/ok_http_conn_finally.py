@@ -1,0 +1,13 @@
+"""Clean: try/finally guarantees the HTTP connection closes even
+when the request raises."""
+
+import http.client
+
+
+def fetch(host, target):
+    conn = http.client.HTTPConnection(host, timeout=5.0)
+    try:
+        conn.request("GET", target)
+        return conn.getresponse().read()
+    finally:
+        conn.close()

@@ -1,0 +1,14 @@
+"""Clean: ownership hand-offs — a dialed connection parked in a pool
+(call argument) or returned to the caller is not a leak here."""
+
+import http.client
+
+
+def dial_into(pool, host):
+    conn = http.client.HTTPSConnection(host, timeout=5.0)
+    pool.release(conn)  # the pool owns it now
+
+
+def dial(host):
+    conn = http.client.HTTPConnection(host, timeout=5.0)
+    return conn  # the caller owns it now

@@ -1,0 +1,12 @@
+"""TP: the close sits on the happy path only — a raised request
+leaks the dialed TLS connection."""
+
+import http.client
+
+
+def fetch_secure(host, target):
+    conn = http.client.HTTPSConnection(host, timeout=5.0)  # BAD
+    conn.request("GET", target)
+    body = conn.getresponse().read()
+    conn.close()
+    return body

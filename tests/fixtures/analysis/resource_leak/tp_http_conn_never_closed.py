@@ -1,0 +1,10 @@
+"""TP: an HTTP connection that is never closed and never handed off."""
+
+import http.client
+
+
+def fetch(host, target):
+    conn = http.client.HTTPConnection(host, timeout=5.0)  # BAD
+    conn.request("GET", target)
+    resp = conn.getresponse()
+    return resp.read()

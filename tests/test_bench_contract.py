@@ -230,6 +230,17 @@ def _fat_details() -> dict:
                 "identical_output": True,
                 "container_rows": 99_999_999,
             },
+            "remote": {
+                "tar_files_per_sec": 99_999_999.9,
+                "vs_local_tar": 99.999,
+                "identical_output": True,
+                "requests": 99_999_999,
+                "latency_ms": 99_999,
+                "pipelined_files_per_sec": 99_999_999.9,
+                "serial_files_per_sec": 99_999_999.9,
+                "pipeline_x": 99.99,
+                "identical_latency": True,
+            },
         },
         "jobs": {
             "files": 1_000_000,
@@ -313,9 +324,10 @@ def test_headline_line_fits_driver_capture(bench_mod):
     # when its striped_* keys joined (PR 15), 1850 -> 1980 when the
     # durable-jobs block joined (PR 16), 1980 -> 2080 when the
     # telemetry-store block joined (PR 18), 2080 -> 2200 when the
-    # multi-tenant block joined (PR 19) — this worst-case dict
+    # multi-tenant block joined (PR 19), 2200 -> 2290 when the
+    # remote-ingest keys joined (PR 20) — this worst-case dict
     # inflates every scalar to its widest; real lines run shorter
-    assert n <= 2200
+    assert n <= 2290
 
 
 def test_headline_carries_the_headline_numbers(bench_mod):
@@ -377,6 +389,12 @@ def test_headline_carries_the_headline_numbers(bench_mod):
     # identical + per-stripe rate vs loose-file striping
     assert d["ingest"]["striped_identical"] is True
     assert d["ingest"]["striped_vs_loose"] == 99.999
+    # the remote-source scalars (PR 20): loopback-HTTP tar rate vs
+    # local tar (sha256-identical) and the injected-latency prefetch
+    # pipelining multiple (readahead=8 over readahead=1)
+    assert d["ingest"]["remote_vs_local"] == 99.999
+    assert d["ingest"]["remote_identical"] is True
+    assert d["ingest"]["remote_pipeline_x"] == 99.99
     # the durable-jobs scalars (PR 16): edge-submitted job throughput
     # vs the direct striped run, submit->first-progress latency, and
     # the sha256-identical merged-output gate

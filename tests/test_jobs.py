@@ -152,6 +152,44 @@ def test_validate_spec_refuses(bad, why):
     assert why in reason
 
 
+def test_validate_spec_probes_remote_entries():
+    """A manifest naming a remote container gets a submit-time probe:
+    reachable hosts pass, dead/range-less/git-over-HTTP ones land the
+    400 reason at POST /jobs instead of a failed job minutes later."""
+    import io
+    import tarfile
+
+    from licensee_tpu.ingest.loopback import LoopbackBlobHost
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        info = tarfile.TarInfo("LICENSE")
+        info.size = 4
+        tf.addfile(info, io.BytesIO(b"MIT\n"))
+    with LoopbackBlobHost({"r.tar": buf.getvalue()}) as host:
+        good = host.url("r.tar") + "::*"
+        spec, reason = validate_spec({"manifest": [good, "/loose"]})
+        assert reason is None and spec["manifest"][0] == good
+
+        spec, reason = validate_spec(
+            {"manifest": [host.url("gone.zip") + "::*"]}
+        )
+        assert spec is None and "gone.zip" in reason
+
+        host.no_range = True
+        spec, reason = validate_spec({"manifest": [good]})
+        assert spec is None and "byte ranges" in reason
+
+        spec, reason = validate_spec(
+            {"manifest": [host.url("x.git") + "::HEAD"]}
+        )
+        assert spec is None and "tar/zip" in reason
+
+    # the whole host is gone: connect refusal is a submit-time 400 too
+    spec, reason = validate_spec({"manifest": [good]})
+    assert spec is None and "probe" in reason
+
+
 # -- stub runners ------------------------------------------------------
 
 

@@ -1,0 +1,298 @@
+"""Remote streaming ingest (licensee_tpu/ingest/remote.py): URL
+grammar routing, loopback sha256 parity for ranged tar / ranged zip /
+streaming compressed tar (including restricted spans, descriptor
+re-opens, and ``--featurize-procs``), range coalescing, and the
+failure model — torn bodies, retry budgets, mid-job republish fencing,
+behind-window misses counted not taken, and submit-time probing.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import os
+import tarfile
+import zipfile
+
+import pytest
+
+from licensee_tpu.ingest import SkippedBlob
+from licensee_tpu.ingest.loopback import LoopbackBlobHost
+from licensee_tpu.ingest.remote import (
+    RemoteChangedError,
+    RemoteError,
+    RemoteProbeError,
+    RemoteRetryBudgetError,
+    _RemoteSeqTarContainer,
+    probe_remote,
+    remote_entry_kind,
+)
+from licensee_tpu.ingest.sources import (
+    IngestError,
+    ManifestExpansion,
+    expand_manifest,
+    expanded_layout,
+    is_container_entry,
+    split_entry,
+)
+
+BLOBS = {
+    f"pkg{i:02d}/LICENSE": (
+        b"Permission is hereby granted, free of charge %02d\n" % i
+    ) * 8
+    for i in range(24)
+}
+
+
+def _tar_bytes(files=None) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, data in (files or BLOBS).items():
+            info = tarfile.TarInfo(name=name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def _zip_bytes(files=None) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for name, data in (files or BLOBS).items():
+            zf.writestr(name, data)
+    return buf.getvalue()
+
+
+@pytest.fixture()
+def host():
+    # 1 ms backoff so the scripted-fault retries cost nothing
+    saved = os.environ.get("LICENSEE_TPU_REMOTE_BACKOFF_MS")
+    os.environ["LICENSEE_TPU_REMOTE_BACKOFF_MS"] = "1"
+    h = LoopbackBlobHost({
+        "a.tar": _tar_bytes(),
+        "a.zip": _zip_bytes(),
+        "a.tar.gz": gzip.compress(_tar_bytes()),
+    })
+    with h:
+        yield h
+    if saved is None:
+        os.environ.pop("LICENSEE_TPU_REMOTE_BACKOFF_MS", None)
+    else:
+        os.environ["LICENSEE_TPU_REMOTE_BACKOFF_MS"] = saved
+
+
+# -- grammar routing --
+
+
+def test_url_entry_grammar():
+    assert remote_entry_kind("https://h/x/a.tar") == "rtar"
+    assert remote_entry_kind("http://h:8080/a.tar.gz?tok=1") == "rctar"
+    assert remote_entry_kind("https://h/a.zip#frag") == "rzip"
+    assert remote_entry_kind("https://h/repo.git") == "rgit"
+    assert remote_entry_kind("https://h/a.bin") is None
+    assert remote_entry_kind("/local/a.tar") is None
+    # the FIRST :: splits; scheme/port colons are single and safe
+    assert split_entry("https://h:8080/a.tar::*") == (
+        "https://h:8080/a.tar", "*",
+    )
+    assert is_container_entry("https://h/r.zip::LICENSE")
+    # an unrecognized URL shape degrades to a loose path, row-contained
+    assert not is_container_entry("https://h/a.bin::x")
+
+
+def test_git_over_http_refused(host):
+    host.set_content("repo.git", b"not a repo")
+    with pytest.raises(IngestError, match="publish a tar/zip"):
+        expand_manifest([host.url("repo.git") + "::HEAD"])
+
+
+# -- parity --
+
+
+@pytest.mark.parametrize("artifact", ["a.tar", "a.zip", "a.tar.gz"])
+def test_remote_parity_bit_identical(host, artifact):
+    ex = expand_manifest([host.url(artifact) + "::*"])
+    try:
+        assert ex.total == len(BLOBS)
+        got = {ex.paths[i]: ex.read_at(i) for i in range(ex.total)}
+    finally:
+        ex.close()
+    assert got == BLOBS
+
+
+def test_ranged_reads_coalesce(host):
+    ex = expand_manifest([host.url("a.tar") + "::*"])
+    try:
+        for i in range(ex.total):
+            ex.read_at(i)
+    finally:
+        ex.close()
+    # 24 small members must NOT cost 24 round trips: adjacent spans
+    # coalesce into few ranged reads (plus metadata/probe requests)
+    assert len(host.ranges.get("a.tar", [])) < len(BLOBS) // 2
+
+
+def test_restricted_spans_and_descriptor_reopen(host):
+    url = host.url("a.tar") + "::*"
+    names = sorted(BLOBS)
+    halves = []
+    for lo, hi in ((0, 12), (12, 24)):
+        ex = expand_manifest([url])
+        try:
+            ex.restrict(lo, hi)
+            desc = ex.descriptor()
+            # the worker-process path: pickle the recipe, re-open fresh
+            worker = ManifestExpansion.from_descriptor(desc)
+            try:
+                halves.append(
+                    [worker.read_at(i) for i in range(hi - lo)]
+                )
+            finally:
+                worker.close()
+        finally:
+            ex.close()
+    assert halves[0] + halves[1] == [BLOBS[n] for n in names]
+
+
+def test_featurize_procs_parity(host, tmp_path):
+    from licensee_tpu.projects.batch_project import BatchProject
+
+    outs = {}
+    for label, procs in (("solo", 0), ("procs", 2)):
+        out = tmp_path / f"{label}.jsonl"
+        project = BatchProject(
+            [host.url("a.tar") + "::*"], batch_size=8, mesh=None,
+            featurize_procs=procs,
+        )
+        try:
+            project.run(str(out), resume=False)
+        finally:
+            project.close()
+        outs[label] = hashlib.sha256(out.read_bytes()).hexdigest()
+    assert outs["solo"] == outs["procs"]
+
+
+def test_oversized_member_skips_not_truncates(host):
+    big = {"small/LICENSE": b"MIT\n" * 10, "big/LICENSE": b"x" * 70_000}
+    host.set_content("big.tar", _tar_bytes(big))
+    ex = expand_manifest([host.url("big.tar") + "::*"])
+    try:
+        rows = {ex.paths[i]: ex.read_at(i) for i in range(ex.total)}
+    finally:
+        ex.close()
+    assert rows["small/LICENSE"] == big["small/LICENSE"]
+    assert isinstance(rows["big/LICENSE"], SkippedBlob)
+
+
+# -- the failure model --
+
+
+def test_torn_body_retried_once_then_bit_identical(host):
+    host.truncate_next("a.tar", 40)
+    ex = expand_manifest([host.url("a.tar") + "::*"])
+    try:
+        assert ex.read_at(0) == BLOBS[sorted(BLOBS)[0]]
+    finally:
+        ex.close()
+
+
+def test_persistent_tear_fails_closed(host):
+    # every body torn: the retry budget must exhaust, never a silent
+    # partial scan (metadata fetches hit the tear at expansion)
+    host.truncate_next("a.tar", 40, times=99)
+    with pytest.raises(RemoteRetryBudgetError):
+        expand_manifest([host.url("a.tar") + "::*"])
+
+
+def test_retry_budget_exhaustion_on_5xx(host):
+    host.fail_next("a.tar", 99, 503)
+    with pytest.raises(RemoteRetryBudgetError):
+        expand_manifest([host.url("a.tar") + "::*"])
+
+
+def test_503_then_recover_within_budget(host):
+    host.fail_next("a.zip", 2, 503)
+    ex = expand_manifest([host.url("a.zip") + "::*"])
+    try:
+        got = {ex.paths[i]: ex.read_at(i) for i in range(ex.total)}
+    finally:
+        ex.close()
+    assert got == BLOBS
+
+
+def test_midjob_republish_refuses_ranged_reads(host):
+    ex = expand_manifest([host.url("a.tar") + "::*"])
+    try:
+        host.set_content("a.tar", _tar_bytes() + b"\0" * 1024)
+        with pytest.raises(RemoteChangedError):
+            ex.read_at(0)
+    finally:
+        ex.close()
+
+
+def test_midjob_republish_refuses_stream_reads(host):
+    ex = expand_manifest([host.url("a.tar.gz") + "::*"])
+    try:
+        host.set_content("a.tar.gz", gzip.compress(_tar_bytes() + b"\0"))
+        with pytest.raises((RemoteChangedError, RemoteRetryBudgetError)):
+            ex.read_at(0)
+    finally:
+        ex.close()
+
+
+def test_republish_changes_fingerprint_and_refuses_resume(host):
+    """The validators fold into the expansion fingerprint, so the
+    PR 15 resume/worker gates refuse a republished artifact even when
+    the member table looks identical."""
+    url = host.url("a.tar") + "::*"
+    before = expanded_layout([url])["fingerprint"]
+    ex = expand_manifest([url])
+    try:
+        desc = ex.descriptor()
+    finally:
+        ex.close()
+    # same member names + sizes, different bytes -> new ETag
+    flipped = {n: d[:-1] + b"?" for n, d in BLOBS.items()}
+    host.set_content("a.tar", _tar_bytes(flipped))
+    after = expanded_layout([url])["fingerprint"]
+    assert before != after
+    with pytest.raises(IngestError, match="changed under a running"):
+        ManifestExpansion.from_descriptor(desc)
+
+
+def test_behind_window_miss_counted_not_taken(host):
+    """The streaming-tar path: a read behind the forward window that
+    was never want()ed pays ONE counted rescan (the correctness
+    fallback), it does not fail and it does not silently rescan per
+    blob."""
+    container = _RemoteSeqTarContainer(host.url("a.tar.gz"))
+    try:
+        names = container.members()
+        # no wants registered: walking to ordinal 2 caches nothing
+        assert container.read(names[2]) == BLOBS[names[2]]
+        assert container.rescans == 0
+        # ordinal 0 is now behind the window -> one counted rescan
+        assert container.read(names[0]) == BLOBS[names[0]]
+        assert container.rescans == 1
+    finally:
+        container.close()
+
+
+# -- submit-time probing --
+
+
+def test_probe_remote_shapes(host):
+    info = probe_remote(host.url("a.tar"))
+    assert info["kind"] == "rtar" and info["size"] == len(_tar_bytes())
+    assert info["etag"]
+    # compressed tar needs reachability only, not Range support
+    host.no_range = True
+    assert probe_remote(host.url("a.tar.gz"))["kind"] == "rctar"
+    with pytest.raises(RemoteProbeError, match="byte ranges"):
+        probe_remote(host.url("a.tar"))
+    host.no_range = False
+    with pytest.raises(RemoteProbeError):
+        probe_remote(host.url("missing.zip"))
+    with pytest.raises(RemoteError, match="503"):
+        host.fail_next("a.zip", 99, 503)
+        probe_remote(host.url("a.zip"))
